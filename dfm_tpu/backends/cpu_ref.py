@@ -1,0 +1,390 @@
+"""CPU reference backend: NumPy float64 Kalman/EM for dynamic factor models.
+
+This module is the correctness oracle for the whole framework (SURVEY.md section
+7.1 M0).  The reference package ``joidegn/DynamicFactorModels.jl`` could not be
+mounted (its directory is empty — SURVEY.md section 0), so the operative spec is
+BASELINE.json:5, which pins the exact recursions implemented here:
+
+    predict:  f_t|t-1 = A f_{t-1},      P_t|t-1 = A P_{t-1} A' + Q
+    update:   S_t = Lam P_t|t-1 Lam' + R,  K_t = P_t|t-1 Lam' S_t^{-1}
+    smoother: RTS backward pass with lag-one covariances for the EM M-step.
+
+Model (SURVEY.md section 3 notation):
+
+    y_t = Lam f_t + eps_t,   eps_t ~ N(0, diag(R))       (observation, N series)
+    f_t = A f_{t-1} + eta_t, eta_t ~ N(0, Q)             (state, k factors)
+    f_1 ~ N(mu0, P0)
+
+Missing observations are handled by a {0,1} mask W (T, N): masked rows are
+excluded from the update and the log-likelihood (Banbura-Modugno, SURVEY.md
+section 3.4).  A fully-observed mask must reproduce the dense path exactly —
+that equivalence is a unit test.
+
+Everything here is float64 NumPy, deliberately simple and allocation-heavy; it
+exists to be *right*, not fast.  The JAX/TPU backend is validated against this
+module to 1e-5 in log-likelihood (BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "SSMParams",
+    "KalmanResult",
+    "SmootherResult",
+    "kalman_filter",
+    "rts_smoother",
+    "em_step",
+    "em_fit",
+    "pca_init",
+    "forecast",
+]
+
+
+@dataclasses.dataclass
+class SSMParams:
+    """Dense state-space parameters (the pytree mirrored by the JAX backend).
+
+    Lam : (N, k) factor loadings
+    A   : (k, k) factor VAR(1) transition (zero matrix for a static DFM)
+    Q   : (k, k) state innovation covariance
+    R   : (N,)   diagonal observation noise variances
+    mu0 : (k,)   initial state mean
+    P0  : (k, k) initial state covariance
+    """
+
+    Lam: np.ndarray
+    A: np.ndarray
+    Q: np.ndarray
+    R: np.ndarray
+    mu0: np.ndarray
+    P0: np.ndarray
+
+    def copy(self) -> "SSMParams":
+        return SSMParams(*(np.array(getattr(self, f.name), dtype=np.float64)
+                           for f in dataclasses.fields(self)))
+
+    @property
+    def n_series(self) -> int:
+        return self.Lam.shape[0]
+
+    @property
+    def n_factors(self) -> int:
+        return self.Lam.shape[1]
+
+
+@dataclasses.dataclass
+class KalmanResult:
+    x_pred: np.ndarray  # (T, k)   f_t|t-1
+    P_pred: np.ndarray  # (T, k, k)
+    x_filt: np.ndarray  # (T, k)   f_t|t
+    P_filt: np.ndarray  # (T, k, k)
+    loglik: float
+
+
+@dataclasses.dataclass
+class SmootherResult:
+    x_sm: np.ndarray   # (T, k)    E[f_t | y_1..T]
+    P_sm: np.ndarray   # (T, k, k) Cov[f_t | y_1..T]
+    P_lag: np.ndarray  # (T, k, k) Cov[f_t, f_{t-1} | y_1..T]; row 0 is zeros
+
+
+def _sym(M: np.ndarray) -> np.ndarray:
+    return 0.5 * (M + np.swapaxes(M, -1, -2))
+
+
+def kalman_filter(Y: np.ndarray, p: SSMParams,
+                  mask: Optional[np.ndarray] = None) -> KalmanResult:
+    """Forward Kalman filter with exact log-likelihood.
+
+    Y    : (T, N) panel; entries at masked positions are ignored (may be nan —
+           they are zeroed internally so arithmetic stays finite).
+    mask : optional (T, N) {0,1}; 1 = observed.  None means fully observed.
+
+    Uses the Joseph-form covariance update for numerical symmetry/PSD-ness
+    (SURVEY.md section 7.2 item 1).  t=1 uses (mu0, P0) directly as the
+    prediction, i.e. P0 is the prior on f_1 itself.
+    """
+    Y = np.asarray(Y, dtype=np.float64)
+    T, N = Y.shape
+    k = p.n_factors
+    Lam, A, Q, R = (np.asarray(p.Lam, np.float64), np.asarray(p.A, np.float64),
+                    np.asarray(p.Q, np.float64), np.asarray(p.R, np.float64))
+    if mask is not None:
+        mask = np.asarray(mask, dtype=np.float64)
+        Y = np.where(mask > 0, np.nan_to_num(Y), 0.0)
+
+    x_pred = np.zeros((T, k))
+    P_pred = np.zeros((T, k, k))
+    x_filt = np.zeros((T, k))
+    P_filt = np.zeros((T, k, k))
+    loglik = 0.0
+    log2pi = np.log(2.0 * np.pi)
+
+    x, P = np.asarray(p.mu0, np.float64), np.asarray(p.P0, np.float64)
+    for t in range(T):
+        if t > 0:
+            x = A @ x_filt[t - 1]
+            P = _sym(A @ P_filt[t - 1] @ A.T + Q)
+        x_pred[t] = x
+        P_pred[t] = P
+
+        if mask is None:
+            obs = np.ones(N, dtype=bool)
+        else:
+            obs = mask[t] > 0
+        n_t = int(obs.sum())
+        if n_t == 0:
+            x_filt[t] = x
+            P_filt[t] = P
+            continue
+
+        H = Lam[obs]                      # (n_t, k)
+        r = R[obs]                        # (n_t,)
+        v = Y[t, obs] - H @ x             # innovation
+        S = H @ P @ H.T + np.diag(r)      # (n_t, n_t)
+        S = _sym(S)
+        # Solve via Cholesky — never form S^{-1} explicitly.
+        L = np.linalg.cholesky(S)
+        Sinv_v = np.linalg.solve(L.T, np.linalg.solve(L, v))
+        K = np.linalg.solve(L.T, np.linalg.solve(L, H @ P)).T  # P H' S^-1, (k, n_t)
+        x = x + K @ v
+        IKH = np.eye(k) - K @ H
+        P = _sym(IKH @ P @ IKH.T + (K * r) @ K.T)  # Joseph form
+        x_filt[t] = x
+        P_filt[t] = P
+        loglik += -0.5 * (n_t * log2pi + 2.0 * np.sum(np.log(np.diag(L)))
+                          + v @ Sinv_v)
+
+    return KalmanResult(x_pred, P_pred, x_filt, P_filt, float(loglik))
+
+
+def rts_smoother(kf: KalmanResult, p: SSMParams) -> SmootherResult:
+    """Rauch-Tung-Striebel backward smoother with lag-one covariances.
+
+    Lag-one smoothed covariance uses the exact identity
+        Cov(f_t, f_{t-1} | Y) = P_sm[t] @ J_{t-1}'
+    with J_t = P_filt[t] A' P_pred[t+1]^{-1}, which follows from the RTS
+    conditional  f_t | f_{t+1}, y_1..t  (equivalent to the Shumway-Stoffer
+    recursion; verified against a brute-force joint-Gaussian oracle in tests).
+    """
+    T, k = kf.x_filt.shape
+    A = np.asarray(p.A, np.float64)
+    x_sm = np.zeros((T, k))
+    P_sm = np.zeros((T, k, k))
+    P_lag = np.zeros((T, k, k))
+    J = np.zeros((T, k, k))  # J[t] defined for t < T-1
+
+    x_sm[-1] = kf.x_filt[-1]
+    P_sm[-1] = kf.P_filt[-1]
+    for t in range(T - 2, -1, -1):
+        Pp = kf.P_pred[t + 1]
+        # J_t = P_filt[t] A' P_pred[t+1]^{-1}  via solve on the symmetric Pp
+        J[t] = np.linalg.solve(Pp, A @ kf.P_filt[t]).T
+        x_sm[t] = kf.x_filt[t] + J[t] @ (x_sm[t + 1] - kf.x_pred[t + 1])
+        P_sm[t] = _sym(kf.P_filt[t]
+                       + J[t] @ (P_sm[t + 1] - Pp) @ J[t].T)
+    for t in range(1, T):
+        P_lag[t] = P_sm[t] @ J[t - 1].T
+    return SmootherResult(x_sm, P_sm, P_lag)
+
+
+def smoothed_moments(sm: SmootherResult):
+    """Sufficient statistics for the EM M-step (SURVEY.md section 3.1).
+
+    Purely a function of the smoother output; observation-side (masked) sums
+    are formed in ``em_step`` where the data lives.
+
+    Returns dict with:
+      S_ff     = sum_t E[f_t f_t']                     (k, k)
+      S_ff_lag = sum_{t>=1} E[f_{t-1} f_{t-1}']        (k, k)
+      S_ff_cur = sum_{t>=1} E[f_t f_t']                (k, k)
+      S_cross  = sum_{t>=1} E[f_t f_{t-1}']            (k, k)
+      Ef       = smoothed means (T, k)
+      EffT     = per-t second moments (T, k, k)
+    """
+    x, P, Pl = sm.x_sm, sm.P_sm, sm.P_lag
+    EffT = P + np.einsum("ti,tj->tij", x, x)
+    cross = Pl[1:] + np.einsum("ti,tj->tij", x[1:], x[:-1])
+    return {
+        "S_ff": EffT.sum(0),
+        "S_ff_lag": EffT[:-1].sum(0),
+        "S_ff_cur": EffT[1:].sum(0),
+        "S_cross": cross.sum(0),
+        "Ef": x,
+        "EffT": EffT,
+    }
+
+
+def em_step(Y: np.ndarray, p: SSMParams,
+            mask: Optional[np.ndarray] = None,
+            estimate_A: bool = True,
+            estimate_Q: bool = True,
+            estimate_init: bool = False,
+            r_floor: float = 1e-6):
+    """One EM iteration: E-step (filter+smoother) then closed-form M-step.
+
+    Returns (new_params, loglik_of_old_params, smoother_result).
+
+    M-step (BASELINE.json:5 "sufficient-statistic reductions"):
+      Lam <- S_yf S_ff^{-1}          (per-series rows when masked)
+      R   <- diag(sum_t y y' - Lam S_yf') / T   (masked: per-series count)
+      A   <- S_cross S_ff_lag^{-1}
+      Q   <- (S_ff_cur - A S_cross') / (T-1)
+
+    With a mask, Lam_i / R_i use only series i's observed times
+    (Banbura-Modugno): Lam_i = (sum_t w_ti y_ti Ef_t') (sum_t w_ti EffT_t)^{-1}
+    and R_i includes the filtered-uncertainty correction lam_i' V_t lam_i.
+    """
+    Y = np.asarray(Y, dtype=np.float64)
+    T, N = Y.shape
+    kf = kalman_filter(Y, p, mask=mask)
+    sm = rts_smoother(kf, p)
+    mom = smoothed_moments(sm)
+    Ef, EffT = mom["Ef"], mom["EffT"]
+
+    new = p.copy()
+    if mask is None:
+        S_yf = Y.T @ Ef                        # (N, k)
+        Lam = np.linalg.solve(mom["S_ff"].T, S_yf.T).T
+        R = (np.einsum("ti,ti->i", Y, Y) - np.einsum("ik,ik->i", Lam, S_yf)) / T
+    else:
+        W = np.asarray(mask, dtype=np.float64)
+        Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
+        # Per-series masked normal equations, vectorized over i.
+        S_yf_i = np.einsum("ti,tk->ik", Yz * W, Ef)            # (N, k)
+        S_ff_i = np.einsum("ti,tkl->ikl", W, EffT)             # (N, k, k)
+        # A series with no observed entries has S_ff_i = 0; substitute the
+        # identity so the batched solve stays nonsingular (its loading comes
+        # out zero since S_yf_i is zero there too).
+        k = p.n_factors
+        never_obs = W.sum(0) == 0
+        S_ff_i = np.where(never_obs[:, None, None], np.eye(k)[None], S_ff_i)
+        Lam = np.linalg.solve(np.swapaxes(S_ff_i, 1, 2),
+                              S_yf_i[:, :, None])[:, :, 0]
+        counts = np.maximum(W.sum(0), 1.0)
+        resid_sq = np.einsum("ti,ti->i", W, (Yz - Ef @ Lam.T) ** 2)
+        smear = np.einsum("ik,ikl,il->i",
+                          Lam, np.einsum("ti,tkl->ikl", W, sm.P_sm), Lam)
+        R = (resid_sq + smear) / counts
+    new.Lam = Lam
+    new.R = np.maximum(R, r_floor)
+
+    if estimate_A:
+        A = np.linalg.solve(mom["S_ff_lag"].T, mom["S_cross"].T).T
+        new.A = A
+        if estimate_Q:
+            Q = (mom["S_ff_cur"] - A @ mom["S_cross"].T) / (T - 1)
+            new.Q = _sym(Q)
+    elif estimate_Q:
+        # A fixed (e.g. zero for static DFM): Q <- mean E[eta eta'].
+        A = p.A
+        Q = (mom["S_ff_cur"] - A @ mom["S_cross"].T - mom["S_cross"] @ A.T
+             + A @ mom["S_ff_lag"] @ A.T) / (T - 1)
+        new.Q = _sym(Q)
+    if estimate_init:
+        new.mu0 = sm.x_sm[0]
+        new.P0 = _sym(sm.P_sm[0])
+    return new, kf.loglik, sm
+
+
+def em_fit(Y: np.ndarray, p0: SSMParams,
+           mask: Optional[np.ndarray] = None,
+           max_iters: int = 50, tol: float = 1e-6,
+           estimate_A: bool = True, estimate_Q: bool = True,
+           estimate_init: bool = False,
+           callback=None):
+    """EM driver with relative-loglik convergence (SURVEY.md section 3.1).
+
+    Returns (params, logliks) where logliks[i] is the log-likelihood *at the
+    parameters entering iteration i* — monotone non-decreasing by the EM
+    invariant (SURVEY.md section 4.2.2a).
+    """
+    p = p0.copy()
+    logliks = []
+    for it in range(max_iters):
+        p_new, ll, _ = em_step(Y, p, mask=mask, estimate_A=estimate_A,
+                               estimate_Q=estimate_Q,
+                               estimate_init=estimate_init)
+        logliks.append(ll)
+        if callback is not None:
+            callback(it, ll, p)
+        if it > 0:
+            denom = max(abs(logliks[-2]), 1e-12)
+            if (ll - logliks[-2]) / denom < tol:
+                p = p_new
+                break
+        p = p_new
+    return p, np.array(logliks)
+
+
+def pca_init(Y: np.ndarray, k: int, static: bool = False,
+             mask: Optional[np.ndarray] = None) -> SSMParams:
+    """Stock-Watson principal-components initializer (SURVEY.md R3).
+
+    Assumes ``Y`` is already standardized per series (mean 0 — the state-space
+    model has no intercept; the ``api`` layer owns standardization, reference
+    component R2).  Lam_hat = sqrt(N) * top-k right singular vectors of the raw
+    data matrix (= eigvecs of Y'Y); f_hat = Y Lam_hat / N.  Then A, Q from an
+    OLS VAR(1) on f_hat and R from idiosyncratic residual variances.  With
+    ``static`` the dynamics are pinned to A=0, Q=I (factor scale absorbed into
+    Lam).  Missing entries (mask=0 or NaN) are zero-filled — the standard EM
+    warm start for incomplete *standardized* panels (zero = series mean).
+    """
+    Y = np.asarray(Y, dtype=np.float64)
+    T, N = Y.shape
+    if mask is not None:
+        Y = np.where(np.asarray(mask) > 0, np.nan_to_num(Y), 0.0)
+    # SVD of the data matrix avoids forming the N x N covariance.
+    U, s, Vt = np.linalg.svd(Y, full_matrices=False)
+    V = Vt[:k].T                                  # (N, k) top eigvecs of Y'Y
+    Lam = np.sqrt(N) * V
+    F = Y @ Lam / N                               # (T, k)
+    resid = Y - F @ Lam.T
+    R = np.maximum(resid.var(axis=0), 1e-6)
+    if static:
+        A = np.zeros((k, k))
+        Q = np.eye(k)
+    else:
+        X, Z = F[1:], F[:-1]
+        A = np.linalg.solve(Z.T @ Z + 1e-8 * np.eye(k), Z.T @ X).T
+        eta = X - Z @ A.T
+        Q = _sym(eta.T @ eta / max(len(eta) - 1, 1)) + 1e-8 * np.eye(k)
+    mu0 = np.zeros(k)
+    P0 = _solve_discrete_lyapunov_or_eye(A, Q)
+    return SSMParams(Lam, A, Q, R, mu0, P0)
+
+
+def _solve_discrete_lyapunov_or_eye(A: np.ndarray, Q: np.ndarray) -> np.ndarray:
+    """Stationary state covariance P = A P A' + Q, or I if A is not stable."""
+    k = A.shape[0]
+    eig = np.max(np.abs(np.linalg.eigvals(A))) if k else 0.0
+    if eig >= 0.999:
+        return np.eye(k)
+    # vec(P) = (I - A kron A)^{-1} vec(Q)
+    M = np.eye(k * k) - np.kron(A, A)
+    P = np.linalg.solve(M, Q.reshape(-1)).reshape(k, k)
+    return _sym(P)
+
+
+def forecast(p: SSMParams, x_T: np.ndarray, P_T: np.ndarray, horizon: int):
+    """h-step-ahead factor and observable forecasts (SURVEY.md section 3.2).
+
+    Returns (f_fore (h, k), y_fore (h, N), P_fore (h, k, k)).
+    """
+    k = p.n_factors
+    f = np.zeros((horizon, k))
+    P = np.zeros((horizon, k, k))
+    x, V = np.asarray(x_T, np.float64), np.asarray(P_T, np.float64)
+    A, Q = np.asarray(p.A, np.float64), np.asarray(p.Q, np.float64)
+    for h in range(horizon):
+        x = A @ x
+        V = _sym(A @ V @ A.T + Q)
+        f[h] = x
+        P[h] = V
+    y = f @ np.asarray(p.Lam, np.float64).T
+    return f, y, P

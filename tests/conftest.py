@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device fake CPU mesh before JAX imports.
+
+SURVEY.md section 4.2.4: only one physical TPU exists in this environment, so
+distributed tests run on a virtual 8-device CPU mesh via
+``--xla_force_host_platform_device_count=8``.  These env vars must be set
+before the first ``import jax`` anywhere in the test process, hence this
+conftest (pytest imports it before collecting test modules).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# Tests compare against float64 NumPy goldens; enable x64 on the CPU backend.
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,222 @@
+"""M0 golden tests for the NumPy CPU reference backend.
+
+The reference package could not be mounted (SURVEY.md section 0), so
+correctness is pinned to first principles: a brute-force joint-Gaussian oracle
+(the whole linear-Gaussian model stacked into one multivariate normal) must
+agree with the filter/smoother/log-likelihood exactly, plus the invariant suite
+of SURVEY.md section 4.2.
+"""
+
+import numpy as np
+import pytest
+
+from dfm_tpu.backends import cpu_ref as cr
+from dfm_tpu.utils import dgp
+
+
+def brute_force_gaussian(Y, p, mask=None):
+    """Joint-Gaussian oracle: stack f_1..f_T and observed y entries into one
+    normal; return (loglik, cond mean (T,k), cond cov (Tk,Tk))."""
+    T, N = Y.shape
+    k = p.n_factors
+    # State means and covariances.
+    mu = np.zeros((T, k))
+    mu[0] = p.mu0
+    Sig = np.zeros((T, k, k))
+    Sig[0] = p.P0
+    for t in range(1, T):
+        mu[t] = p.A @ mu[t - 1]
+        Sig[t] = p.A @ Sig[t - 1] @ p.A.T + p.Q
+    # Cov(f_s, f_t), s <= t: Sig[s] @ (A^(t-s))'.
+    C = np.zeros((T * k, T * k))
+    for s in range(T):
+        Apow = np.eye(k)
+        for t in range(s, T):
+            blk = Sig[s] @ Apow.T
+            C[s * k:(s + 1) * k, t * k:(t + 1) * k] = blk
+            C[t * k:(t + 1) * k, s * k:(s + 1) * k] = blk.T
+            Apow = p.A @ Apow
+    mu_f = mu.reshape(-1)
+    # Observation selector.
+    obs_idx = []
+    for t in range(T):
+        for i in range(N):
+            if mask is None or mask[t, i] > 0:
+                obs_idx.append((t, i))
+    m = len(obs_idx)
+    H = np.zeros((m, T * k))
+    r = np.zeros(m)
+    y = np.zeros(m)
+    for j, (t, i) in enumerate(obs_idx):
+        H[j, t * k:(t + 1) * k] = p.Lam[i]
+        r[j] = p.R[i]
+        y[j] = Y[t, i]
+    S = H @ C @ H.T + np.diag(r)
+    mu_y = H @ mu_f
+    v = y - mu_y
+    Sinv_v = np.linalg.solve(S, v)
+    sign, logdet = np.linalg.slogdet(S)
+    loglik = -0.5 * (m * np.log(2 * np.pi) + logdet + v @ Sinv_v)
+    G = C @ H.T
+    cond_mean = mu_f + G @ Sinv_v
+    cond_cov = C - G @ np.linalg.solve(S, G.T)
+    return loglik, cond_mean.reshape(T, k), cond_cov
+
+
+@pytest.fixture(scope="module")
+def small_problem():
+    rng = np.random.default_rng(0)
+    p = dgp.dfm_params(N=4, k=2, rng=rng)
+    Y, F = dgp.simulate(p, T=12, rng=rng)
+    return Y, F, p
+
+
+def test_filter_loglik_matches_bruteforce(small_problem):
+    Y, _, p = small_problem
+    kf = cr.kalman_filter(Y, p)
+    ll, _, _ = brute_force_gaussian(Y, p)
+    assert kf.loglik == pytest.approx(ll, rel=1e-10)
+
+
+def test_smoother_matches_bruteforce(small_problem):
+    Y, _, p = small_problem
+    T, k = 12, 2
+    kf = cr.kalman_filter(Y, p)
+    sm = cr.rts_smoother(kf, p)
+    _, cond_mean, cond_cov = brute_force_gaussian(Y, p)
+    np.testing.assert_allclose(sm.x_sm, cond_mean, atol=1e-9)
+    for t in range(T):
+        blk = cond_cov[t * k:(t + 1) * k, t * k:(t + 1) * k]
+        np.testing.assert_allclose(sm.P_sm[t], blk, atol=1e-9)
+    for t in range(1, T):
+        lag = cond_cov[t * k:(t + 1) * k, (t - 1) * k:t * k]
+        np.testing.assert_allclose(sm.P_lag[t], lag, atol=1e-9)
+
+
+def test_masked_matches_bruteforce(small_problem):
+    Y, _, p = small_problem
+    rng = np.random.default_rng(1)
+    mask = dgp.random_mask(12, 4, rng, frac_missing=0.3)
+    kf = cr.kalman_filter(Y, p, mask=mask)
+    sm = cr.rts_smoother(kf, p)
+    ll, cond_mean, _ = brute_force_gaussian(Y, p, mask=mask)
+    assert kf.loglik == pytest.approx(ll, rel=1e-10)
+    np.testing.assert_allclose(sm.x_sm, cond_mean, atol=1e-9)
+
+
+def test_full_mask_equals_dense(small_problem):
+    Y, _, p = small_problem
+    kf_d = cr.kalman_filter(Y, p)
+    kf_m = cr.kalman_filter(Y, p, mask=np.ones_like(Y))
+    assert kf_m.loglik == pytest.approx(kf_d.loglik, rel=1e-14)
+    np.testing.assert_allclose(kf_m.x_filt, kf_d.x_filt, atol=1e-14)
+
+
+def test_smoother_equals_filter_at_T(small_problem):
+    Y, _, p = small_problem
+    kf = cr.kalman_filter(Y, p)
+    sm = cr.rts_smoother(kf, p)
+    np.testing.assert_allclose(sm.x_sm[-1], kf.x_filt[-1], atol=1e-14)
+    np.testing.assert_allclose(sm.P_sm[-1], kf.P_filt[-1], atol=1e-14)
+
+
+def test_identity_model_reproduces_data():
+    # R -> 0, Lam = I, k = N: filtered state must equal the data.
+    rng = np.random.default_rng(2)
+    N = k = 3
+    p = cr.SSMParams(Lam=np.eye(N), A=0.5 * np.eye(k), Q=np.eye(k),
+                     R=1e-10 * np.ones(N), mu0=np.zeros(k), P0=np.eye(k))
+    Y, _ = dgp.simulate(p, T=10, rng=rng)
+    kf = cr.kalman_filter(Y, p)
+    np.testing.assert_allclose(kf.x_filt, Y, atol=1e-6)
+
+
+def test_filter_covariances_psd(small_problem):
+    Y, _, p = small_problem
+    kf = cr.kalman_filter(Y, p)
+    for P in kf.P_filt:
+        np.testing.assert_allclose(P, P.T, atol=1e-12)
+        assert np.linalg.eigvalsh(P).min() > -1e-12
+
+
+def test_em_monotone_loglik(small_problem):
+    Y, _, p_true = small_problem
+    rng = np.random.default_rng(3)
+    p0 = dgp.dfm_params(N=4, k=2, rng=rng)  # wrong params on purpose
+    _, lls = cr.em_fit(Y, p0, max_iters=30, tol=0.0)
+    assert np.all(np.diff(lls) >= -1e-8), f"EM loglik not monotone: {lls}"
+
+
+def test_em_monotone_loglik_masked():
+    rng = np.random.default_rng(4)
+    p_true = dgp.dfm_params(N=6, k=2, rng=rng)
+    Y, _ = dgp.simulate(p_true, T=40, rng=rng)
+    mask = dgp.random_mask(40, 6, rng, frac_missing=0.2)
+    p0 = dgp.dfm_params(N=6, k=2, rng=np.random.default_rng(5))
+    _, lls = cr.em_fit(Y, p0, mask=mask, max_iters=25, tol=0.0)
+    assert np.all(np.diff(lls) >= -1e-8), f"masked EM not monotone: {lls}"
+
+
+def test_em_static_monotone():
+    rng = np.random.default_rng(6)
+    p_true = dgp.dfm_params(N=10, k=2, rng=rng, static=True)
+    Y, _ = dgp.simulate(p_true, T=60, rng=rng)
+    p0 = cr.pca_init(Y, k=2, static=True)
+    _, lls = cr.em_fit(Y, p0, max_iters=20, tol=0.0,
+                       estimate_A=False, estimate_Q=False)
+    assert np.all(np.diff(lls) >= -1e-8)
+
+
+def test_recovery_pca_em():
+    # simulate -> estimate -> recover (SURVEY.md section 4.2.3): smoothed
+    # factors must span the truth (canonical correlation, rotation-invariant).
+    rng = np.random.default_rng(7)
+    p_true = dgp.dfm_params(N=30, k=2, rng=rng, noise_scale=0.3)
+    Y, F = dgp.simulate(p_true, T=150, rng=rng)
+    p0 = cr.pca_init(Y, k=2)
+    p_hat, lls = cr.em_fit(Y, p0, max_iters=30)
+    kf = cr.kalman_filter(Y, p_hat)
+    sm = cr.rts_smoother(kf, p_hat)
+    # Regression R^2 of each true factor on the estimated ones.
+    X = sm.x_sm - sm.x_sm.mean(0)
+    for j in range(2):
+        f = F[:, j] - F[:, j].mean()
+        beta = np.linalg.lstsq(X, f, rcond=None)[0]
+        r2 = 1 - np.sum((f - X @ beta) ** 2) / np.sum(f ** 2)
+        assert r2 > 0.95, f"factor {j} recovery R^2={r2}"
+    # EM must also improve on the PCA init.
+    assert lls[-1] >= lls[0]
+
+
+def test_pca_init_static_shapes():
+    rng = np.random.default_rng(8)
+    p_true = dgp.dfm_params(N=20, k=3, rng=rng)
+    Y, _ = dgp.simulate(p_true, T=50, rng=rng)
+    p = cr.pca_init(Y, k=3)
+    assert p.Lam.shape == (20, 3) and p.A.shape == (3, 3)
+    assert np.all(p.R > 0)
+    assert np.max(np.abs(np.linalg.eigvals(p.A))) < 1.0
+
+
+def test_forecast_shapes_and_decay():
+    rng = np.random.default_rng(9)
+    p = dgp.dfm_params(N=5, k=2, rng=rng, spectral_radius=0.5)
+    Y, _ = dgp.simulate(p, T=30, rng=rng)
+    kf = cr.kalman_filter(Y, p)
+    f, y, P = cr.forecast(p, kf.x_filt[-1], kf.P_filt[-1], horizon=20)
+    assert f.shape == (20, 2) and y.shape == (20, 5)
+    # Stable dynamics: long-horizon forecast decays toward zero mean.
+    assert np.linalg.norm(f[-1]) < np.linalg.norm(f[0]) + 1e-9
+
+
+def test_em_series_never_observed():
+    # A series with zero observed entries must not crash the masked M-step;
+    # its loading comes out zero.
+    rng = np.random.default_rng(10)
+    p_true = dgp.dfm_params(N=5, k=2, rng=rng)
+    Y, _ = dgp.simulate(p_true, T=30, rng=rng)
+    mask = np.ones((30, 5))
+    mask[:, 3] = 0.0
+    p_new, ll, _ = cr.em_step(Y, p_true, mask=mask)
+    assert np.isfinite(ll)
+    np.testing.assert_allclose(p_new.Lam[3], 0.0, atol=1e-12)
