@@ -1,0 +1,22 @@
+"""TPU-native dynamic-factor-model framework.
+
+A from-scratch JAX/XLA reimplementation of the capability surface of
+``joidegn/DynamicFactorModels.jl`` (see SURVEY.md): PCA + EM estimation of
+static/AR(1)/mixed-frequency/time-varying-loadings/stochastic-volatility
+dynamic factor models behind a ``fit(model, data, backend=...)`` dispatch
+seam, with a NumPy float64 reference backend and a TPU-first execution path
+(``lax.scan`` Kalman recursions, information-form sharded EM over a device
+mesh).
+"""
+
+from .api import (DynamicFactorModel, FitResult, fit, forecast,
+                  Backend, CPUBackend, TPUBackend,
+                  register_backend, get_backend)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DynamicFactorModel", "FitResult", "fit", "forecast",
+    "Backend", "CPUBackend", "TPUBackend",
+    "register_backend", "get_backend", "__version__",
+]

@@ -1,0 +1,255 @@
+"""Public API: ``DynamicFactorModel`` + ``fit(model, data, backend=...)``.
+
+The TPU-native mirror of the reference's user surface (SURVEY.md section 1.1):
+a model description object and a ``fit`` entry point with a backend-dispatch
+plugin seam (BASELINE.json:5 — ``fit(dfm; backend=...)``), where the dense
+CPU reference backend and the JAX/TPU backend are interchangeable and must
+agree in log-likelihood to 1e-5.
+
+Backends are looked up in a registry so external code can register new ones —
+the TPU analog of the reference's backend plugin hook:
+
+    fit(model, Y, backend="cpu")     # NumPy float64 golden path
+    fit(model, Y, backend="tpu")     # JAX path (TPU when available)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, Optional, Type, Union
+
+import numpy as np
+
+from .backends import cpu_ref
+from .utils.data import Standardizer, build_mask, standardize
+
+__all__ = [
+    "DynamicFactorModel", "FitResult", "fit", "forecast",
+    "Backend", "CPUBackend", "TPUBackend", "register_backend", "get_backend",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicFactorModel:
+    """Model description (what to estimate), independent of any backend.
+
+    dynamics: "static" (f_t iid N(0, I) — A = 0, Q = I fixed) or
+              "ar1" (factor VAR(1), A and Q estimated).
+    """
+
+    n_factors: int
+    dynamics: str = "ar1"
+    standardize: bool = True
+    estimate_init: bool = False
+
+    def __post_init__(self):
+        if self.dynamics not in ("static", "ar1"):
+            raise ValueError(f"unknown dynamics {self.dynamics!r}")
+        if self.n_factors < 1:
+            raise ValueError("n_factors must be >= 1")
+
+    @property
+    def estimate_A(self) -> bool:
+        return self.dynamics == "ar1"
+
+    @property
+    def estimate_Q(self) -> bool:
+        return self.dynamics == "ar1"
+
+
+@dataclasses.dataclass
+class FitResult:
+    """Everything a user needs after estimation (NumPy, de-jaxed)."""
+
+    params: cpu_ref.SSMParams          # in standardized units
+    logliks: np.ndarray                # per-iteration loglik at entry params
+    factors: np.ndarray                # (T, k) smoothed factor means
+    factor_cov: np.ndarray             # (T, k, k) smoothed covariances
+    converged: bool
+    n_iters: int
+    standardizer: Optional[Standardizer]
+    model: DynamicFactorModel
+    backend: str
+    history: list                      # per-iter dicts {iter, loglik, secs}
+
+    @property
+    def loglik(self) -> float:
+        return float(self.logliks[-1]) if len(self.logliks) else float("nan")
+
+
+class Backend:
+    """Backend interface: estimate params and smooth factors."""
+
+    name = "abstract"
+
+    def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
+        raise NotImplementedError
+
+    def smooth(self, Y, mask, params):
+        raise NotImplementedError
+
+
+class CPUBackend(Backend):
+    """NumPy float64 reference backend (the golden oracle)."""
+
+    name = "cpu"
+
+    def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
+        p, lls, converged = cpu_ref.em_fit(
+            Y, p0, mask=mask, max_iters=max_iters, tol=tol,
+            estimate_A=model.estimate_A, estimate_Q=model.estimate_Q,
+            estimate_init=model.estimate_init, callback=callback)
+        return p, np.asarray(lls), converged
+
+    def smooth(self, Y, mask, params):
+        kf = cpu_ref.kalman_filter(Y, params, mask=mask)
+        sm = cpu_ref.rts_smoother(kf, params)
+        return np.asarray(sm.x_sm), np.asarray(sm.P_sm)
+
+
+class TPUBackend(Backend):
+    """JAX backend: runs on TPU when present, any XLA device otherwise.
+
+    dtype: computation precision.  None means float32 on accelerators (the
+    TPU-native choice; MXU-friendly) and float64 on CPU when x64 is enabled.
+    """
+
+    name = "tpu"
+
+    def __init__(self, dtype=None):
+        self.dtype = dtype
+
+    def _dtype(self):
+        import jax
+        import jax.numpy as jnp
+        if self.dtype is not None:
+            return jnp.dtype(self.dtype)
+        if jax.config.jax_enable_x64 and jax.default_backend() == "cpu":
+            return jnp.dtype("float64")
+        return jnp.dtype("float32")
+
+    def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
+        import jax.numpy as jnp
+        from .estim.em import EMConfig, em_fit
+        from .ssm.params import SSMParams as JaxParams
+        dt = self._dtype()
+        Yj = jnp.asarray(Y, dt)
+        mj = jnp.asarray(mask, dt) if mask is not None else None
+        pj = JaxParams.from_numpy(p0, dtype=dt)
+        cfg = EMConfig(estimate_A=model.estimate_A,
+                       estimate_Q=model.estimate_Q,
+                       estimate_init=model.estimate_init)
+        p, lls, converged = em_fit(Yj, pj, mask=mj, cfg=cfg,
+                                   max_iters=max_iters, tol=tol,
+                                   callback=callback)
+        return p.to_numpy(), np.asarray(lls), converged
+
+    def smooth(self, Y, mask, params):
+        import jax.numpy as jnp
+        from .ssm.kalman import filter_smoother
+        from .ssm.params import SSMParams as JaxParams
+        dt = self._dtype()
+        Yj = jnp.asarray(Y, dt)
+        mj = jnp.asarray(mask, dt) if mask is not None else None
+        _, sm = filter_smoother(Yj, JaxParams.from_numpy(params, dtype=dt),
+                                mask=mj)
+        return np.asarray(sm.x_sm, np.float64), np.asarray(sm.P_sm, np.float64)
+
+
+_BACKENDS: Dict[str, Type[Backend]] = {}
+
+
+def register_backend(name: str, cls: Type[Backend]) -> None:
+    """Plugin hook: make ``fit(..., backend=name)`` resolve to ``cls``."""
+    _BACKENDS[name] = cls
+
+
+def get_backend(backend: Union[str, Backend, None]) -> Backend:
+    if backend is None:
+        backend = "tpu"
+    if isinstance(backend, Backend):
+        return backend
+    try:
+        return _BACKENDS[backend]()
+    except KeyError:
+        raise ValueError(
+            f"unknown backend {backend!r}; registered: {sorted(_BACKENDS)}")
+
+
+register_backend("cpu", CPUBackend)
+register_backend("tpu", TPUBackend)
+register_backend("jax", TPUBackend)
+
+
+def fit(model: DynamicFactorModel,
+        Y: np.ndarray,
+        mask: Optional[np.ndarray] = None,
+        backend: Union[str, Backend, None] = None,
+        max_iters: int = 50,
+        tol: float = 1e-6,
+        init: Optional[cpu_ref.SSMParams] = None,
+        callback: Optional[Callable] = None) -> FitResult:
+    """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
+
+    Y    : (T, N) panel; NaNs mark missing observations.
+    mask : optional explicit {0,1} mask, combined with the NaN pattern.
+    backend : "cpu", "tpu", a Backend instance, or a registered name.
+    """
+    Y = np.asarray(Y, dtype=np.float64)
+    if Y.ndim != 2:
+        raise ValueError(f"Y must be (T, N); got shape {Y.shape}")
+    T, N = Y.shape
+    if model.n_factors > min(T, N):
+        raise ValueError(f"n_factors={model.n_factors} exceeds min(T, N)={min(T, N)}")
+    if T < 2 and model.dynamics == "ar1":
+        raise ValueError("ar1 dynamics needs T >= 2 (the M-step divides by T-1)")
+
+    W = build_mask(Y, mask)
+    any_missing = bool((W == 0).any())
+    std: Optional[Standardizer] = None
+    if model.standardize:
+        Y, std = standardize(Y, mask=W if any_missing else None)
+    Wm = W if any_missing else None
+    Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
+
+    if init is None:
+        init = cpu_ref.pca_init(Yz, model.n_factors,
+                                static=(model.dynamics == "static"), mask=Wm)
+    b = get_backend(backend)
+
+    history: list = []
+    t_prev = time.perf_counter()
+
+    def _cb(it, ll, p):
+        nonlocal t_prev
+        now = time.perf_counter()
+        rec = {"iter": it, "loglik": float(ll), "secs": now - t_prev}
+        t_prev = now
+        history.append(rec)
+        if callback is not None:
+            callback(it, ll, p)
+
+    params, lls, converged = b.run_em(Yz, Wm, init, model, max_iters, tol, _cb)
+    x_sm, P_sm = b.smooth(Yz, Wm, params)
+    return FitResult(params=params, logliks=np.asarray(lls),
+                     factors=x_sm, factor_cov=P_sm,
+                     converged=bool(converged), n_iters=len(lls),
+                     standardizer=std, model=model, backend=b.name,
+                     history=history)
+
+
+def forecast(result: FitResult, horizon: int):
+    """h-step-ahead forecasts in ORIGINAL data units (de-standardized).
+
+    Returns (y_fore (h, N), f_fore (h, k)).  Reference behavior per SURVEY.md
+    section 3.2 (filter to T, iterate dynamics, map through loadings).
+    """
+    p = result.params
+    # Re-filter to the end of sample using smoothed factors' last state:
+    x_T = result.factors[-1]
+    P_T = result.factor_cov[-1]
+    f, y, _ = cpu_ref.forecast(p, x_T, P_T, horizon)
+    if result.standardizer is not None:
+        y = result.standardizer.inverse(y)
+    return y, f

@@ -256,7 +256,7 @@ def em_step(Y: np.ndarray, p: SSMParams,
         W = np.asarray(mask, dtype=np.float64)
         Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
         # Per-series masked normal equations, vectorized over i.
-        S_yf_i = np.einsum("ti,tk->ik", Yz * W, Ef)            # (N, k)
+        S_yf_i = np.einsum("ti,tk->ik", Yz, Ef)                # (N, k)
         S_ff_i = np.einsum("ti,tkl->ikl", W, EffT)             # (N, k, k)
         # A series with no observed entries has S_ff_i = 0; substitute the
         # identity so the batched solve stays nonsingular (its loading comes
@@ -300,12 +300,13 @@ def em_fit(Y: np.ndarray, p0: SSMParams,
            callback=None):
     """EM driver with relative-loglik convergence (SURVEY.md section 3.1).
 
-    Returns (params, logliks) where logliks[i] is the log-likelihood *at the
-    parameters entering iteration i* — monotone non-decreasing by the EM
-    invariant (SURVEY.md section 4.2.2a).
+    Returns (params, logliks, converged) where logliks[i] is the
+    log-likelihood *at the parameters entering iteration i* — monotone
+    non-decreasing by the EM invariant (SURVEY.md section 4.2.2a).
     """
     p = p0.copy()
     logliks = []
+    converged = False
     for it in range(max_iters):
         p_new, ll, _ = em_step(Y, p, mask=mask, estimate_A=estimate_A,
                                estimate_Q=estimate_Q,
@@ -313,13 +314,13 @@ def em_fit(Y: np.ndarray, p0: SSMParams,
         logliks.append(ll)
         if callback is not None:
             callback(it, ll, p)
+        p = p_new
         if it > 0:
             denom = max(abs(logliks[-2]), 1e-12)
             if (ll - logliks[-2]) / denom < tol:
-                p = p_new
+                converged = True
                 break
-        p = p_new
-    return p, np.array(logliks)
+    return p, np.array(logliks), converged
 
 
 def pca_init(Y: np.ndarray, k: int, static: bool = False,

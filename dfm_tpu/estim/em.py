@@ -1,0 +1,142 @@
+"""EM estimation for DFMs in JAX: jitted E+M step, Python-loop driver.
+
+Mirrors the CPU reference M-step exactly (same closed forms — see
+``cpu_ref.em_step``), with the E-step smoother from ``ssm.kalman``.  The
+convergence loop stays in Python (one jitted step per iteration) so the driver
+can log/checkpoint per iteration; a fully-fused ``lax.scan`` over iterations is
+provided for benchmarking where Python overhead would pollute timing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.linalg import sym, solve_psd
+from ..ssm.kalman import kalman_filter, rts_smoother
+from ..ssm.params import SSMParams, SmootherResult
+
+__all__ = ["EMConfig", "em_step", "em_fit", "em_fit_scan"]
+
+
+@dataclasses.dataclass(frozen=True)
+class EMConfig:
+    """Static EM switches (hashable -> usable as a jit static argument)."""
+    estimate_A: bool = True
+    estimate_Q: bool = True
+    estimate_init: bool = False
+    r_floor: float = 1e-6
+
+
+def _moments(sm: SmootherResult):
+    x, P, Pl = sm.x_sm, sm.P_sm, sm.P_lag
+    EffT = P + jnp.einsum("ti,tj->tij", x, x)
+    cross = Pl[1:] + jnp.einsum("ti,tj->tij", x[1:], x[:-1])
+    return EffT, cross
+
+
+def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig):
+    T = Y.shape[0]
+    dtype = Y.dtype
+    k = p.n_factors
+    EffT, cross = _moments(sm)
+    S_ff = EffT.sum(0)
+    S_ff_lag = EffT[:-1].sum(0)
+    S_ff_cur = EffT[1:].sum(0)
+    S_cross = cross.sum(0)
+    Ef = sm.x_sm
+
+    if mask is None:
+        S_yf = Y.T @ Ef                                       # (N, k)
+        Lam = solve_psd(S_ff, S_yf.T).T
+        R = (jnp.einsum("ti,ti->i", Y, Y)
+             - jnp.einsum("ik,ik->i", Lam, S_yf)) / T
+    else:
+        W = mask.astype(dtype)
+        Yz = jnp.where(W > 0, Y, 0.0)
+        S_yf_i = jnp.einsum("ti,tk->ik", Yz, Ef)              # (N, k)
+        S_ff_i = jnp.einsum("ti,tkl->ikl", W, EffT)           # (N, k, k)
+        never = (W.sum(0) == 0)[:, None, None]
+        S_ff_i = jnp.where(never, jnp.eye(k, dtype=dtype)[None], S_ff_i)
+        Lam = jax.vmap(solve_psd)(S_ff_i, S_yf_i)
+        counts = jnp.maximum(W.sum(0), 1.0)
+        resid_sq = jnp.einsum("ti,ti->i", W, (Yz - Ef @ Lam.T) ** 2)
+        PV = jnp.einsum("ti,tkl->ikl", W, sm.P_sm)
+        smear = jnp.einsum("ik,ikl,il->i", Lam, PV, Lam)
+        R = (resid_sq + smear) / counts
+    R = jnp.maximum(R, cfg.r_floor)
+
+    A, Q = p.A, p.Q
+    if cfg.estimate_A:
+        A = solve_psd(S_ff_lag, S_cross.T).T
+        if cfg.estimate_Q:
+            Q = sym((S_ff_cur - A @ S_cross.T) / (T - 1))
+    elif cfg.estimate_Q:
+        Q = sym((S_ff_cur - A @ S_cross.T - S_cross @ A.T
+                 + A @ S_ff_lag @ A.T) / (T - 1))
+    mu0, P0 = p.mu0, p.P0
+    if cfg.estimate_init:
+        mu0 = sm.x_sm[0]
+        P0 = sym(sm.P_sm[0])
+    return SSMParams(Lam, A, Q, R, mu0, P0)
+
+
+@partial(jax.jit, static_argnames=("cfg", "has_mask"))
+def _em_step_impl(Y, mask, p: SSMParams, cfg: EMConfig, has_mask: bool):
+    m = mask if has_mask else None
+    kf = kalman_filter(Y, p, mask=m)
+    sm = rts_smoother(kf, p)
+    p_new = _m_step(Y, m, sm, p, cfg)
+    return p_new, kf.loglik
+
+
+def em_step(Y, p: SSMParams, mask=None, cfg: EMConfig = EMConfig()):
+    """One EM iteration.  Returns (new_params, loglik at entry params)."""
+    return _em_step_impl(Y, mask, p, cfg, mask is not None)
+
+
+def em_fit(Y, p0: SSMParams, mask=None, cfg: EMConfig = EMConfig(),
+           max_iters: int = 50, tol: float = 1e-6, callback=None):
+    """EM driver with relative-loglik convergence.
+
+    Returns (params, loglik history, converged).  ``callback(it, loglik,
+    params)`` fires per iteration (logging/checkpoint hook — SURVEY.md
+    section 5 observability row).
+    """
+    p = p0
+    lls = []
+    converged = False
+    for it in range(max_iters):
+        p_new, ll = em_step(Y, p, mask=mask, cfg=cfg)
+        ll = float(ll)
+        lls.append(ll)
+        if callback is not None:
+            callback(it, ll, p)
+        p = p_new
+        if it > 0 and (ll - lls[-2]) / max(abs(lls[-2]), 1e-12) < tol:
+            converged = True
+            break
+    return p, jnp.asarray(lls), converged
+
+
+@partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
+def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
+    m = mask if has_mask else None
+
+    def body(p, _):
+        kf = kalman_filter(Y, p, mask=m)
+        sm = rts_smoother(kf, p)
+        return _m_step(Y, m, sm, p, cfg), kf.loglik
+
+    return jax.lax.scan(body, p0, None, length=n_iters)
+
+
+def em_fit_scan(Y, p0: SSMParams, n_iters: int, mask=None,
+                cfg: EMConfig = EMConfig()):
+    """Fixed-iteration EM fused into one XLA program (benchmark path:
+    BASELINE.json:2 'EM iters/sec' measured without host round-trips)."""
+    return _em_fit_scan_impl(Y, mask, p0, cfg, mask is not None, n_iters)

@@ -1,0 +1,53 @@
+"""PSD-safe linear-algebra primitives shared by the JAX state-space code.
+
+SURVEY.md section 7.2 item 1: float32 covariance recursions on TPU lose
+symmetry/PSD-ness quickly; everything here exists to keep them sane.
+Cholesky-only solves — no explicit inverses anywhere in the framework.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+__all__ = ["sym", "psd_cholesky", "chol_solve", "chol_logdet",
+           "solve_psd", "default_jitter"]
+
+
+def sym(M: jax.Array) -> jax.Array:
+    """Symmetrize the trailing two axes."""
+    return 0.5 * (M + jnp.swapaxes(M, -1, -2))
+
+
+def default_jitter(dtype) -> float:
+    """Diagonal jitter matched to precision: ~1e-10 in f64, ~1e-6 in f32."""
+    return 1e-10 if jnp.dtype(dtype) == jnp.float64 else 1e-6
+
+
+def psd_cholesky(M: jax.Array, jitter: float | None = None) -> jax.Array:
+    """Cholesky of a nominally-PSD matrix with symmetrization + jitter."""
+    k = M.shape[-1]
+    if jitter is None:
+        jitter = default_jitter(M.dtype)
+    return jnp.linalg.cholesky(sym(M) + jitter * jnp.eye(k, dtype=M.dtype))
+
+
+def chol_solve(L: jax.Array, B: jax.Array) -> jax.Array:
+    """Solve (L L') X = B given lower-triangular L.  B may be matrix or vector."""
+    vec = B.ndim == L.ndim - 1
+    if vec:
+        B = B[..., None]
+    X = solve_triangular(L, B, lower=True)
+    X = solve_triangular(L, X, lower=True, trans=1)
+    return X[..., 0] if vec else X
+
+
+def chol_logdet(L: jax.Array) -> jax.Array:
+    """log det(L L') from the Cholesky factor."""
+    return 2.0 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+
+
+def solve_psd(M: jax.Array, B: jax.Array, jitter: float | None = None) -> jax.Array:
+    """Solve M X = B for symmetric PSD M via Cholesky."""
+    return chol_solve(psd_cholesky(M, jitter), B)

@@ -1,0 +1,119 @@
+"""Dense-covariance Kalman filter as a ``lax.scan`` over time.
+
+The TPU-native realization of the recursions quoted in BASELINE.json:5
+(predict P' = A P A' + Q; update K = P Lam' S^{-1}); the cross-sectional
+scale-out (information form + sharding) lives in ``info_filter.py`` — this
+dense form is the small-N path and the oracle for it.
+
+Missing data with static shapes (critical under jit, SURVEY.md section 3.4):
+for mask w_t in {0,1}^N the masked model is rewritten as
+    Lam_t = diag(w_t) Lam,  y_t -> w_t * y_t,  R_t = w_t * R + (1 - w_t)
+so masked rows have zero loading, zero innovation, unit variance — they
+contribute 0 to the innovation quadratic and log|S|, reproducing the
+variable-dimension filter exactly without dynamic shapes (tested against the
+CPU reference which drops rows for real).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.linalg import sym, psd_cholesky, chol_solve, chol_logdet
+from .params import SSMParams, FilterResult, SmootherResult
+
+__all__ = ["kalman_filter", "rts_smoother", "filter_smoother"]
+
+_LOG2PI = 1.8378770664093453  # log(2*pi)
+
+
+def _masked_obs(y_t, mask_t, Lam, R):
+    """Apply the static-shape masking rewrite; no-op when mask_t is None."""
+    if mask_t is None:
+        return y_t, Lam, R
+    w = mask_t.astype(y_t.dtype)
+    # nan_to_num: masked entries may legitimately be NaN (the CPU oracle
+    # accepts that encoding); 0 * NaN would otherwise poison the update.
+    return w * jnp.nan_to_num(y_t), w[:, None] * Lam, w * R + (1.0 - w)
+
+
+def kalman_filter(Y: jax.Array, p: SSMParams,
+                  mask: Optional[jax.Array] = None) -> FilterResult:
+    """Forward filter with exact log-likelihood; O(T) scan of O(N^3) updates.
+
+    Y: (T, N); mask: optional (T, N) {0,1}.  Joseph-form covariance update.
+    """
+    dtype = Y.dtype
+    p = p.astype(dtype)
+    N, k = p.Lam.shape
+    I_k = jnp.eye(k, dtype=dtype)
+
+    def step(carry, inp):
+        x, P = carry                       # predicted state for this t
+        y_t, mask_t = inp
+        y_m, H, r = _masked_obs(y_t, mask_t, p.Lam, p.R)
+        v = y_m - H @ x
+        S = H @ P @ H.T + jnp.diag(r)
+        L = psd_cholesky(S)
+        Sinv_v = chol_solve(L, v)
+        K = chol_solve(L, H @ P).T         # (k, N)
+        x_f = x + K @ v
+        IKH = I_k - K @ H
+        P_f = sym(IKH @ P @ IKH.T + (K * r) @ K.T)
+        # Masked rows contribute log(1)=0 and v=0 automatically; but the
+        # constant n_t*log(2pi) must count only observed rows.
+        n_t = jnp.sum(mask_t.astype(dtype)) if mask_t is not None \
+            else jnp.asarray(float(N), dtype)
+        ll_t = -0.5 * (n_t * _LOG2PI + chol_logdet(L) + v @ Sinv_v)
+        x_n = p.A @ x_f
+        P_n = sym(p.A @ P_f @ p.A.T + p.Q)
+        return (x_n, P_n), (x, P, x_f, P_f, ll_t)
+
+    if mask is not None:
+        (xp, Pp, xf, Pf, lls) = lax.scan(
+            step, (p.mu0, p.P0), (Y, mask))[1]
+    else:
+        (xp, Pp, xf, Pf, lls) = lax.scan(
+            lambda c, y: step(c, (y, None)), (p.mu0, p.P0), Y)[1]
+    return FilterResult(xp, Pp, xf, Pf, jnp.sum(lls))
+
+
+def rts_smoother(kf: FilterResult, p: SSMParams) -> SmootherResult:
+    """Backward RTS pass; lag-one covariances via P_lag[t] = P_sm[t] J_{t-1}'.
+
+    Same identity as the CPU reference (verified there against a brute-force
+    joint-Gaussian oracle).
+    """
+    dtype = kf.x_filt.dtype
+    p = p.astype(dtype)
+    T, k = kf.x_filt.shape
+
+    # J_t = P_filt[t] A' P_pred[t+1]^{-1} for t = 0..T-2, batched up front.
+    Pp_next = kf.P_pred[1:]                                  # (T-1, k, k)
+    APf = jnp.einsum("ij,tjk->tik", p.A, kf.P_filt[:-1])     # A P_filt[t]
+    L = psd_cholesky(Pp_next)
+    J = jnp.swapaxes(jax.vmap(chol_solve)(L, APf), -1, -2)   # (T-1, k, k)
+
+    def step(carry, inp):
+        x_next, P_next = carry           # smoothed at t+1
+        x_f, P_f, x_p_next, P_p_next, J_t = inp
+        x_s = x_f + J_t @ (x_next - x_p_next)
+        P_s = sym(P_f + J_t @ (P_next - P_p_next) @ J_t.T)
+        return (x_s, P_s), (x_s, P_s)
+
+    init = (kf.x_filt[-1], kf.P_filt[-1])
+    inps = (kf.x_filt[:-1], kf.P_filt[:-1], kf.x_pred[1:], kf.P_pred[1:], J)
+    (_, _), (x_sm_rev, P_sm_rev) = lax.scan(step, init, inps, reverse=True)
+    x_sm = jnp.concatenate([x_sm_rev, kf.x_filt[-1:]], axis=0)
+    P_sm = jnp.concatenate([P_sm_rev, kf.P_filt[-1:]], axis=0)
+    P_lag_tail = jnp.einsum("tij,tkj->tik", P_sm[1:], J)     # P_sm[t] J_{t-1}'
+    P_lag = jnp.concatenate([jnp.zeros((1, k, k), dtype), P_lag_tail], axis=0)
+    return SmootherResult(x_sm, P_sm, P_lag)
+
+
+def filter_smoother(Y, p, mask=None):
+    kf = kalman_filter(Y, p, mask=mask)
+    return kf, rts_smoother(kf, p)

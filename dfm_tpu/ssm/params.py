@@ -1,0 +1,63 @@
+"""State-space parameter pytree shared by all JAX estimation code.
+
+The JAX mirror of ``dfm_tpu.backends.cpu_ref.SSMParams`` (BASELINE.json:5's
+AbstractStateSpaceModel parameter block): a NamedTuple so it is automatically a
+pytree — jit/vmap/shard_map transparent, no registration needed.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SSMParams(NamedTuple):
+    """y_t = Lam f_t + eps, eps ~ N(0, diag(R)); f_t = A f_{t-1} + eta ~ N(0,Q).
+
+    Lam: (N, k); A: (k, k); Q: (k, k); R: (N,) diagonal; mu0: (k,); P0: (k, k).
+    """
+
+    Lam: jax.Array
+    A: jax.Array
+    Q: jax.Array
+    R: jax.Array
+    mu0: jax.Array
+    P0: jax.Array
+
+    @property
+    def n_series(self) -> int:
+        return self.Lam.shape[0]
+
+    @property
+    def n_factors(self) -> int:
+        return self.Lam.shape[1]
+
+    def astype(self, dtype) -> "SSMParams":
+        return SSMParams(*(jnp.asarray(x, dtype) for x in self))
+
+    @classmethod
+    def from_numpy(cls, p, dtype=None) -> "SSMParams":
+        """From the CPU-reference dataclass (or anything with the same fields)."""
+        arrs = (p.Lam, p.A, p.Q, p.R, p.mu0, p.P0)
+        return cls(*(jnp.asarray(a, dtype) for a in arrs))
+
+    def to_numpy(self):
+        from ..backends.cpu_ref import SSMParams as NpParams
+        return NpParams(*(np.asarray(x, dtype=np.float64) for x in self))
+
+
+class FilterResult(NamedTuple):
+    x_pred: jax.Array   # (T, k)
+    P_pred: jax.Array   # (T, k, k)
+    x_filt: jax.Array   # (T, k)
+    P_filt: jax.Array   # (T, k, k)
+    loglik: jax.Array   # scalar
+
+
+class SmootherResult(NamedTuple):
+    x_sm: jax.Array     # (T, k)
+    P_sm: jax.Array     # (T, k, k)
+    P_lag: jax.Array    # (T, k, k); row 0 is zeros

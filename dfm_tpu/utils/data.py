@@ -1,0 +1,68 @@
+"""Panel data preparation (reference component R2, SURVEY.md section 2.1).
+
+Column standardization to mean 0 / variance 1 before factor extraction, with
+mask/NaN awareness, plus lag-matrix helpers for factor-augmented regressions.
+All NumPy: data prep happens once on host, the device path starts afterwards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Standardizer:
+    """Per-series affine transform y -> (y - mean) / scale and its inverse."""
+
+    mean: np.ndarray   # (N,)
+    scale: np.ndarray  # (N,)
+
+    def transform(self, Y: np.ndarray) -> np.ndarray:
+        return (Y - self.mean) / self.scale
+
+    def inverse(self, Z: np.ndarray) -> np.ndarray:
+        return Z * self.scale + self.mean
+
+
+def standardize(Y: np.ndarray, mask: Optional[np.ndarray] = None
+                ) -> Tuple[np.ndarray, Standardizer]:
+    """Standardize each series over its *observed* entries.
+
+    NaNs in ``Y`` are treated as missing regardless of ``mask``.  Returns the
+    standardized panel (missing entries left as NaN) and the transform.
+    """
+    Y = np.asarray(Y, dtype=np.float64)
+    obs = np.isfinite(Y)
+    if mask is not None:
+        obs &= np.asarray(mask) > 0
+    W = obs.astype(np.float64)
+    counts = np.maximum(W.sum(0), 1.0)
+    Yz = np.where(obs, Y, 0.0)
+    mean = Yz.sum(0) / counts
+    var = (W * (Yz - mean) ** 2).sum(0) / np.maximum(counts - 1.0, 1.0)
+    scale = np.sqrt(np.maximum(var, 1e-12))
+    Z = np.where(obs, (Y - mean) / scale, np.nan)
+    return Z, Standardizer(mean, scale)
+
+
+def build_mask(Y: np.ndarray, mask: Optional[np.ndarray] = None) -> np.ndarray:
+    """{0,1} observation mask from explicit mask and/or NaN pattern."""
+    obs = np.isfinite(np.asarray(Y, dtype=np.float64))
+    if mask is not None:
+        obs &= np.asarray(mask) > 0
+    return obs.astype(np.float64)
+
+
+def lag_matrix(x: np.ndarray, lags: int) -> np.ndarray:
+    """Stack [x_{t-1}, ..., x_{t-lags}] rows for t = lags..T-1.
+
+    x: (T,) or (T, d).  Returns (T - lags, lags * d)."""
+    x = np.asarray(x, dtype=np.float64)
+    if x.ndim == 1:
+        x = x[:, None]
+    T, d = x.shape
+    cols = [x[lags - j - 1:T - j - 1] for j in range(lags)]
+    return np.concatenate(cols, axis=1)

@@ -1,18 +1,28 @@
-"""Test configuration: force an 8-device fake CPU mesh before JAX imports.
+"""Test configuration: force an 8-device fake CPU mesh for all tests.
 
 SURVEY.md section 4.2.4: only one physical TPU exists in this environment, so
-distributed tests run on a virtual 8-device CPU mesh via
-``--xla_force_host_platform_device_count=8``.  These env vars must be set
-before the first ``import jax`` anywhere in the test process, hence this
-conftest (pytest imports it before collecting test modules).
+distributed tests run on a virtual 8-device CPU mesh.  Two wrinkles specific
+to this machine:
+
+- ``jax`` is already imported at interpreter startup (a sitecustomize hook
+  registers the ``axon`` TPU PJRT plugin), so setting ``JAX_PLATFORMS`` via
+  ``os.environ`` here is too late — we must go through ``jax.config.update``.
+- ``XLA_FLAGS`` is read by the XLA client at backend *creation*, which has not
+  happened yet at conftest time, so the env route still works for the device
+  count.
+
+Tests compare against float64 NumPy goldens, hence x64; float32/TPU behavior
+is covered by dedicated tolerance tests and the on-device bench.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-# Tests compare against float64 NumPy goldens; enable x64 on the CPU backend.
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,102 @@
+"""Backend-dispatch seam tests (SURVEY.md section 4.2.7 test_backends):
+cpu and tpu backends interchangeable behind fit(), loglik agreement to the
+BASELINE.json:5 bound, NaN handling, validation errors.
+"""
+
+import numpy as np
+import pytest
+
+import dfm_tpu
+from dfm_tpu import DynamicFactorModel, fit, forecast
+from dfm_tpu.utils import dgp
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(21)
+    p = dgp.dfm_params(N=20, k=2, rng=rng)
+    Y, F = dgp.simulate(p, T=60, rng=rng)
+    return Y
+
+
+def test_cpu_tpu_loglik_agree(panel):
+    m = DynamicFactorModel(n_factors=2)
+    r_cpu = fit(m, panel, backend="cpu", max_iters=10, tol=0.0)
+    r_tpu = fit(m, panel, backend="tpu", max_iters=10, tol=0.0)
+    # x64 on fake-CPU jax -> near-exact; the 1e-5 spec bound is generous here.
+    np.testing.assert_allclose(r_tpu.logliks, r_cpu.logliks, rtol=1e-7)
+    np.testing.assert_allclose(r_tpu.factors, r_cpu.factors, atol=1e-6)
+
+
+def test_static_model(panel):
+    m = DynamicFactorModel(n_factors=2, dynamics="static")
+    r = fit(m, panel, backend="tpu", max_iters=8, tol=0.0)
+    assert np.allclose(r.params.A, 0.0)
+    assert np.allclose(r.params.Q, np.eye(2))
+    assert np.all(np.diff(r.logliks) >= -1e-7)
+
+
+def test_nan_panel_auto_mask(panel):
+    Yn = panel.copy()
+    rng = np.random.default_rng(22)
+    miss = rng.random(Yn.shape) < 0.15
+    Yn[miss] = np.nan
+    m = DynamicFactorModel(n_factors=2)
+    r_cpu = fit(m, Yn, backend="cpu", max_iters=6, tol=0.0)
+    r_tpu = fit(m, Yn, backend="tpu", max_iters=6, tol=0.0)
+    assert np.isfinite(r_cpu.logliks).all()
+    np.testing.assert_allclose(r_tpu.logliks, r_cpu.logliks, rtol=1e-7)
+
+
+def test_monotone_loglik_through_api(panel):
+    m = DynamicFactorModel(n_factors=3)
+    r = fit(m, panel, backend="tpu", max_iters=15, tol=0.0)
+    assert np.all(np.diff(r.logliks) >= -1e-7)
+    assert r.n_iters == 15
+    assert len(r.history) == 15
+    assert all("secs" in h for h in r.history)
+
+
+def test_forecast_destandardized(panel):
+    m = DynamicFactorModel(n_factors=2)
+    r = fit(m, panel, backend="cpu", max_iters=5)
+    y, f = forecast(r, horizon=4)
+    assert y.shape == (4, panel.shape[1])
+    # De-standardized forecasts live on the data scale.
+    assert np.all(np.abs(y.mean(0) - panel.mean(0)) < 5 * panel.std(0))
+
+
+def test_validation_errors(panel):
+    with pytest.raises(ValueError, match="dynamics"):
+        DynamicFactorModel(n_factors=2, dynamics="arma")
+    with pytest.raises(ValueError, match="n_factors"):
+        DynamicFactorModel(n_factors=0)
+    with pytest.raises(ValueError, match="exceeds"):
+        fit(DynamicFactorModel(n_factors=200), panel)
+    with pytest.raises(ValueError, match="unknown backend"):
+        fit(DynamicFactorModel(n_factors=2), panel, backend="cuda")
+    with pytest.raises(ValueError, match="must be"):
+        fit(DynamicFactorModel(n_factors=2), panel[:, 0])
+
+
+def test_backend_registry_plugin():
+    from dfm_tpu.api import _BACKENDS
+
+    class MyBackend(dfm_tpu.CPUBackend):
+        name = "mine"
+
+    try:
+        dfm_tpu.register_backend("mine", MyBackend)
+        assert isinstance(dfm_tpu.get_backend("mine"), MyBackend)
+        # Instances pass through the seam untouched.
+        inst = MyBackend()
+        assert dfm_tpu.get_backend(inst) is inst
+    finally:
+        _BACKENDS.pop("mine", None)
+
+
+def test_convergence_flag(panel):
+    m = DynamicFactorModel(n_factors=2)
+    r = fit(m, panel, backend="cpu", max_iters=200, tol=1e-5)
+    assert r.converged
+    assert r.n_iters < 200

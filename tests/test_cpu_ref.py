@@ -143,7 +143,7 @@ def test_em_monotone_loglik(small_problem):
     Y, _, p_true = small_problem
     rng = np.random.default_rng(3)
     p0 = dgp.dfm_params(N=4, k=2, rng=rng)  # wrong params on purpose
-    _, lls = cr.em_fit(Y, p0, max_iters=30, tol=0.0)
+    _, lls, _ = cr.em_fit(Y, p0, max_iters=30, tol=0.0)
     assert np.all(np.diff(lls) >= -1e-8), f"EM loglik not monotone: {lls}"
 
 
@@ -153,7 +153,7 @@ def test_em_monotone_loglik_masked():
     Y, _ = dgp.simulate(p_true, T=40, rng=rng)
     mask = dgp.random_mask(40, 6, rng, frac_missing=0.2)
     p0 = dgp.dfm_params(N=6, k=2, rng=np.random.default_rng(5))
-    _, lls = cr.em_fit(Y, p0, mask=mask, max_iters=25, tol=0.0)
+    _, lls, _ = cr.em_fit(Y, p0, mask=mask, max_iters=25, tol=0.0)
     assert np.all(np.diff(lls) >= -1e-8), f"masked EM not monotone: {lls}"
 
 
@@ -162,7 +162,7 @@ def test_em_static_monotone():
     p_true = dgp.dfm_params(N=10, k=2, rng=rng, static=True)
     Y, _ = dgp.simulate(p_true, T=60, rng=rng)
     p0 = cr.pca_init(Y, k=2, static=True)
-    _, lls = cr.em_fit(Y, p0, max_iters=20, tol=0.0,
+    _, lls, _ = cr.em_fit(Y, p0, max_iters=20, tol=0.0,
                        estimate_A=False, estimate_Q=False)
     assert np.all(np.diff(lls) >= -1e-8)
 
@@ -174,7 +174,7 @@ def test_recovery_pca_em():
     p_true = dgp.dfm_params(N=30, k=2, rng=rng, noise_scale=0.3)
     Y, F = dgp.simulate(p_true, T=150, rng=rng)
     p0 = cr.pca_init(Y, k=2)
-    p_hat, lls = cr.em_fit(Y, p0, max_iters=30)
+    p_hat, lls, _ = cr.em_fit(Y, p0, max_iters=30)
     kf = cr.kalman_filter(Y, p_hat)
     sm = cr.rts_smoother(kf, p_hat)
     # Regression R^2 of each true factor on the estimated ones.
