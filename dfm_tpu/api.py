@@ -26,7 +26,8 @@ from .utils.data import Standardizer, build_mask, standardize
 
 __all__ = [
     "DynamicFactorModel", "FitResult", "fit", "forecast",
-    "Backend", "CPUBackend", "TPUBackend", "register_backend", "get_backend",
+    "Backend", "CPUBackend", "TPUBackend", "ShardedBackend",
+    "register_backend", "get_backend",
 ]
 
 
@@ -113,12 +114,37 @@ class TPUBackend(Backend):
 
     dtype: computation precision.  None means float32 on accelerators (the
     TPU-native choice; MXU-friendly) and float64 on CPU when x64 is enabled.
+
+    filter: "dense" (N x N innovation covariance), "info" (information form —
+    k x k scan, N enters only through matmul reductions; the scalable path),
+    or "auto" (info for N >= 32).  Both agree to fp tolerance (tested).
+
+    matmul_precision: XLA matmul precision.  TPU MXUs round f32 matmul inputs
+    to bf16 at the default setting, which costs ~1e-4 relative log-likelihood
+    (measured on config S1) — far outside the 1e-5 contract (BASELINE.json:5).
+    "highest" keeps true-f32 products (multi-pass bf16 on the MXU) and
+    measured 7e-7 relative; it is the default.  Set "default" to trade
+    accuracy for raw speed in benchmarks.
     """
 
     name = "tpu"
 
-    def __init__(self, dtype=None):
+    def __init__(self, dtype=None, filter: str = "auto",
+                 matmul_precision: str = "highest"):
         self.dtype = dtype
+        if filter not in ("auto", "dense", "info"):
+            raise ValueError(f"unknown filter {filter!r}")
+        self.filter = filter
+        self.matmul_precision = matmul_precision
+
+    def _filter_for(self, N: int) -> str:
+        if self.filter == "auto":
+            return "info" if N >= 32 else "dense"
+        return self.filter
+
+    def _precision_ctx(self):
+        import jax
+        return jax.default_matmul_precision(self.matmul_precision)
 
     def _dtype(self):
         import jax
@@ -139,22 +165,95 @@ class TPUBackend(Backend):
         pj = JaxParams.from_numpy(p0, dtype=dt)
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
-                       estimate_init=model.estimate_init)
-        p, lls, converged = em_fit(Yj, pj, mask=mj, cfg=cfg,
-                                   max_iters=max_iters, tol=tol,
-                                   callback=callback)
+                       estimate_init=model.estimate_init,
+                       filter=self._filter_for(Y.shape[1]))
+        with self._precision_ctx():
+            p, lls, converged = em_fit(Yj, pj, mask=mj, cfg=cfg,
+                                       max_iters=max_iters, tol=tol,
+                                       callback=callback)
         return p.to_numpy(), np.asarray(lls), converged
 
     def smooth(self, Y, mask, params):
         import jax.numpy as jnp
-        from .ssm.kalman import filter_smoother
+        from .ssm.kalman import kalman_filter, rts_smoother
+        from .ssm.info_filter import info_filter
         from .ssm.params import SSMParams as JaxParams
         dt = self._dtype()
         Yj = jnp.asarray(Y, dt)
         mj = jnp.asarray(mask, dt) if mask is not None else None
-        _, sm = filter_smoother(Yj, JaxParams.from_numpy(params, dtype=dt),
-                                mask=mj)
+        ff = {"dense": kalman_filter,
+              "info": info_filter}[self._filter_for(Y.shape[1])]
+        pj = JaxParams.from_numpy(params, dtype=dt)
+        with self._precision_ctx():
+            kf = ff(Yj, pj, mask=mj)
+            sm = rts_smoother(kf, pj)
         return np.asarray(sm.x_sm, np.float64), np.asarray(sm.P_sm, np.float64)
+
+
+class ShardedBackend(TPUBackend):
+    """Multi-device backend: series-sharded EM over a 1-D mesh.
+
+    ``shard_map`` + psum realization of BASELINE.json:5's distributed design
+    (see ``parallel.sharded``).  n_devices=None uses every local device; on a
+    single chip this degrades gracefully to a 1-shard mesh.
+    """
+
+    name = "sharded"
+
+    def __init__(self, dtype=None, n_devices=None,
+                 matmul_precision: str = "highest"):
+        super().__init__(dtype=dtype, filter="info",
+                         matmul_precision=matmul_precision)
+        self.n_devices = n_devices
+        self._drv = None          # ShardedEM from the last run_em
+        self._drv_params = None   # the numpy params it ended at
+
+    def _mesh(self):
+        from .parallel.mesh import make_mesh
+        return make_mesh(self.n_devices)
+
+    def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
+        from .estim.em import EMConfig
+        from .parallel.sharded import sharded_em_fit
+        cfg = EMConfig(estimate_A=model.estimate_A,
+                       estimate_Q=model.estimate_Q,
+                       estimate_init=model.estimate_init, filter="info")
+        with self._precision_ctx():
+            p, lls, converged, drv = sharded_em_fit(
+                Y, p0, mask=mask, mesh=self._mesh(), cfg=cfg,
+                max_iters=max_iters, tol=tol, dtype=self._dtype(),
+                callback=callback)
+        self._drv, self._drv_params = drv, p
+        return p, lls, converged
+
+    def smooth(self, Y, mask, params):
+        import jax.numpy as jnp
+        from .parallel.mesh import pad_panel
+        from .parallel.sharded import sharded_filter_smoother
+        from .ssm.params import SSMParams as JaxParams
+        # fit() calls smooth right after run_em with the params it returned;
+        # in that case the driver already holds the padded panel and params
+        # on device — reuse them instead of re-padding and re-transferring.
+        if self._drv is not None and params is self._drv_params:
+            with self._precision_ctx():
+                x_sm, P_sm, _ = self._drv.smooth()
+            return np.asarray(x_sm, np.float64), np.asarray(P_sm, np.float64)
+        dt = self._dtype()
+        mesh = self._mesh()
+        Yp, Wp, Lp, Rp, _ = pad_panel(
+            np.asarray(Y, np.float64), mask, np.asarray(params.Lam),
+            np.asarray(params.R), mesh.devices.size)
+        pj = JaxParams(Lam=jnp.asarray(Lp, dt),
+                       A=jnp.asarray(params.A, dt),
+                       Q=jnp.asarray(params.Q, dt),
+                       R=jnp.asarray(Rp, dt),
+                       mu0=jnp.asarray(params.mu0, dt),
+                       P0=jnp.asarray(params.P0, dt))
+        mj = jnp.asarray(Wp, dt) if Wp is not None else None
+        with self._precision_ctx():
+            x_sm, P_sm, _ = sharded_filter_smoother(
+                jnp.asarray(Yp, dt), pj, mask=mj, mesh=mesh)
+        return np.asarray(x_sm, np.float64), np.asarray(P_sm, np.float64)
 
 
 _BACKENDS: Dict[str, Type[Backend]] = {}
@@ -180,6 +279,7 @@ def get_backend(backend: Union[str, Backend, None]) -> Backend:
 register_backend("cpu", CPUBackend)
 register_backend("tpu", TPUBackend)
 register_backend("jax", TPUBackend)
+register_backend("sharded", ShardedBackend)
 
 
 def fit(model: DynamicFactorModel,

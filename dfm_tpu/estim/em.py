@@ -18,58 +18,82 @@ import jax.numpy as jnp
 
 from ..ops.linalg import sym, solve_psd
 from ..ssm.kalman import kalman_filter, rts_smoother
+from ..ssm.info_filter import info_filter
 from ..ssm.params import SSMParams, SmootherResult
 
-__all__ = ["EMConfig", "em_step", "em_fit", "em_fit_scan"]
+__all__ = ["EMConfig", "em_step", "em_fit", "em_fit_scan", "run_em_loop",
+           "moments", "mstep_rows", "mstep_dynamics"]
 
 
 @dataclasses.dataclass(frozen=True)
 class EMConfig:
-    """Static EM switches (hashable -> usable as a jit static argument)."""
+    """Static EM switches (hashable -> usable as a jit static argument).
+
+    filter: "dense" (N x N innovation covariance — small-N oracle path) or
+            "info" (information form, k x k scan — the N-scalable TPU path,
+            see ``ssm.info_filter``).
+    """
     estimate_A: bool = True
     estimate_Q: bool = True
     estimate_init: bool = False
     r_floor: float = 1e-6
+    filter: str = "dense"
+
+    def filter_fn(self):
+        return {"dense": kalman_filter, "info": info_filter}[self.filter]
 
 
-def _moments(sm: SmootherResult):
+def moments(sm: SmootherResult):
+    """Smoothed second moments: (EffT (T,k,k), cross (T-1,k,k)).
+
+    Compute ONCE per M-step and thread into ``mstep_rows`` /
+    ``mstep_dynamics`` — the (T,k,k) einsums are not free at scale.
+    """
     x, P, Pl = sm.x_sm, sm.P_sm, sm.P_lag
     EffT = P + jnp.einsum("ti,tj->tij", x, x)
     cross = Pl[1:] + jnp.einsum("ti,tj->tij", x[1:], x[:-1])
     return EffT, cross
 
 
-def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig):
+def mstep_rows(Y, mask, Ef, EffT, P_sm, S_ff, r_floor: float):
+    """Per-series M-step rows: new (Lam (n, k), R (n,)) for a series block.
+
+    ``Y`` is (T, n) — the full panel or one device's shard.  Each series' row
+    of Lam/R depends only on that series' own column of Y plus the replicated
+    smoother moments, so under sharding this runs locally with NO collective
+    (the psum lives in the E-step; SURVEY.md section 3.1 device boundary).
+    """
     T = Y.shape[0]
     dtype = Y.dtype
-    k = p.n_factors
-    EffT, cross = _moments(sm)
-    S_ff = EffT.sum(0)
-    S_ff_lag = EffT[:-1].sum(0)
-    S_ff_cur = EffT[1:].sum(0)
-    S_cross = cross.sum(0)
-    Ef = sm.x_sm
-
     if mask is None:
-        S_yf = Y.T @ Ef                                       # (N, k)
+        S_yf = Y.T @ Ef                                       # (n, k)
         Lam = solve_psd(S_ff, S_yf.T).T
         R = (jnp.einsum("ti,ti->i", Y, Y)
              - jnp.einsum("ik,ik->i", Lam, S_yf)) / T
     else:
+        k = S_ff.shape[0]
         W = mask.astype(dtype)
-        Yz = jnp.where(W > 0, Y, 0.0)
-        S_yf_i = jnp.einsum("ti,tk->ik", Yz, Ef)              # (N, k)
-        S_ff_i = jnp.einsum("ti,tkl->ikl", W, EffT)           # (N, k, k)
+        Yz = jnp.where(W > 0, jnp.nan_to_num(Y), 0.0)
+        S_yf_i = jnp.einsum("ti,tk->ik", Yz, Ef)              # (n, k)
+        S_ff_i = jnp.einsum("ti,tkl->ikl", W, EffT)           # (n, k, k)
         never = (W.sum(0) == 0)[:, None, None]
         S_ff_i = jnp.where(never, jnp.eye(k, dtype=dtype)[None], S_ff_i)
         Lam = jax.vmap(solve_psd)(S_ff_i, S_yf_i)
         counts = jnp.maximum(W.sum(0), 1.0)
         resid_sq = jnp.einsum("ti,ti->i", W, (Yz - Ef @ Lam.T) ** 2)
-        PV = jnp.einsum("ti,tkl->ikl", W, sm.P_sm)
+        PV = jnp.einsum("ti,tkl->ikl", W, P_sm)
         smear = jnp.einsum("ik,ikl,il->i", Lam, PV, Lam)
         R = (resid_sq + smear) / counts
-    R = jnp.maximum(R, cfg.r_floor)
+    return Lam, jnp.maximum(R, r_floor)
 
+
+def mstep_dynamics(sm: SmootherResult, EffT, cross, p: SSMParams,
+                   cfg: EMConfig):
+    """Replicated k x k M-step updates (A, Q, mu0, P0) from smoother moments."""
+    T = sm.x_sm.shape[0]
+    S_ff_lag = EffT[:-1].sum(0)
+    S_ff_cur = EffT[1:].sum(0)
+    S_cross = cross.sum(0)
     A, Q = p.A, p.Q
     if cfg.estimate_A:
         A = solve_psd(S_ff_lag, S_cross.T).T
@@ -82,13 +106,21 @@ def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig):
     if cfg.estimate_init:
         mu0 = sm.x_sm[0]
         P0 = sym(sm.P_sm[0])
+    return A, Q, mu0, P0
+
+
+def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig):
+    EffT, cross = moments(sm)
+    S_ff = EffT.sum(0)
+    Lam, R = mstep_rows(Y, mask, sm.x_sm, EffT, sm.P_sm, S_ff, cfg.r_floor)
+    A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p, cfg)
     return SSMParams(Lam, A, Q, R, mu0, P0)
 
 
 @partial(jax.jit, static_argnames=("cfg", "has_mask"))
 def _em_step_impl(Y, mask, p: SSMParams, cfg: EMConfig, has_mask: bool):
     m = mask if has_mask else None
-    kf = kalman_filter(Y, p, mask=m)
+    kf = cfg.filter_fn()(Y, p, mask=m)
     sm = rts_smoother(kf, p)
     p_new = _m_step(Y, m, sm, p, cfg)
     return p_new, kf.loglik
@@ -99,27 +131,51 @@ def em_step(Y, p: SSMParams, mask=None, cfg: EMConfig = EMConfig()):
     return _em_step_impl(Y, mask, p, cfg, mask is not None)
 
 
+def run_em_loop(step, max_iters: int, tol: float, callback=None):
+    """Shared EM convergence loop (used by single-device AND sharded drivers).
+
+    ``step(it) -> (loglik, params_for_callback)`` advances one iteration;
+    the loglik is at the ENTERING params, matching ``callback(it, ll, p)``.
+
+    Convergence: |relative change| < tol.  A loglik DROP larger than tol is
+    impossible for exact EM — it signals numerical trouble — so the loop
+    stops there too but reports ``converged=False`` rather than success.
+    """
+    lls = []
+    converged = False
+    for it in range(max_iters):
+        ll, cb_params = step(it)
+        ll = float(ll)
+        lls.append(ll)
+        if callback is not None:
+            callback(it, ll, cb_params)
+        if it > 0:
+            rel = (ll - lls[-2]) / max(abs(lls[-2]), 1e-12)
+            if abs(rel) < tol:
+                converged = True
+                break
+            if rel < 0:
+                break  # divergence guard
+    return lls, converged
+
+
 def em_fit(Y, p0: SSMParams, mask=None, cfg: EMConfig = EMConfig(),
            max_iters: int = 50, tol: float = 1e-6, callback=None):
     """EM driver with relative-loglik convergence.
 
     Returns (params, loglik history, converged).  ``callback(it, loglik,
-    params)`` fires per iteration (logging/checkpoint hook — SURVEY.md
-    section 5 observability row).
+    params)`` fires per iteration with the params the loglik was evaluated
+    at (logging/checkpoint hook — SURVEY.md section 5 observability row).
     """
     p = p0
-    lls = []
-    converged = False
-    for it in range(max_iters):
-        p_new, ll = em_step(Y, p, mask=mask, cfg=cfg)
-        ll = float(ll)
-        lls.append(ll)
-        if callback is not None:
-            callback(it, ll, p)
-        p = p_new
-        if it > 0 and (ll - lls[-2]) / max(abs(lls[-2]), 1e-12) < tol:
-            converged = True
-            break
+
+    def step(it):
+        nonlocal p
+        entering = p
+        p, ll = em_step(Y, entering, mask=mask, cfg=cfg)
+        return ll, entering
+
+    lls, converged = run_em_loop(step, max_iters, tol, callback)
     return p, jnp.asarray(lls), converged
 
 
@@ -128,7 +184,7 @@ def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
     m = mask if has_mask else None
 
     def body(p, _):
-        kf = kalman_filter(Y, p, mask=m)
+        kf = cfg.filter_fn()(Y, p, mask=m)
         sm = rts_smoother(kf, p)
         return _m_step(Y, m, sm, p, cfg), kf.loglik
 

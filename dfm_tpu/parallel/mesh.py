@@ -1,0 +1,66 @@
+"""Device-mesh construction and series-axis padding helpers.
+
+The framework's one distributed axis is the cross-section: the N series of the
+panel are sharded over a 1-D ``jax.sharding.Mesh`` axis named ``"series"``
+(SURVEY.md section 2.3).  Time stays sequential (scan) and the k-dim state is
+replicated, so a 1-D mesh is the whole topology — on real hardware it lays the
+series blocks across ICI neighbors and every collective is a single psum ring.
+
+Padding: shard_map needs N divisible by the mesh size.  Padded series are
+given zero loadings, unit variance, zero data and a zero mask row, so they
+contribute exactly nothing to any reduction (b, C, c2, n, ldR, M-step sums) —
+equivalence with the unpadded run is a unit test.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["SERIES_AXIS", "make_mesh", "pad_panel", "unpad_rows"]
+
+SERIES_AXIS = "series"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)} "
+                f"(hint: XLA_FLAGS=--xla_force_host_platform_device_count=K)")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (SERIES_AXIS,))
+
+
+def pad_panel(Y: np.ndarray, mask: Optional[np.ndarray], Lam: np.ndarray,
+              R: np.ndarray, n_shards: int
+              ) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray,
+                         np.ndarray, int]:
+    """Pad the series axis of (Y, mask, Lam, R) to a multiple of n_shards.
+
+    Returns (Y, mask, Lam, R, n_pad).  If padding is added and mask was None,
+    a mask is materialized (ones for real series, zeros for pads) so the
+    padded series drop out of every reduction.
+    """
+    T, N = Y.shape
+    n_pad = (-N) % n_shards
+    if n_pad == 0:
+        return Y, mask, Lam, R, 0
+    k = Lam.shape[1]
+    Yp = np.concatenate([Y, np.zeros((T, n_pad), Y.dtype)], axis=1)
+    if mask is None:
+        mask = np.ones((T, N), Y.dtype)
+    Wp = np.concatenate([mask, np.zeros((T, n_pad), mask.dtype)], axis=1)
+    Lp = np.concatenate([Lam, np.zeros((n_pad, k), Lam.dtype)], axis=0)
+    Rp = np.concatenate([R, np.ones(n_pad, R.dtype)], axis=0)
+    return Yp, Wp, Lp, Rp, n_pad
+
+
+def unpad_rows(x: np.ndarray, n_pad: int) -> np.ndarray:
+    """Drop trailing padded rows (series axis is axis 0 for Lam/R)."""
+    return x[: x.shape[0] - n_pad] if n_pad else x

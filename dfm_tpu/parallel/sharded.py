@@ -1,0 +1,195 @@
+"""Series-sharded EM: ``shard_map`` over the mesh, ``psum`` for the E-step.
+
+The distributed design of SURVEY.md sections 2.3/3.1 made concrete.  The panel
+``Y (T, N)``, loadings rows ``Lam (N, k)`` and noise diag ``R (N,)`` are
+sharded over the 1-D ``"series"`` mesh axis; ``A, Q, mu0, P0`` and the whole
+k-dimensional time recursion are replicated.  Per EM iteration the only
+communication is ONE psum of the k-sized observation statistics
+(``ssm.info_filter.ObsStats`` — b, C, c2, n, ldR), after which:
+
+  - every device runs the identical k x k filter + RTS scan (replicated);
+  - the M-step loading/noise rows are computed locally (each series' row
+    depends only on its own data column + replicated moments — no collective;
+    this is where BASELINE.json:5's "sufficient-statistic reductions as psum
+    collectives" lands: the reductions Lam' R^{-1} y_t etc. ARE the psum'd
+    ObsStats, and S_yf stays shard-local by construction);
+  - A, Q, mu0, P0 updates are recomputed identically everywhere.
+
+Per-step comm volume is O(k^2) regardless of N — the layout scales the
+cross-section purely through ICI-local einsums.
+
+Equivalence with the single-device path (same loglik sequence and params to fp
+tolerance) is asserted in ``tests/test_sharding.py`` on a fake 8-device CPU
+mesh (SURVEY.md section 4.2.4).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import dataclasses
+
+from ..estim.em import (EMConfig, moments, mstep_rows, mstep_dynamics,
+                        run_em_loop)
+from ..ssm.info_filter import (ObsStats, obs_stats, info_scan,
+                               loglik_terms_local, loglik_from_terms)
+from ..ssm.kalman import rts_smoother
+from ..ssm.params import SSMParams, FilterResult
+from .mesh import SERIES_AXIS, make_mesh, pad_panel, unpad_rows
+
+__all__ = ["sharded_em_step", "sharded_em_fit", "sharded_filter_smoother",
+           "ShardedEM"]
+
+
+def _psum_stats(stats: ObsStats) -> ObsStats:
+    return ObsStats(*(lax.psum(x, SERIES_AXIS) for x in stats))
+
+
+def _shard_filter_smoother(Y_s, mask_s, p_s: SSMParams):
+    """Per-device body: local stats -> psum -> replicated k x k scans.
+
+    The loglik quadratic is reduced in a second psum of the per-shard
+    residual terms (see info_filter module docstring's float32 note)."""
+    stats = _psum_stats(obs_stats(Y_s, p_s.Lam, p_s.R, mask=mask_s))
+    xp, Pp, xf, Pf, logdetG = info_scan(stats, p_s.A, p_s.Q, p_s.mu0, p_s.P0)
+    quad_R, U = loglik_terms_local(Y_s, p_s.Lam, p_s.R, xp, mask_s)
+    quad_R = lax.psum(quad_R, SERIES_AXIS)
+    U = lax.psum(U, SERIES_AXIS)
+    kf = FilterResult(xp, Pp, xf, Pf,
+                      loglik_from_terms(stats, logdetG, Pf, quad_R, U))
+    sm = rts_smoother(kf, p_s)
+    return kf, sm
+
+
+def _shard_em_step(Y_s, mask_s, p_s: SSMParams, cfg: EMConfig):
+    kf, sm = _shard_filter_smoother(Y_s, mask_s, p_s)
+    EffT, cross = moments(sm)
+    S_ff = EffT.sum(0)
+    Lam_s, R_s = mstep_rows(Y_s, mask_s, sm.x_sm, EffT, sm.P_sm, S_ff,
+                            cfg.r_floor)
+    A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p_s, cfg)
+    return SSMParams(Lam_s, A, Q, R_s, mu0, P0), kf.loglik
+
+
+def _param_specs():
+    return SSMParams(Lam=P(SERIES_AXIS, None), A=P(), Q=P(),
+                     R=P(SERIES_AXIS), mu0=P(), P0=P())
+
+
+@partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask"))
+def _sharded_em_step_impl(Y, mask, p: SSMParams, mesh: Mesh, cfg: EMConfig,
+                          has_mask: bool):
+    def body(Y_s, mask_s, p_s):
+        return _shard_em_step(Y_s, mask_s if has_mask else None, p_s, cfg)
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS), _param_specs()),
+        out_specs=(_param_specs(), P()),
+        check_vma=False)
+    if mask is None:
+        mask = jnp.ones_like(Y)  # placeholder; body ignores it when !has_mask
+    return mapped(Y, mask, p)
+
+
+@partial(jax.jit, static_argnames=("mesh", "has_mask"))
+def _sharded_smooth_impl(Y, mask, p: SSMParams, mesh: Mesh, has_mask: bool):
+    def body(Y_s, mask_s, p_s):
+        kf, sm = _shard_filter_smoother(Y_s, mask_s if has_mask else None, p_s)
+        return sm.x_sm, sm.P_sm, kf.loglik
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS), _param_specs()),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+    if mask is None:
+        mask = jnp.ones_like(Y)
+    return mapped(Y, mask, p)
+
+
+class ShardedEM:
+    """Driver wrapping padding + device placement + the jitted sharded step.
+
+    Holds the padded device arrays across iterations so the Python convergence
+    loop only moves the scalar loglik host-side each iteration.
+    """
+
+    def __init__(self, Y: np.ndarray, p0, mask: Optional[np.ndarray] = None,
+                 mesh: Optional[Mesh] = None, dtype=jnp.float32,
+                 cfg: EMConfig = EMConfig()):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n_shards = self.mesh.devices.size
+        Lam0 = np.asarray(p0.Lam)
+        R0 = np.asarray(p0.R)
+        Yp, Wp, Lp, Rp, self.n_pad = pad_panel(
+            np.asarray(Y, np.float64), mask, Lam0, R0, n_shards)
+        self.has_mask = Wp is not None
+        self.cfg = dataclasses.replace(cfg, filter="info")
+        self.Y = jnp.asarray(Yp, dtype)
+        self.mask = jnp.asarray(Wp, dtype) if self.has_mask else None
+        self.p = SSMParams(
+            Lam=jnp.asarray(Lp, dtype), A=jnp.asarray(p0.A, dtype),
+            Q=jnp.asarray(p0.Q, dtype), R=jnp.asarray(Rp, dtype),
+            mu0=jnp.asarray(p0.mu0, dtype), P0=jnp.asarray(p0.P0, dtype))
+
+    def step(self):
+        """One EM iteration; returns loglik at the entering params."""
+        self.p, ll = _sharded_em_step_impl(
+            self.Y, self.mask, self.p, self.mesh, self.cfg, self.has_mask)
+        return ll
+
+    def smooth(self):
+        x_sm, P_sm, ll = _sharded_smooth_impl(
+            self.Y, self.mask, self.p, self.mesh, self.has_mask)
+        return x_sm, P_sm, ll
+
+    def params_numpy(self, p: Optional[SSMParams] = None):
+        """Unpadded float64 copy of ``p`` (default: current params)."""
+        from ..backends.cpu_ref import SSMParams as NpParams
+        p = self.p if p is None else p
+        return NpParams(
+            Lam=unpad_rows(np.asarray(p.Lam, np.float64), self.n_pad),
+            A=np.asarray(p.A, np.float64), Q=np.asarray(p.Q, np.float64),
+            R=unpad_rows(np.asarray(p.R, np.float64), self.n_pad),
+            mu0=np.asarray(p.mu0, np.float64),
+            P0=np.asarray(p.P0, np.float64))
+
+
+def sharded_em_step(Y, p, mask=None, mesh=None, cfg: EMConfig = EMConfig()):
+    """Functional one-shot sharded EM step (shapes must already divide)."""
+    mesh = mesh if mesh is not None else make_mesh()
+    return _sharded_em_step_impl(Y, mask, p, mesh,
+                                 dataclasses.replace(cfg, filter="info"),
+                                 mask is not None)
+
+
+def sharded_filter_smoother(Y, p, mask=None, mesh=None):
+    mesh = mesh if mesh is not None else make_mesh()
+    return _sharded_smooth_impl(Y, mask, p, mesh, mask is not None)
+
+
+def sharded_em_fit(Y, p0, mask=None, mesh=None, cfg: EMConfig = EMConfig(),
+                   max_iters: int = 50, tol: float = 1e-6, dtype=jnp.float32,
+                   callback=None):
+    """EM driver over the mesh; mirrors ``estim.em.em_fit``'s contract,
+    including the callback receiving the (unpadded) params the loglik was
+    evaluated at.  Returns (params, logliks, converged, driver)."""
+    drv = ShardedEM(Y, p0, mask=mask, mesh=mesh, dtype=dtype, cfg=cfg)
+
+    def step(it):
+        entering = drv.p
+        ll = drv.step()
+        # Only materialize host params when someone is listening.
+        cb_params = drv.params_numpy(entering) if callback is not None else None
+        return ll, cb_params
+
+    lls, converged = run_em_loop(step, max_iters, tol, callback)
+    return drv.params_numpy(), np.asarray(lls), converged, drv

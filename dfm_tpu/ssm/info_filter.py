@@ -1,0 +1,177 @@
+"""Information-form Kalman filter: the N-scalable TPU path (SURVEY.md M2).
+
+The dense filter (``ssm.kalman``) forms the N x N innovation covariance
+S_t = Lam P Lam' + R every step — O(T N^3), infeasible at the 10k-series
+headline shape (BASELINE.json:2).  With diagonal R the update can be written so
+the cross-section enters ONLY through k-dimensional reductions
+(BASELINE.json:5 "psum collectives over sharded series"):
+
+    C_t = Lam' W_t R^{-1} Lam          (k, k)   precision added by the obs
+    b_t = Lam' W_t R^{-1} y_t          (k,)     information vector
+    n_t  = #observed at t              scalar   \ log-likelihood pieces
+    ldR_t = sum of log R over observed scalar   /
+
+All of these are einsums over the series axis — one big MXU matmul outside the
+time scan (static mask-free case: B = Y R^{-1} Lam is a single (T,N)x(N,k)
+product) or a batched one (masked case), and under sharding a local einsum
+followed by a psum.  The t-scan itself is pure k x k:
+
+    update   P_f = (P_p^{-1} + C_t)^{-1} = L (I + L' C_t L)^{-1} L',  P_p = LL'
+             x_f = x_p + P_f (b_t - C_t x_p)
+    loglik   log|S_t| = ldR_t + log|I + L' C_t L|      (matrix det lemma)
+             v' S^{-1} v = v' R^{-1} v - u' P_f u,  u = Lam' R^{-1} v (Woodbury)
+
+Float32 note (SURVEY.md section 7.2 item 1): the algebraically-equivalent form
+v' R^{-1} v = c2_t - 2 x_p.b_t + x_p' C_t x_p cancels catastrophically in f32
+(measured ~1e-3 relative loglik error vs the dense filter's ~6e-6 on the S1
+config).  The filter therefore computes the quadratic in a SECOND batched pass
+after the scan, from actual residuals V = Y - x_pred Lam' — one extra
+(T,N)x(N,k) MXU matmul, no large-term differencing.  Equivalence with the
+dense filter is a unit test; SURVEY.md section 7.2 item 2 flags the Woodbury
+loglik as the easy-to-get-wrong part.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.linalg import sym, psd_cholesky, chol_solve, chol_logdet
+from .params import SSMParams, FilterResult, SmootherResult
+from .kalman import rts_smoother
+
+__all__ = ["ObsStats", "obs_stats", "info_scan", "loglik_terms_local",
+           "loglik_from_terms", "info_filter_from_stats", "info_filter",
+           "info_filter_smoother"]
+
+_LOG2PI = 1.8378770664093453
+
+
+class ObsStats(NamedTuple):
+    """Per-step k-dimensional observation reductions (see module docstring).
+
+    C is (k, k) when the mask is absent (time-invariant precision) and
+    (T, k, k) when masked.  Everything here is psum-reducible over series
+    shards — this tuple IS the device-boundary payload of the sharded filter.
+    """
+
+    b: jax.Array     # (T, k)
+    C: jax.Array     # (k, k) or (T, k, k)
+    n: jax.Array     # (T,)
+    ldR: jax.Array   # (T,)
+
+
+def obs_stats(Y: jax.Array, Lam: jax.Array, R: jax.Array,
+              mask: Optional[jax.Array] = None) -> ObsStats:
+    """Reduce the panel to k-dimensional per-step statistics.
+
+    Y (T, N), Lam (N, k), R (N,); mask optional (T, N) {0,1}.  These einsums
+    are the only place N appears; under ``shard_map`` each shard computes them
+    on its local series block and psums (see ``parallel.sharded``).
+    """
+    dtype = Y.dtype
+    T, N = Y.shape
+    Rinv = 1.0 / R
+    logR = jnp.log(R)
+    if mask is None:
+        G = Lam * Rinv[:, None]                     # R^{-1} Lam, (N, k)
+        b = Y @ G                                   # (T, k): one big matmul
+        C = Lam.T @ G                               # (k, k)
+        n = jnp.full((T,), float(N), dtype)
+        ldR = jnp.full((T,), jnp.sum(logR), dtype)
+    else:
+        W = mask.astype(dtype)
+        Yw = W * jnp.nan_to_num(Y)                  # masked entries may be NaN
+        G = Lam * Rinv[:, None]
+        b = Yw @ G
+        C = jnp.einsum("nk,tn,n,nl->tkl", Lam, W, Rinv, Lam)
+        n = W.sum(axis=1)
+        ldR = W @ logR
+    return ObsStats(b, C, n, ldR)
+
+
+def info_scan(stats: ObsStats, A: jax.Array, Q: jax.Array,
+              mu0: jax.Array, P0: jax.Array):
+    """k x k time scan given precomputed observation stats (replicated under
+    sharding — every device runs this identically after the psum).
+
+    Returns (x_pred, P_pred, x_filt, P_filt, logdetG (T,)) where
+    logdetG_t = log|I + L' C_t L| is the low-rank part of log|S_t|.  The
+    innovation quadratic is NOT computed here — see ``loglik_terms_local``.
+    """
+    dtype = stats.b.dtype
+    k = A.shape[0]
+    I_k = jnp.eye(k, dtype=dtype)
+    static_C = stats.C.ndim == 2
+
+    def step(carry, inp):
+        x, P = carry
+        b_t, C_t = inp
+        Lp = psd_cholesky(P)
+        CL = C_t @ Lp                               # (k, k)
+        G = I_k + Lp.T @ CL                         # >= I: chol needs no jitter
+        Lg = psd_cholesky(G, jitter=0.0)
+        P_f = sym(Lp @ chol_solve(Lg, Lp.T))
+        u = b_t - C_t @ x
+        x_f = x + P_f @ u
+        x_n = A @ x_f
+        P_n = sym(A @ P_f @ A.T + Q)
+        return (x_n, P_n), (x, P, x_f, P_f, chol_logdet(Lg))
+
+    if static_C:
+        C_seq = jnp.broadcast_to(stats.C, (stats.b.shape[0], k, k))
+    else:
+        C_seq = stats.C
+    return lax.scan(step, (mu0, P0), (stats.b, C_seq))[1]
+
+
+def loglik_terms_local(Y: jax.Array, Lam: jax.Array, R: jax.Array,
+                       x_pred: jax.Array, mask: Optional[jax.Array]):
+    """Per-shard innovation-quadratic reductions, cancellation-free.
+
+    V = Y - x_pred Lam' (true residuals, one batched matmul);
+    returns (quad_R (T,) = v'R^{-1}v partial sums, U (T, k) = Lam'R^{-1}v
+    partial sums) — both psum-reducible over series shards.
+    """
+    V = Y - x_pred @ Lam.T
+    if mask is not None:
+        V = mask.astype(Y.dtype) * jnp.nan_to_num(V)
+    VR = V / R[None, :]
+    quad_R = jnp.einsum("tn,tn->t", V, VR)
+    U = VR @ Lam
+    return quad_R, U
+
+
+def loglik_from_terms(stats: ObsStats, logdetG, P_filt, quad_R, U):
+    """Assemble sum_t ll_t from global (psum'd) pieces."""
+    quad = quad_R - jnp.einsum("tk,tkl,tl->t", U, P_filt, U)
+    lls = -0.5 * (stats.n * _LOG2PI + stats.ldR + logdetG + quad)
+    return jnp.sum(lls)
+
+
+def info_filter_from_stats(stats: ObsStats, A, Q, mu0, P0, Y=None, Lam=None,
+                           R=None, mask=None) -> FilterResult:
+    """Scan + loglik in one call (single-device; Y/Lam/R for the residual
+    pass).  Sharded callers instead compose info_scan + loglik_terms_local +
+    psum + loglik_from_terms (see ``parallel.sharded``)."""
+    xp, Pp, xf, Pf, logdetG = info_scan(stats, A, Q, mu0, P0)
+    quad_R, U = loglik_terms_local(Y, Lam, R, xp, mask)
+    ll = loglik_from_terms(stats, logdetG, Pf, quad_R, U)
+    return FilterResult(xp, Pp, xf, Pf, ll)
+
+
+def info_filter(Y: jax.Array, p: SSMParams,
+                mask: Optional[jax.Array] = None) -> FilterResult:
+    """Single-call info-form filter: stats + scan + residual loglik pass."""
+    p = p.astype(Y.dtype)
+    stats = obs_stats(Y, p.Lam, p.R, mask=mask)
+    return info_filter_from_stats(stats, p.A, p.Q, p.mu0, p.P0,
+                                  Y=Y, Lam=p.Lam, R=p.R, mask=mask)
+
+
+def info_filter_smoother(Y, p, mask=None):
+    kf = info_filter(Y, p, mask=mask)
+    return kf, rts_smoother(kf, p)
