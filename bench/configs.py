@@ -1,0 +1,57 @@
+"""The five benchmark configs of BASELINE.json:6-12 as named presets.
+
+SURVEY.md section 5 (config/flag system row) prescribes these be checked in;
+``bench.run`` and the root-level ``bench.py`` harness consume them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchConfig:
+    name: str
+    description: str
+    N: int                      # series
+    T: int                      # time steps
+    k: int                      # factors
+    dynamics: str = "ar1"       # model.dynamics
+    em_iters: int = 20
+    kind: str = "plain"         # plain | missing | mixed_freq | tvl | sv
+    frac_missing: float = 0.0
+    n_quarterly: int = 0
+    seed: int = 0
+
+
+CONFIGS = {
+    # BASELINE.json:7 — the CPU-reference config.
+    "s1": BenchConfig("s1", "2-factor static DFM, 50x200, PCA init + 20 EM "
+                            "iters (CPU ref)",
+                      N=50, T=200, k=2, dynamics="static", em_iters=20),
+    # BASELINE.json:8
+    "s2": BenchConfig("s2", "10-factor AR(1) DFM, 1000x500",
+                      N=1000, T=500, k=10, em_iters=20),
+    # BASELINE.json:9
+    "s3": BenchConfig("s3", "Mixed-frequency nowcasting DFM, 2000 series, "
+                            "missing obs",
+                      N=2000, T=300, k=5, em_iters=10, kind="mixed_freq",
+                      frac_missing=0.1, n_quarterly=400),
+    # BASELINE.json:10
+    "s4": BenchConfig("s4", "Time-varying-loadings DFM, 5000 series",
+                      N=5000, T=300, k=4, em_iters=5, kind="tvl"),
+    # BASELINE.json:11
+    "s5": BenchConfig("s5", "SV-DFM via particle Kalman filter, 10000x1000",
+                      N=10000, T=1000, k=5, em_iters=1, kind="sv"),
+    # BASELINE.json:2 — the headline metric shape.
+    "headline": BenchConfig("headline", "EM iters/sec, 10000x500, 10 factors",
+                            N=10000, T=500, k=10, em_iters=10),
+}
+
+
+def get(name: str) -> BenchConfig:
+    try:
+        return CONFIGS[name]
+    except KeyError:
+        raise SystemExit(f"unknown config {name!r}; have {sorted(CONFIGS)}")

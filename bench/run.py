@@ -1,0 +1,93 @@
+"""Benchmark/CLI runner: ``python -m bench.run --config s1 --backend tpu``.
+
+The minimum-slice command of SURVEY.md section 7.3: simulate the named config,
+fit with the chosen backend, print per-iteration loglik/timing records (JSONL,
+the observability sink of SURVEY.md section 5) and a one-line JSON summary
+with the BASELINE.json:2 metrics (EM iters/sec, loglik evals/sec).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from dfm_tpu.api import DynamicFactorModel, fit
+from dfm_tpu.utils import dgp
+from .configs import get
+
+
+def make_data(cfg):
+    rng = np.random.default_rng(cfg.seed)
+    if cfg.kind in ("plain", "missing", "mixed_freq"):
+        p_true = dgp.dfm_params(cfg.N, cfg.k, rng,
+                                static=(cfg.dynamics == "static"))
+        Y, F = dgp.simulate(p_true, cfg.T, rng)
+        mask = None
+        if cfg.kind == "missing" or cfg.frac_missing > 0:
+            mask = dgp.random_mask(cfg.T, cfg.N, rng, cfg.frac_missing)
+        if cfg.kind == "mixed_freq":
+            mf = dgp.mixed_freq_mask(cfg.T, cfg.N, cfg.n_quarterly)
+            mask = mf if mask is None else mask * mf
+        return Y, mask, F
+    if cfg.kind == "tvl":
+        Y, F, _, _, _ = dgp.simulate_tv_loadings(cfg.N, cfg.T, cfg.k, rng)
+        return Y, None, F
+    if cfg.kind == "sv":
+        Y, F, _, _ = dgp.simulate_sv(cfg.N, cfg.T, cfg.k, rng)
+        return Y, None, F
+    raise SystemExit(f"config kind {cfg.kind!r} not yet runnable")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", default="s1")
+    ap.add_argument("--backend", default="tpu")
+    ap.add_argument("--iters", type=int, default=None,
+                    help="override the config's EM iteration count")
+    ap.add_argument("--tol", type=float, default=0.0,
+                    help="EM convergence tol (0 = run all iters)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress per-iteration JSONL records")
+    args = ap.parse_args(argv)
+
+    cfg = get(args.config)
+    Y, mask, _ = make_data(cfg)
+    model = DynamicFactorModel(n_factors=cfg.k, dynamics=cfg.dynamics)
+    iters = args.iters if args.iters is not None else cfg.em_iters
+
+    records = []
+
+    def cb(it, ll, p):
+        rec = {"iter": it, "loglik": float(ll)}
+        records.append(rec)
+        if not args.quiet:
+            print(json.dumps(rec), file=sys.stderr)
+
+    t0 = time.perf_counter()
+    res = fit(model, Y, mask=mask, backend=args.backend, max_iters=iters,
+              tol=args.tol, callback=cb)
+    wall = time.perf_counter() - t0
+    # Per-iteration seconds from the fit history (first iter includes compile).
+    secs = [h["secs"] for h in res.history]
+    steady = secs[1:] if len(secs) > 1 else secs
+    summary = {
+        "config": cfg.name,
+        "backend": res.backend,
+        "N": cfg.N, "T": cfg.T, "k": cfg.k,
+        "n_iters": res.n_iters,
+        "converged": res.converged,
+        "loglik": res.loglik,
+        "wall_secs": wall,
+        "em_iters_per_sec": (len(steady) / sum(steady)) if steady else None,
+        "first_iter_secs": secs[0] if secs else None,
+    }
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,7 @@ __all__ = [
     "KalmanResult",
     "SmootherResult",
     "kalman_filter",
+    "kalman_filter_info",
     "rts_smoother",
     "em_step",
     "em_fit",
@@ -163,6 +164,80 @@ def kalman_filter(Y: np.ndarray, p: SSMParams,
     return KalmanResult(x_pred, P_pred, x_filt, P_filt, float(loglik))
 
 
+def kalman_filter_info(Y: np.ndarray, p: SSMParams,
+                       mask: Optional[np.ndarray] = None) -> KalmanResult:
+    """Information-form filter: k x k recursion, N only in matmul reductions.
+
+    NumPy mirror of ``dfm_tpu.ssm.info_filter`` (same algebra: Cholesky of
+    I + L'CL, determinant-lemma logdet, residual-pass Woodbury quadratic).
+    This is the honest single-threaded CPU baseline at the 10k-series
+    headline shape (BASELINE.json:2) where the dense O(N^3)-per-step filter
+    is infeasible, and the at-scale golden for the TPU info path.
+    Requires diagonal R (always true in this framework).
+    """
+    Y = np.asarray(Y, dtype=np.float64)
+    T, N = Y.shape
+    k = p.n_factors
+    Lam, A, Q, R = (np.asarray(p.Lam, np.float64), np.asarray(p.A, np.float64),
+                    np.asarray(p.Q, np.float64), np.asarray(p.R, np.float64))
+    Rinv = 1.0 / R
+    logR = np.log(R)
+    G = Lam * Rinv[:, None]                       # R^{-1} Lam
+    if mask is None:
+        B = Y @ G                                 # (T, k)
+        C_static = Lam.T @ G
+        n_t_all = np.full(T, float(N))
+        ldR_all = np.full(T, logR.sum())
+    else:
+        W = np.asarray(mask, dtype=np.float64)
+        Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
+        Y = Yz
+        B = Yz @ G
+        n_t_all = W.sum(axis=1)
+        ldR_all = W @ logR
+
+    I_k = np.eye(k)
+    x_pred = np.zeros((T, k))
+    P_pred = np.zeros((T, k, k))
+    x_filt = np.zeros((T, k))
+    P_filt = np.zeros((T, k, k))
+    logdetG = np.zeros(T)
+    x, P = np.asarray(p.mu0, np.float64), np.asarray(p.P0, np.float64)
+    for t in range(T):
+        if t > 0:
+            x = A @ x_filt[t - 1]
+            P = _sym(A @ P_filt[t - 1] @ A.T + Q)
+        x_pred[t] = x
+        P_pred[t] = P
+        if mask is None:
+            C = C_static
+        else:
+            C = (Lam * (W[t] * Rinv)[:, None]).T @ Lam
+        Lp = np.linalg.cholesky(P + 1e-12 * I_k)
+        Gm = I_k + Lp.T @ C @ Lp
+        Lg = np.linalg.cholesky(Gm)
+        Pf = Lp @ np.linalg.solve(Lg.T, np.linalg.solve(Lg, Lp.T))
+        Pf = _sym(Pf)
+        u = B[t] - C @ x
+        x = x + Pf @ u
+        P = Pf
+        x_filt[t] = x
+        P_filt[t] = P
+        logdetG[t] = 2.0 * np.sum(np.log(np.diag(Lg)))
+    # Residual-pass quadratic (cancellation-free; matches the JAX path).
+    V = Y - x_pred @ Lam.T
+    if mask is not None:
+        V = W * V
+    VR = V * Rinv[None, :]
+    quad_R = np.einsum("tn,tn->t", V, VR)
+    U = VR @ Lam
+    quad = quad_R - np.einsum("tk,tkl,tl->t", U, P_filt, U)
+    log2pi = np.log(2.0 * np.pi)
+    loglik = float(np.sum(-0.5 * (n_t_all * log2pi + ldR_all + logdetG
+                                  + quad)))
+    return KalmanResult(x_pred, P_pred, x_filt, P_filt, loglik)
+
+
 def rts_smoother(kf: KalmanResult, p: SSMParams) -> SmootherResult:
     """Rauch-Tung-Striebel backward smoother with lag-one covariances.
 
@@ -225,7 +300,8 @@ def em_step(Y: np.ndarray, p: SSMParams,
             estimate_A: bool = True,
             estimate_Q: bool = True,
             estimate_init: bool = False,
-            r_floor: float = 1e-6):
+            r_floor: float = 1e-6,
+            filter: str = "dense"):
     """One EM iteration: E-step (filter+smoother) then closed-form M-step.
 
     Returns (new_params, loglik_of_old_params, smoother_result).
@@ -242,7 +318,8 @@ def em_step(Y: np.ndarray, p: SSMParams,
     """
     Y = np.asarray(Y, dtype=np.float64)
     T, N = Y.shape
-    kf = kalman_filter(Y, p, mask=mask)
+    ff = {"dense": kalman_filter, "info": kalman_filter_info}[filter]
+    kf = ff(Y, p, mask=mask)
     sm = rts_smoother(kf, p)
     mom = smoothed_moments(sm)
     Ef, EffT = mom["Ef"], mom["EffT"]
@@ -297,7 +374,7 @@ def em_fit(Y: np.ndarray, p0: SSMParams,
            max_iters: int = 50, tol: float = 1e-6,
            estimate_A: bool = True, estimate_Q: bool = True,
            estimate_init: bool = False,
-           callback=None):
+           callback=None, filter: str = "dense"):
     """EM driver with relative-loglik convergence (SURVEY.md section 3.1).
 
     Returns (params, logliks, converged) where logliks[i] is the
@@ -310,7 +387,7 @@ def em_fit(Y: np.ndarray, p0: SSMParams,
     for it in range(max_iters):
         p_new, ll, _ = em_step(Y, p, mask=mask, estimate_A=estimate_A,
                                estimate_Q=estimate_Q,
-                               estimate_init=estimate_init)
+                               estimate_init=estimate_init, filter=filter)
         logliks.append(ll)
         if callback is not None:
             callback(it, ll, p)

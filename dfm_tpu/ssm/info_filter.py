@@ -8,8 +8,8 @@ the cross-section enters ONLY through k-dimensional reductions
 
     C_t = Lam' W_t R^{-1} Lam          (k, k)   precision added by the obs
     b_t = Lam' W_t R^{-1} y_t          (k,)     information vector
-    n_t  = #observed at t              scalar   \ log-likelihood pieces
-    ldR_t = sum of log R over observed scalar   /
+    n_t  = #observed at t              scalar   | log-likelihood pieces
+    ldR_t = sum of log R over observed scalar   | (with logdet below)
 
 All of these are einsums over the series axis — one big MXU matmul outside the
 time scan (static mask-free case: B = Y R^{-1} Lam is a single (T,N)x(N,k)

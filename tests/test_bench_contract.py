@@ -1,0 +1,69 @@
+"""Driver-contract and bench-harness surface tests.
+
+Covers: the CPU info-form filter golden (the bench baseline algorithm), the
+bench config presets (BASELINE.json:6-12), and the __graft_entry__ contract
+(single-chip jittable entry + multi-chip dry run on the fake CPU mesh).
+"""
+
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.utils import dgp
+
+
+def test_cpu_info_filter_matches_dense():
+    rng = np.random.default_rng(11)
+    p = dgp.dfm_params(29, 3, rng)
+    Y, _ = dgp.simulate(p, 50, rng)
+    kf_d = cpu_ref.kalman_filter(Y, p)
+    kf_i = cpu_ref.kalman_filter_info(Y, p)
+    assert abs(kf_d.loglik - kf_i.loglik) < 1e-8 * abs(kf_d.loglik)
+    np.testing.assert_allclose(kf_i.x_filt, kf_d.x_filt, atol=1e-8)
+    np.testing.assert_allclose(kf_i.P_filt, kf_d.P_filt, atol=1e-8)
+
+
+def test_cpu_info_filter_matches_dense_masked():
+    rng = np.random.default_rng(12)
+    p = dgp.dfm_params(29, 3, rng)
+    Y, _ = dgp.simulate(p, 50, rng)
+    W = dgp.random_mask(50, 29, rng, 0.3)
+    W[7] = 0.0
+    kf_d = cpu_ref.kalman_filter(Y, p, mask=W)
+    kf_i = cpu_ref.kalman_filter_info(Y, p, mask=W)
+    assert abs(kf_d.loglik - kf_i.loglik) < 1e-8 * abs(kf_d.loglik)
+    np.testing.assert_allclose(kf_i.x_filt, kf_d.x_filt, atol=1e-8)
+
+
+def test_cpu_em_step_info_matches_dense():
+    rng = np.random.default_rng(13)
+    p = dgp.dfm_params(40, 2, rng)
+    Y, _ = dgp.simulate(p, 60, rng)
+    p0 = cpu_ref.pca_init(Y, 2)
+    pd_, lld, _ = cpu_ref.em_step(Y, p0, filter="dense")
+    pi_, lli, _ = cpu_ref.em_step(Y, p0, filter="info")
+    assert abs(lld - lli) < 1e-8 * abs(lld)
+    np.testing.assert_allclose(pi_.Lam, pd_.Lam, atol=1e-8)
+    np.testing.assert_allclose(pi_.A, pd_.A, atol=1e-8)
+
+
+def test_bench_configs_cover_baseline():
+    from bench.configs import CONFIGS
+    assert set(CONFIGS) >= {"s1", "s2", "s3", "s4", "s5", "headline"}
+    s1 = CONFIGS["s1"]
+    assert (s1.N, s1.T, s1.k, s1.dynamics) == (50, 200, 2, "static")
+    h = CONFIGS["headline"]
+    assert (h.N, h.T, h.k) == (10_000, 500, 10)
+
+
+def test_graft_entry_contract():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as ge
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    ll = float(out[0])
+    assert np.isfinite(ll)
+    ge.dryrun_multichip(min(jax.device_count(), 8))
