@@ -22,16 +22,21 @@ from .configs import get
 
 def make_data(cfg):
     rng = np.random.default_rng(cfg.seed)
-    if cfg.kind in ("plain", "missing", "mixed_freq"):
+    if cfg.kind in ("plain", "missing"):
         p_true = dgp.dfm_params(cfg.N, cfg.k, rng,
                                 static=(cfg.dynamics == "static"))
         Y, F = dgp.simulate(p_true, cfg.T, rng)
         mask = None
         if cfg.kind == "missing" or cfg.frac_missing > 0:
             mask = dgp.random_mask(cfg.T, cfg.N, rng, cfg.frac_missing)
-        if cfg.kind == "mixed_freq":
-            mf = dgp.mixed_freq_mask(cfg.T, cfg.N, cfg.n_quarterly)
-            mask = mf if mask is None else mask * mf
+        return Y, mask, F
+    if cfg.kind == "mixed_freq":
+        Y, mask, F, _ = dgp.simulate_mixed_freq(
+            cfg.N - cfg.n_quarterly, cfg.n_quarterly, cfg.T, cfg.k, rng)
+        if cfg.frac_missing > 0:
+            ragged = dgp.random_mask(cfg.T, cfg.N, rng, cfg.frac_missing)
+            mask = mask * ragged
+            Y = np.where(mask > 0, Y, np.nan)
         return Y, mask, F
     if cfg.kind == "tvl":
         Y, F, _, _, _ = dgp.simulate_tv_loadings(cfg.N, cfg.T, cfg.k, rng)
@@ -56,29 +61,42 @@ def main(argv=None):
 
     cfg = get(args.config)
     Y, mask, _ = make_data(cfg)
-    model = DynamicFactorModel(n_factors=cfg.k, dynamics=cfg.dynamics)
     iters = args.iters if args.iters is not None else cfg.em_iters
 
     records = []
+    t_prev = time.perf_counter()
 
     def cb(it, ll, p):
-        rec = {"iter": it, "loglik": float(ll)}
+        nonlocal t_prev
+        now = time.perf_counter()
+        rec = {"iter": it, "loglik": float(ll), "secs": now - t_prev}
+        t_prev = now
         records.append(rec)
         if not args.quiet:
             print(json.dumps(rec), file=sys.stderr)
 
     t0 = time.perf_counter()
-    res = fit(model, Y, mask=mask, backend=args.backend, max_iters=iters,
-              tol=args.tol, callback=cb)
+    if cfg.kind == "mixed_freq":
+        from dfm_tpu.models.mixed_freq import MixedFreqSpec, mf_fit
+        spec = MixedFreqSpec(n_monthly=cfg.N - cfg.n_quarterly,
+                             n_quarterly=cfg.n_quarterly, n_factors=cfg.k)
+        res = mf_fit(Y, spec, mask=mask, max_iters=iters, tol=args.tol,
+                     callback=cb)
+        res_backend, history = "tpu", records
+    else:
+        res = fit(DynamicFactorModel(n_factors=cfg.k, dynamics=cfg.dynamics),
+                  Y, mask=mask, backend=args.backend, max_iters=iters,
+                  tol=args.tol, callback=cb)
+        res_backend, history = res.backend, res.history
     wall = time.perf_counter() - t0
     # Per-iteration seconds from the fit history (first iter includes compile).
-    secs = [h["secs"] for h in res.history]
+    secs = [h["secs"] for h in history]
     steady = secs[1:] if len(secs) > 1 else secs
     summary = {
         "config": cfg.name,
-        "backend": res.backend,
+        "backend": res_backend,
         "N": cfg.N, "T": cfg.T, "k": cfg.k,
-        "n_iters": res.n_iters,
+        "n_iters": len(records),
         "converged": res.converged,
         "loglik": res.loglik,
         "wall_secs": wall,

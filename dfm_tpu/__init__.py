@@ -10,13 +10,13 @@ mesh).
 """
 
 from .api import (DynamicFactorModel, FitResult, fit, forecast,
-                  Backend, CPUBackend, TPUBackend,
+                  Backend, CPUBackend, TPUBackend, ShardedBackend,
                   register_backend, get_backend)
 
 __version__ = "0.1.0"
 
 __all__ = [
     "DynamicFactorModel", "FitResult", "fit", "forecast",
-    "Backend", "CPUBackend", "TPUBackend",
+    "Backend", "CPUBackend", "TPUBackend", "ShardedBackend",
     "register_backend", "get_backend", "__version__",
 ]

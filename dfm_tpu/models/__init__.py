@@ -1,0 +1,14 @@
+"""Model families (SURVEY.md L4): the config surface of BASELINE.json:6-12.
+
+Static and AR(1) DFMs live in the core API (``dfm_tpu.api``); this package
+holds the structured variants: mixed-frequency nowcasting, time-varying
+loadings, stochastic-volatility via particle Kalman filtering.
+"""
+
+from .mixed_freq import (MixedFreqSpec, MFParams, MFResult, augment,
+                         mf_em_step, mf_fit, mf_pca_init)
+
+__all__ = [
+    "MixedFreqSpec", "MFParams", "MFResult", "augment",
+    "mf_em_step", "mf_fit", "mf_pca_init",
+]

@@ -1,0 +1,286 @@
+"""Mixed-frequency nowcasting DFM (config S3; SURVEY.md sections 3.4, 7.1 M3).
+
+Monthly/quarterly panel with arbitrary missing observations:
+
+  - **State augmentation (Mariano-Murasawa):** the state stacks n_lags=5
+    monthly factor lags, x_t = [f_t, f_{t-1}, ..., f_{t-4}]; quarterly series
+    load on the weighted combination g_t = sum_j w_j f_{t-j}, w = [1,2,3,2,1]/3
+    (the quarterly-growth aggregation identity).  Transition is the companion
+    matrix with the VAR(1) block A in the top-left; only the top k x k block
+    of Q is nonzero.
+  - **Missing data (Banbura-Modugno):** a {0,1} mask with static shapes —
+    masked rows drop out of the info-form observation statistics and the
+    log-likelihood; quarterly rows are masked except months 3, 6, ... plus
+    any ragged-edge missingness.
+  - **Constrained EM:** the M-step respects the loading structure.  Monthly
+    rows regress on the f_t block only; quarterly rows regress on g_t (so the
+    full augmented row is kron(w, lam_q) by construction); the transition
+    block is estimated from within-period cross moments E[f_t f_{t-1}'] =
+    sum_t EffT[t][0:k, k:2k], which the augmented state carries without lag-1
+    smoother covariances.
+
+Everything is jit-compiled JAX over the info-form filter (state dim m = 5k
+stays small; N enters only through the masked observation reductions, so the
+series axis shards exactly as in ``parallel.sharded``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.linalg import sym, solve_psd
+from ..ssm.info_filter import info_filter
+from ..ssm.kalman import rts_smoother
+from ..ssm.params import SSMParams
+from ..estim.em import run_em_loop
+
+__all__ = ["MixedFreqSpec", "MFParams", "augment", "mf_em_step", "mf_fit",
+           "MFResult"]
+
+MM_WEIGHTS = (1.0 / 3, 2.0 / 3, 1.0, 2.0 / 3, 1.0 / 3)
+
+
+@dataclasses.dataclass(frozen=True)
+class MixedFreqSpec:
+    """Static model description (hashable -> jit static argument)."""
+    n_monthly: int
+    n_quarterly: int
+    n_factors: int
+    n_lags: int = 5
+    weights: tuple = MM_WEIGHTS
+    r_floor: float = 1e-6
+    estimate_init: bool = False
+
+    @property
+    def state_dim(self) -> int:
+        return self.n_lags * self.n_factors
+
+
+class MFParams(NamedTuple):
+    """Small (unaugmented) parameter pytree the EM iterates on.
+
+    Lam_m: (Nm, k) monthly loadings; Lam_q: (Nq, k) quarterly loadings on the
+    aggregated factor g_t; A, Q: (k, k) monthly-factor VAR(1); R: (Nm+Nq,);
+    mu0, P0: augmented-state initial moments ((m,), (m, m)).
+    """
+
+    Lam_m: jax.Array
+    Lam_q: jax.Array
+    A: jax.Array
+    Q: jax.Array
+    R: jax.Array
+    mu0: jax.Array
+    P0: jax.Array
+
+    def astype(self, dtype):
+        return MFParams(*(jnp.asarray(x, dtype) for x in self))
+
+
+def augment(p: MFParams, spec: MixedFreqSpec) -> SSMParams:
+    """Build the augmented (state-dim m = L*k) SSMParams for the filter."""
+    k, L = spec.n_factors, spec.n_lags
+    m = spec.state_dim
+    dtype = p.Lam_m.dtype
+    wv = jnp.asarray(spec.weights, dtype)
+    # Loadings: monthly rows live on block 0; quarterly rows = kron(w, lam_q).
+    Lam_m_aug = jnp.concatenate(
+        [p.Lam_m, jnp.zeros((spec.n_monthly, m - k), dtype)], axis=1)
+    Lam_q_aug = jnp.reshape(wv[None, :, None] * p.Lam_q[:, None, :],
+                            (spec.n_quarterly, m))
+    Lam = jnp.concatenate([Lam_m_aug, Lam_q_aug], axis=0)
+    # Companion transition and top-block-only innovation covariance.
+    A_aug = jnp.zeros((m, m), dtype)
+    A_aug = A_aug.at[:k, :k].set(p.A)
+    A_aug = A_aug.at[k:, :-k].set(jnp.eye(m - k, dtype=dtype))
+    Q_aug = jnp.zeros((m, m), dtype).at[:k, :k].set(p.Q)
+    return SSMParams(Lam=Lam, A=A_aug, Q=Q_aug, R=p.R, mu0=p.mu0, P0=p.P0)
+
+
+def _blocked(EffT, L, k):
+    """(T, m, m) -> (T, L, k, L, k) block view."""
+    T = EffT.shape[0]
+    return EffT.reshape(T, L, k, L, k)
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def mf_em_step(Y, mask, p: MFParams, spec: MixedFreqSpec):
+    """One constrained EM iteration.  Returns (new_params, entry loglik)."""
+    k, L = spec.n_factors, spec.n_lags
+    Nm = spec.n_monthly
+    dtype = Y.dtype
+    wv = jnp.asarray(spec.weights, dtype)
+    T = Y.shape[0]
+
+    aug = augment(p, spec)
+    kf = info_filter(Y, aug, mask=mask)
+    sm = rts_smoother(kf, aug)
+
+    x, P = sm.x_sm, sm.P_sm                       # (T, m), (T, m, m)
+    EffT = P + jnp.einsum("ti,tj->tij", x, x)
+    E5 = _blocked(EffT, L, k)                     # (T, L, k, L, k)
+    Ef = x.reshape(T, L, k)
+
+    W = mask.astype(dtype)
+    Yz = jnp.where(W > 0, jnp.nan_to_num(Y), 0.0)
+    counts = jnp.maximum(W.sum(0), 1.0)
+
+    # ----- monthly loadings: regress on the f_t (block-0) moments -----
+    Ef0 = Ef[:, 0, :]                             # (T, k)
+    Eff0 = E5[:, 0, :, 0, :]                      # (T, k, k)
+    Wm, Ym = W[:, :Nm], Yz[:, :Nm]
+    S_yf_m = jnp.einsum("ti,tk->ik", Ym, Ef0)
+    S_ff_m = jnp.einsum("ti,tkl->ikl", Wm, Eff0)
+    never_m = (Wm.sum(0) == 0)[:, None, None]
+    S_ff_m = jnp.where(never_m, jnp.eye(k, dtype=dtype)[None], S_ff_m)
+    Lam_m = jax.vmap(solve_psd)(S_ff_m, S_yf_m)
+    # E[(y - lam'f)^2] summed: y^2 - 2 y lam'Ef + lam' (sum w Eff) lam,
+    # reusing the per-series moment sums (Ym is already mask-zero-filled).
+    rm = (jnp.einsum("ti,ti->i", Ym, Ym)
+          - 2.0 * jnp.einsum("ti,ti->i", Ym, Ef0 @ Lam_m.T)
+          + jnp.einsum("ik,ikl,il->i", Lam_m, S_ff_m, Lam_m))
+
+    # ----- quarterly loadings: regress on g_t = sum_j w_j f_{t-j} -----
+    Eg = jnp.einsum("tak,a->tk", Ef, wv)          # (T, k)
+    Egg = jnp.einsum("tajbl,a,b->tjl", E5, wv, wv)  # (T, k, k)
+    Wq, Yq = W[:, Nm:], Yz[:, Nm:]
+    S_yg = jnp.einsum("ti,tk->ik", Yq, Eg)
+    S_gg = jnp.einsum("ti,tkl->ikl", Wq, Egg)
+    never_q = (Wq.sum(0) == 0)[:, None, None]
+    S_gg = jnp.where(never_q, jnp.eye(k, dtype=dtype)[None], S_gg)
+    Lam_q = jax.vmap(solve_psd)(S_gg, S_yg)
+    rq = (jnp.einsum("ti,ti->i", Yq, Yq)
+          - 2.0 * jnp.einsum("ti,ti->i", Yq, Eg @ Lam_q.T)
+          + jnp.einsum("ik,ikl,il->i", Lam_q, S_gg, Lam_q))
+
+    R = jnp.maximum(jnp.concatenate([rm, rq]) / counts, spec.r_floor)
+
+    # ----- transition block: within-state cross moments -----
+    # The augmented state carries (f_t, f_{t-1}) jointly, so E[f_t f_{t-1}']
+    # needs no lag-one smoother covariance.  t=0's pair belongs to the prior,
+    # not the dynamics, hence the [1:] sums over the T-1 real transitions.
+    S_cur = E5[1:, 0, :, 0, :].sum(0)             # sum E[f_t f_t']
+    S_cross = E5[1:, 0, :, 1, :].sum(0)           # sum E[f_t f_{t-1}']
+    S_lag = E5[1:, 1, :, 1, :].sum(0)             # sum E[f_{t-1} f_{t-1}']
+    A = solve_psd(S_lag, S_cross.T).T
+    Q = sym((S_cur - A @ S_cross.T) / (T - 1))
+
+    mu0, P0 = p.mu0, p.P0
+    if spec.estimate_init:
+        mu0 = x[0]
+        P0 = sym(P[0])
+    return MFParams(Lam_m, Lam_q, A, Q, R, mu0, P0), kf.loglik
+
+
+def mf_pca_init(Y: np.ndarray, mask: np.ndarray,
+                spec: MixedFreqSpec) -> MFParams:
+    """Warm start: PCA on the zero-filled monthly block, then regressions.
+
+    Standard EM warm start for incomplete standardized panels (zero = series
+    mean); quarterly loadings from OLS of observed quarterly values on the
+    MM-aggregated PCA factor path.
+    """
+    from ..backends.cpu_ref import pca_init as _pca, \
+        _solve_discrete_lyapunov_or_eye
+    k, L, Nm = spec.n_factors, spec.n_lags, spec.n_monthly
+    wv = np.asarray(spec.weights, np.float64)
+    T = Y.shape[0]
+    W = np.asarray(mask, np.float64)
+    Yz = np.where(W > 0, np.nan_to_num(np.asarray(Y, np.float64)), 0.0)
+    pm = _pca(Yz[:, :Nm], k)
+    F = Yz[:, :Nm] @ pm.Lam / Nm                  # (T, k) PCA factor path
+    # MM aggregate of the estimated path (zeros before t=0).
+    G = np.zeros((T, k))
+    for j in range(L):
+        G[j:] += wv[j] * F[: T - j]
+    Lam_q = np.zeros((spec.n_quarterly, k))
+    Wq, Yq = W[:, Nm:], Yz[:, Nm:]
+    for i in range(spec.n_quarterly):
+        w = Wq[:, i] > 0
+        if w.sum() > k:
+            Gw = G[w]
+            Lam_q[i] = np.linalg.lstsq(Gw, Yq[w, i], rcond=None)[0]
+    resid_q = Yq - G @ Lam_q.T
+    Rq = np.ones(spec.n_quarterly)
+    for i in range(spec.n_quarterly):
+        w = Wq[:, i] > 0
+        Rq[i] = resid_q[w, i].var() if w.sum() > 1 else 1.0
+    m = spec.state_dim
+    A_aug = np.zeros((m, m))
+    A_aug[:k, :k] = pm.A
+    A_aug[k:, :-k] = np.eye(m - k)
+    Q_aug = np.zeros((m, m))
+    Q_aug[:k, :k] = pm.Q
+    P0 = _solve_discrete_lyapunov_or_eye(A_aug, Q_aug + 1e-10 * np.eye(m))
+    return MFParams(
+        Lam_m=jnp.asarray(pm.Lam), Lam_q=jnp.asarray(Lam_q),
+        A=jnp.asarray(pm.A), Q=jnp.asarray(pm.Q),
+        R=jnp.asarray(np.concatenate([pm.R, np.maximum(Rq, 1e-6)])),
+        mu0=jnp.zeros(m), P0=jnp.asarray(P0))
+
+
+@dataclasses.dataclass
+class MFResult:
+    params: MFParams
+    logliks: np.ndarray
+    factors: np.ndarray          # (T, k) smoothed current-month factors
+    factor_cov: np.ndarray       # (T, k, k)
+    nowcast: np.ndarray          # (T, N) smoothed common component
+    converged: bool
+    spec: MixedFreqSpec
+
+    @property
+    def loglik(self):
+        return float(self.logliks[-1]) if len(self.logliks) else float("nan")
+
+
+def mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
+           mask: Optional[np.ndarray] = None,
+           max_iters: int = 50, tol: float = 1e-6,
+           dtype=None, init: Optional[MFParams] = None,
+           standardize: bool = True,
+           callback=None) -> MFResult:
+    """Estimate the mixed-frequency DFM.  Y is (T, Nm+Nq), monthly series
+    first; NaNs and/or ``mask`` mark unobserved entries.  Standardization
+    (per-series, over observed entries) is applied by default; the returned
+    nowcast is mapped back to original data units."""
+    Y = np.asarray(Y, np.float64)
+    from ..utils.data import build_mask, standardize as _std
+    W = build_mask(Y, mask)
+    std = None
+    if standardize:
+        Y, std = _std(Y, mask=W)
+    if dtype is None:
+        dtype = (jnp.float64 if jax.config.jax_enable_x64
+                 and jax.default_backend() == "cpu" else jnp.float32)
+    if init is None:
+        init = mf_pca_init(Y, W, spec)
+    Yj = jnp.asarray(np.nan_to_num(Y * (W > 0)), dtype)
+    Wj = jnp.asarray(W, dtype)
+    p = init.astype(dtype)
+
+    def step(it):
+        nonlocal p
+        entering = p
+        p, ll = mf_em_step(Yj, Wj, entering, spec)
+        return ll, entering
+
+    lls, converged = run_em_loop(step, max_iters, tol, callback)
+
+    aug = augment(p, spec)
+    kf = info_filter(Yj, aug, mask=Wj)
+    sm = rts_smoother(kf, aug)
+    k = spec.n_factors
+    x_sm = np.asarray(sm.x_sm, np.float64)
+    P_sm = np.asarray(sm.P_sm, np.float64)
+    common = x_sm @ np.asarray(aug.Lam, np.float64).T
+    if std is not None:
+        common = std.inverse(common)
+    return MFResult(params=p, logliks=np.asarray(lls),
+                    factors=x_sm[:, :k], factor_cov=P_sm[:, :k, :k],
+                    nowcast=common, converged=converged, spec=spec)

@@ -76,6 +76,43 @@ def mixed_freq_mask(T: int, N: int, n_quarterly: int) -> np.ndarray:
     return mask
 
 
+def simulate_mixed_freq(n_monthly: int, n_quarterly: int, T: int, k: int,
+                        rng: np.random.Generator,
+                        weights=(1.0, 2.0, 3.0, 2.0, 1.0),
+                        noise_scale: float = 1.0):
+    """Mixed-frequency DGP (config S3, BASELINE.json:9; SURVEY.md section 3.4).
+
+    Monthly series load on f_t; quarterly series load on the Mariano-Murasawa
+    weighted lag combination g_t = sum_j w_j f_{t-j} (w = [1,2,3,2,1]/3) and
+    are observed only at months 3, 6, ... (indices 2, 5, ...).
+
+    Returns (Y (T, Nm+Nq) with NaN at unobserved, mask, F (T, k), truth dict).
+    """
+    wv = np.asarray(weights, np.float64) / 3.0
+    L = len(wv)
+    A = stable_var1(k, rng)
+    F = np.zeros((T + L - 1, k))
+    f = rng.standard_normal(k)
+    for t in range(T + L - 1):
+        if t > 0:
+            f = A @ F[t - 1] + rng.standard_normal(k)
+        F[t] = f
+    Fw = F[L - 1:]                                 # aligned current factor
+    G = sum(wv[j] * F[L - 1 - j: L - 1 - j + T] for j in range(L))
+    Lam_m = rng.standard_normal((n_monthly, k))
+    Lam_q = rng.standard_normal((n_quarterly, k))
+    R = noise_scale * (0.5 + rng.random(n_monthly + n_quarterly))
+    Ym = Fw @ Lam_m.T + rng.standard_normal((T, n_monthly)) * np.sqrt(
+        R[:n_monthly])
+    Yq = G @ Lam_q.T + rng.standard_normal((T, n_quarterly)) * np.sqrt(
+        R[n_monthly:])
+    Y = np.concatenate([Ym, Yq], axis=1)
+    mask = mixed_freq_mask(T, n_monthly + n_quarterly, n_quarterly)
+    Y = np.where(mask > 0, Y, np.nan)
+    truth = {"Lam_m": Lam_m, "Lam_q": Lam_q, "A": A, "R": R, "G": G}
+    return Y, mask, Fw, truth
+
+
 def simulate_tv_loadings(N: int, T: int, k: int, rng: np.random.Generator,
                          walk_scale: float = 0.02,
                          noise_scale: float = 1.0):

@@ -1,0 +1,121 @@
+"""Mixed-frequency DFM tests (config S3; SURVEY.md sections 3.4, 4.2).
+
+Spine: DGP -> estimate -> recover, plus the two structural equivalences that
+pin the augmentation algebra:
+  - with no quarterly series, the augmented model's loglik equals the plain
+    k-state model's (the companion lags are deterministic bookkeeping);
+  - EM loglik is monotone under masks + augmentation (whole-pipeline oracle).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.models.mixed_freq import (MFParams, MixedFreqSpec, augment,
+                                       mf_em_step, mf_fit, mf_pca_init)
+from dfm_tpu.ssm.info_filter import info_filter
+from dfm_tpu.ssm.params import SSMParams as JP
+from dfm_tpu.utils import dgp
+from dfm_tpu.utils.data import build_mask
+
+
+@pytest.fixture(scope="module")
+def mf_panel():
+    rng = np.random.default_rng(21)
+    Y, mask, F, truth = dgp.simulate_mixed_freq(
+        n_monthly=30, n_quarterly=8, T=120, k=2, rng=rng)
+    return Y, mask, F, truth
+
+
+def test_augment_shapes():
+    spec = MixedFreqSpec(n_monthly=3, n_quarterly=2, n_factors=2)
+    p = MFParams(Lam_m=jnp.ones((3, 2)), Lam_q=jnp.ones((2, 2)),
+                 A=0.5 * jnp.eye(2), Q=jnp.eye(2), R=jnp.ones(5),
+                 mu0=jnp.zeros(10), P0=jnp.eye(10))
+    aug = augment(p, spec)
+    assert aug.Lam.shape == (5, 10)
+    assert aug.A.shape == (10, 10)
+    # quarterly row = kron(w, lam_q)
+    np.testing.assert_allclose(np.asarray(aug.Lam)[3, :2], 1.0 / 3)
+    np.testing.assert_allclose(np.asarray(aug.Lam)[3, 4:6], 1.0)
+    # companion shift: block (1,0) is I
+    np.testing.assert_allclose(np.asarray(aug.A)[2:4, :2], np.eye(2))
+    # top-left is A
+    np.testing.assert_allclose(np.asarray(aug.A)[:2, :2], 0.5 * np.eye(2))
+
+
+def test_monthly_only_equals_plain_model():
+    """Augmented filter with zero quarterly series == plain k-state filter."""
+    rng = np.random.default_rng(22)
+    p_np = dgp.dfm_params(12, 2, rng)
+    Y, _ = dgp.simulate(p_np, 60, rng)
+    spec = MixedFreqSpec(n_monthly=12, n_quarterly=0, n_factors=2)
+    m = spec.state_dim
+    # Build consistent augmented initial moments: block-diagonalize P0 over
+    # lags using the stationary distribution of the companion.
+    A_aug = np.zeros((m, m))
+    A_aug[:2, :2] = p_np.A
+    A_aug[2:, :-2] = np.eye(m - 2)
+    Q_aug = np.zeros((m, m))
+    Q_aug[:2, :2] = p_np.Q
+    P0_aug = cpu_ref._solve_discrete_lyapunov_or_eye(
+        A_aug, Q_aug + 1e-12 * np.eye(m))
+    p_mf = MFParams(Lam_m=jnp.asarray(p_np.Lam),
+                    Lam_q=jnp.zeros((0, 2)),
+                    A=jnp.asarray(p_np.A), Q=jnp.asarray(p_np.Q),
+                    R=jnp.asarray(p_np.R),
+                    mu0=jnp.zeros(m), P0=jnp.asarray(P0_aug))
+    aug = augment(p_mf, spec)
+    W = np.ones_like(Y)
+    ll_aug = float(info_filter(jnp.asarray(Y), aug,
+                               mask=jnp.asarray(W)).loglik)
+    # Plain model with the *matching* prior on f_1 (top block of P0_aug).
+    p_plain = cpu_ref.SSMParams(p_np.Lam, p_np.A, p_np.Q, p_np.R,
+                                np.zeros(2), P0_aug[:2, :2])
+    ll_plain = cpu_ref.kalman_filter(Y, p_plain).loglik
+    assert abs(ll_aug - ll_plain) < 1e-6 * abs(ll_plain)
+
+
+def test_mf_em_monotone_loglik(mf_panel):
+    Y, mask, _, _ = mf_panel
+    spec = MixedFreqSpec(n_monthly=30, n_quarterly=8, n_factors=2)
+    from dfm_tpu.utils.data import standardize
+    Yz, _ = standardize(Y, mask=mask)
+    W = build_mask(Yz, mask)
+    p = mf_pca_init(Yz, W, spec)
+    Yj = jnp.asarray(np.nan_to_num(np.where(W > 0, Yz, 0.0)))
+    Wj = jnp.asarray(W)
+    lls = []
+    for _ in range(8):
+        p, ll = mf_em_step(Yj, Wj, p, spec)
+        lls.append(float(ll))
+    dll = np.diff(lls)
+    assert np.all(dll >= -1e-7 * np.abs(lls[:-1]).max()), lls
+
+
+def test_mf_fit_recovers_factors(mf_panel):
+    Y, mask, F, truth = mf_panel
+    spec = MixedFreqSpec(n_monthly=30, n_quarterly=8, n_factors=2)
+    res = mf_fit(Y, spec, mask=mask, max_iters=30, tol=1e-8)
+    assert np.all(np.isfinite(res.logliks))
+    # Factor space recovery up to rotation: R^2 of true factors on estimates.
+    X = np.column_stack([res.factors, np.ones(len(F))])
+    for j in range(2):
+        beta, *_ = np.linalg.lstsq(X, F[:, j], rcond=None)
+        resid = F[:, j] - X @ beta
+        r2 = 1.0 - resid.var() / F[:, j].var()
+        assert r2 > 0.85, f"factor {j}: R^2={r2:.3f}"
+
+
+def test_mf_nowcast_fills_missing_quarterly(mf_panel):
+    """The smoothed common component approximates the LATENT quarterly value
+    in months where the series is unobserved."""
+    Y, mask, F, truth = mf_panel
+    spec = MixedFreqSpec(n_monthly=30, n_quarterly=8, n_factors=2)
+    res = mf_fit(Y, spec, mask=mask, max_iters=30, tol=1e-8)
+    latent_q = truth["G"] @ truth["Lam_q"].T        # noiseless quarterly path
+    miss = mask[:, 30:] == 0
+    now_q = res.nowcast[:, 30:]
+    corr = np.corrcoef(now_q[miss], latent_q[miss])[0, 1]
+    assert corr > 0.9, corr
