@@ -39,7 +39,8 @@ def make_data(cfg):
             Y = np.where(mask > 0, Y, np.nan)
         return Y, mask, F
     if cfg.kind == "tvl":
-        Y, F, _, _, _ = dgp.simulate_tv_loadings(cfg.N, cfg.T, cfg.k, rng)
+        Y, F, _, _, _ = dgp.simulate_tv_loadings(cfg.N, cfg.T, cfg.k, rng,
+                                                 walk_scale=0.05)
         return Y, None, F
     if cfg.kind == "sv":
         Y, F, _, _ = dgp.simulate_sv(cfg.N, cfg.T, cfg.k, rng)
@@ -82,6 +83,11 @@ def main(argv=None):
                              n_quarterly=cfg.n_quarterly, n_factors=cfg.k)
         res = mf_fit(Y, spec, mask=mask, max_iters=iters, tol=args.tol,
                      callback=cb)
+        res_backend, history = "tpu", records
+    elif cfg.kind == "tvl":
+        from dfm_tpu.models.tv_loadings import TVLSpec, tvl_fit
+        res = tvl_fit(Y, TVLSpec(n_factors=cfg.k, n_rounds=iters,
+                                 tol=args.tol), mask=mask, callback=cb)
         res_backend, history = "tpu", records
     else:
         res = fit(DynamicFactorModel(n_factors=cfg.k, dynamics=cfg.dynamics),

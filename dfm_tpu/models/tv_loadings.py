@@ -1,0 +1,315 @@
+"""Time-varying-loadings DFM (config S4, BASELINE.json:10; SURVEY.md M4).
+
+Model:  y_it = lam_it' f_t + eps_it,  lam_it = lam_i,t-1 + xi_it (random walk,
+Var xi = tau2_i I);  f_t = A f_{t-1} + eta_t.
+
+The naive formulation puts all N*k loadings in the state (dim k(N+1) — 25k at
+the S4 scale, infeasible; SURVEY.md section 7.2 item 4).  Instead the model
+factorizes: CONDITIONAL on the factor path the N loading processes are
+independent k-dim linear-Gaussian chains, and conditional on the loading
+paths the factors follow a time-varying-loadings SSM the information-form
+filter already handles (C_t, b_t simply become per-t einsums).  Estimation
+alternates the two exact conditional smoothers (a dual-Kalman/EM-style
+coordinate scheme):
+
+  A-step  factors | loadings:  info-form filter/smoother with Lam_t (T,N,k)
+  B-step  loadings | factors:  N independent scalar-observation Kalman
+          filters, batched as ONE lax.scan over time carrying (N,k) means and
+          (N,k,k) covariances — rank-1 updates, no solves, pure vector ops
+  M-bits  R, tau2 from smoothed residuals/increments; A, Q from factor
+          moments (same closed forms as the core EM)
+
+Both directions are large batched scans — the TPU-native layout for this
+model family.  Exact joint likelihood is intractable (bilinear); the reported
+loglik is the factor-filter loglik conditional on the current loading paths,
+which is the standard convergence monitor for dual estimation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.linalg import sym, solve_psd
+from ..ssm.info_filter import (ObsStats, info_scan, loglik_from_terms)
+from ..ssm.params import FilterResult, SmootherResult
+from ..ssm.kalman import rts_smoother
+from ..ssm.params import SSMParams
+from ..estim.em import run_em_loop
+
+__all__ = ["TVLSpec", "TVLParams", "tvl_fit", "TVLResult",
+           "factor_pass_tv", "loading_pass"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TVLSpec:
+    n_factors: int
+    n_rounds: int = 10
+    tol: float = 1e-6
+    estimate_tau2: bool = True
+    r_floor: float = 1e-6
+    tau2_floor: float = 1e-10
+
+
+class TVLParams(NamedTuple):
+    """Lam0 (N, k) initial loadings; tau2 (N,) loading-walk variances;
+    A, Q (k, k); R (N,); mu0 (k,); P0 (k, k)."""
+
+    Lam0: jax.Array
+    tau2: jax.Array
+    A: jax.Array
+    Q: jax.Array
+    R: jax.Array
+    mu0: jax.Array
+    P0: jax.Array
+
+    def astype(self, dtype):
+        return TVLParams(*(jnp.asarray(x, dtype) for x in self))
+
+
+# ---------------------------------------------------------------------------
+# A-step: factor filter/smoother with time-varying loadings (info form)
+# ---------------------------------------------------------------------------
+
+def obs_stats_tv(Y, Lam_t, R, mask=None) -> ObsStats:
+    """Info-form observation stats with per-t loadings Lam_t (T, N, k)."""
+    dtype = Y.dtype
+    T, N = Y.shape
+    Rinv = 1.0 / R
+    logR = jnp.log(R)
+    if mask is None:
+        b = jnp.einsum("tn,n,tnk->tk", Y, Rinv, Lam_t)
+        C = jnp.einsum("tnk,n,tnl->tkl", Lam_t, Rinv, Lam_t)
+        n = jnp.full((T,), float(N), dtype)
+        ldR = jnp.full((T,), jnp.sum(logR), dtype)
+    else:
+        W = mask.astype(dtype)
+        Yw = W * jnp.nan_to_num(Y)
+        b = jnp.einsum("tn,n,tnk->tk", Yw, Rinv, Lam_t)
+        C = jnp.einsum("tnk,tn,n,tnl->tkl", Lam_t, W, Rinv, Lam_t)
+        n = W.sum(axis=1)
+        ldR = W @ logR
+    return ObsStats(b, C, n, ldR)
+
+
+def factor_pass_tv(Y, Lam_t, p: TVLParams, mask=None):
+    """Filter + RTS smoother over factors given loading paths.
+
+    Returns (FilterResult, SmootherResult); loglik is conditional on Lam_t.
+    """
+    stats = obs_stats_tv(Y, Lam_t, p.R, mask=mask)
+    xp, Pp, xf, Pf, logdetG = info_scan(stats, p.A, p.Q, p.mu0, p.P0)
+    V = Y - jnp.einsum("tnk,tk->tn", Lam_t, xp)
+    if mask is not None:
+        V = mask.astype(Y.dtype) * jnp.nan_to_num(V)
+    VR = V / p.R[None, :]
+    quad_R = jnp.einsum("tn,tn->t", V, VR)
+    U = jnp.einsum("tn,tnk->tk", VR, Lam_t)
+    ll = loglik_from_terms(stats, logdetG, Pf, quad_R, U)
+    kf = FilterResult(xp, Pp, xf, Pf, ll)
+    dummy = SSMParams(Lam=Lam_t[0], A=p.A, Q=p.Q, R=p.R, mu0=p.mu0, P0=p.P0)
+    return kf, rts_smoother(kf, dummy)
+
+
+# ---------------------------------------------------------------------------
+# B-step: batched loading filter/smoother given the factor path
+# ---------------------------------------------------------------------------
+
+def loading_pass(Y, F, p: TVLParams, mask=None):
+    """N independent k-dim random-walk chains, one scan over time.
+
+    Scalar observation per (t, i): y_it = F_t' lam_it + eps.  The update is
+    rank-1 (gain K = P f / (f'Pf + R)) so the whole cross-section advances
+    with einsums only — no linear solves anywhere.
+
+    Returns (lam_sm (T, N, k), P_sm (T, N, k, k), incr (N,), counts used for
+    tau2), where incr accumulates E[|lam_t - lam_{t-1}|^2] for the tau2
+    update (exact, using the random-walk smoother identities).
+    """
+    dtype = Y.dtype
+    T, N = Y.shape
+    k = p.A.shape[0]
+    I_k = jnp.eye(k, dtype=dtype)
+    tau2 = p.tau2
+    R = p.R
+    W = None if mask is None else mask.astype(dtype)
+    Yz = jnp.nan_to_num(Y) if mask is None else jnp.nan_to_num(Y) * W
+
+    def fstep(carry, inp):
+        lam, P = carry                   # (N, k), (N, k, k) filtered t-1
+        y_t, f_t, w_t = inp
+        P_pred = P + tau2[:, None, None] * I_k[None]
+        Pf = jnp.einsum("nkl,l->nk", P_pred, f_t)       # (N, k)
+        S = jnp.einsum("nk,k->n", Pf, f_t) + R          # (N,)
+        gate = w_t if w_t is not None else jnp.ones((N,), dtype)
+        K = gate[:, None] * Pf / S[:, None]             # (N, k)
+        v = y_t - lam @ f_t                             # innovation vs pred
+        lam_f = lam + K * v[:, None]
+        P_f = P_pred - jnp.einsum("nk,nl->nkl", K, Pf)
+        P_f = sym(P_f)
+        return (lam_f, P_f), (lam, P_pred, lam_f, P_f)
+
+    lam0 = jnp.broadcast_to(p.Lam0, (N, k))
+    P0 = jnp.broadcast_to((1e-2 + tau2)[:, None, None] * I_k[None],
+                          (N, k, k))
+    if W is None:
+        (_, _), (lam_pr, P_pr, lam_fs, P_fs) = lax.scan(
+            lambda c, i: fstep(c, (i[0], i[1], None)), (lam0, P0), (Yz, F))
+    else:
+        (_, _), (lam_pr, P_pr, lam_fs, P_fs) = lax.scan(
+            lambda c, i: fstep(c, i), (lam0, P0), (Yz, F, W))
+
+    # RTS for the random walk: J_t = P_f[t] (P_pred[t+1])^{-1}; both are
+    # (N, k, k) PSD; batched Cholesky solve over (T-1, N).
+    def bstep(carry, inp):
+        lam_n, P_n, incr = carry         # smoothed at t+1, running increment
+        lam_f, P_f, lam_p_next, P_p_next = inp
+        L = jnp.linalg.cholesky(P_p_next)
+        # J' = solve(P_pred, P_f) using the Cholesky factor.
+        tmp = jax.scipy.linalg.cho_solve((L, True), P_f)   # (N, k, k) = J'
+        J = jnp.swapaxes(tmp, -1, -2)
+        lam_s = lam_f + jnp.einsum("nkl,nl->nk", J, lam_n - lam_p_next)
+        P_s = sym(P_f + jnp.einsum("nkl,nlm,npm->nkp", J, P_n - P_p_next, J))
+        # E|lam_{t+1} - lam_t|^2 = |dlam|^2 + tr(P_s[t+1]) + tr(P_s[t])
+        #                          - 2 tr(P_lag), P_lag = P_sm[t+1] J'
+        P_lag = jnp.einsum("nkl,nml->nkm", P_n, J)
+        d = lam_n - lam_s
+        incr = incr + (jnp.einsum("nk,nk->n", d, d)
+                       + jnp.trace(P_n, axis1=-2, axis2=-1)
+                       + jnp.trace(P_s, axis1=-2, axis2=-1)
+                       - 2.0 * jnp.trace(P_lag, axis1=-2, axis2=-1))
+        return (lam_s, P_s, incr), (lam_s, P_s)
+
+    init = (lam_fs[-1], P_fs[-1], jnp.zeros((N,), dtype))
+    inps = (lam_fs[:-1], P_fs[:-1], lam_pr[1:], P_pr[1:])
+    (lam_s0, P_s0, incr), (lam_rev, P_rev) = lax.scan(
+        bstep, init, inps, reverse=True)
+    lam_sm = jnp.concatenate([lam_rev, lam_fs[-1:]], axis=0)
+    P_sm = jnp.concatenate([P_rev, P_fs[-1:]], axis=0)
+    return lam_sm, P_sm, incr
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("spec", "has_mask"))
+def _tvl_round(Y, mask, Lam_t, p: TVLParams, spec: TVLSpec, has_mask: bool):
+    """One alternation round.  Returns (Lam_t', params', loglik, F_sm)."""
+    m = mask if has_mask else None
+    dtype = Y.dtype
+    T, N = Y.shape
+    k = spec.n_factors
+
+    # A-step: factors given loadings.
+    kf, sm = factor_pass_tv(Y, Lam_t, p, mask=m)
+    F = sm.x_sm
+
+    # Factor-dynamics M-bits (exact given the factor smoother).
+    EffT = sm.P_sm + jnp.einsum("ti,tj->tij", F, F)
+    cross = sm.P_lag[1:] + jnp.einsum("ti,tj->tij", F[1:], F[:-1])
+    S_lag = EffT[:-1].sum(0)
+    S_cur = EffT[1:].sum(0)
+    S_cross = cross.sum(0)
+    A = solve_psd(S_lag, S_cross.T).T
+    Q = sym((S_cur - A @ S_cross.T) / (T - 1))
+
+    # B-step: loadings given (smoothed-mean) factor path.
+    lam_sm, P_sm_l, incr = loading_pass(Y, F, p, mask=m)
+
+    # R update: conditional residuals + loading-uncertainty smear.
+    W = mask.astype(dtype) if has_mask else jnp.ones_like(Y)
+    Yz = jnp.nan_to_num(Y) * W
+    resid = Yz - W * jnp.einsum("tnk,tk->tn", lam_sm, F)
+    smear = jnp.einsum("tn,tnkl,tk,tl->n", W, P_sm_l, F, F)
+    counts = jnp.maximum(W.sum(0), 1.0)
+    R = jnp.maximum((jnp.einsum("tn,tn->n", resid, resid) + smear) / counts,
+                    spec.r_floor)
+
+    tau2 = p.tau2
+    if spec.estimate_tau2:
+        tau2 = jnp.maximum(incr / ((T - 1) * k), spec.tau2_floor)
+
+    p_new = TVLParams(Lam0=lam_sm[0], tau2=tau2, A=A, Q=Q, R=R,
+                      mu0=p.mu0, P0=p.P0)
+    return lam_sm, p_new, kf.loglik, F
+
+
+@dataclasses.dataclass
+class TVLResult:
+    params: TVLParams
+    loadings: np.ndarray       # (T, N, k) smoothed loading paths
+    factors: np.ndarray        # (T, k)
+    logliks: np.ndarray        # conditional loglik per round
+    common: np.ndarray         # (T, N) fitted common component
+    converged: bool
+    spec: TVLSpec
+
+    @property
+    def loglik(self):
+        return float(self.logliks[-1]) if len(self.logliks) else float("nan")
+
+
+def tvl_fit(Y: np.ndarray, spec: TVLSpec,
+            mask: Optional[np.ndarray] = None,
+            dtype=None, callback=None,
+            init: Optional[TVLParams] = None) -> TVLResult:
+    """Dual-Kalman alternating estimation of the TVL-DFM.
+
+    Warm start: static PCA (loadings constant), tau2 small; then
+    ``spec.n_rounds`` alternation rounds (or until the conditional loglik's
+    relative change drops below ``spec.tol``).
+    """
+    from ..backends.cpu_ref import pca_init
+    from ..utils.data import build_mask
+    Y = np.asarray(Y, np.float64)
+    T, N = Y.shape
+    k = spec.n_factors
+    W = build_mask(Y)
+    if mask is not None:
+        W = W * np.asarray(mask, np.float64)
+    any_missing = bool((W == 0).any())
+    if dtype is None:
+        dtype = (jnp.float64 if jax.config.jax_enable_x64
+                 and jax.default_backend() == "cpu" else jnp.float32)
+
+    Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
+    if init is None:
+        p0 = pca_init(Yz, k, mask=W if any_missing else None)
+        init = TVLParams(
+            Lam0=jnp.asarray(p0.Lam), tau2=jnp.full((N,), 1e-4),
+            A=jnp.asarray(p0.A), Q=jnp.asarray(p0.Q), R=jnp.asarray(p0.R),
+            mu0=jnp.asarray(p0.mu0), P0=jnp.asarray(p0.P0))
+    p = init.astype(dtype)
+    Yj = jnp.asarray(Yz, dtype)
+    Wj = jnp.asarray(W, dtype) if any_missing else None
+    Lam_t = jnp.broadcast_to(p.Lam0, (T, N, k))
+    F_last = None
+
+    state = {"Lam_t": Lam_t, "p": p, "F": None}
+
+    def step(it):
+        Lam_t_new, p_new, ll, F = _tvl_round(
+            Yj, Wj if Wj is not None else jnp.ones_like(Yj),
+            state["Lam_t"], state["p"], spec, Wj is not None)
+        entering = state["p"]
+        state.update(Lam_t=Lam_t_new, p=p_new, F=F)
+        return ll, entering
+
+    lls, converged = run_em_loop(step, spec.n_rounds, spec.tol, callback)
+
+    Lam_t = state["Lam_t"]
+    F = state["F"]
+    common = np.einsum("tnk,tk->tn", np.asarray(Lam_t, np.float64),
+                       np.asarray(F, np.float64))
+    return TVLResult(params=state["p"],
+                     loadings=np.asarray(Lam_t, np.float64),
+                     factors=np.asarray(F, np.float64),
+                     logliks=np.asarray(lls), common=common,
+                     converged=converged, spec=spec)
