@@ -89,6 +89,18 @@ def main(argv=None):
         res = tvl_fit(Y, TVLSpec(n_factors=cfg.k, n_rounds=iters,
                                  tol=args.tol), mask=mask, callback=cb)
         res_backend, history = "tpu", records
+    elif cfg.kind == "sv":
+        from dfm_tpu.models.sv import SVSpec, sv_fit
+        t_pf = time.perf_counter()
+        svr = sv_fit(Y, SVSpec(n_factors=cfg.k, n_particles=256),
+                     em_iters=max(iters, 2), backend=args.backend)
+        cb(0, svr.loglik, None)
+
+        class _R:  # summary-shape shim
+            loglik = svr.loglik
+            converged = True
+        res = _R()
+        res_backend, history = args.backend, records
     else:
         res = fit(DynamicFactorModel(n_factors=cfg.k, dynamics=cfg.dynamics),
                   Y, mask=mask, backend=args.backend, max_iters=iters,

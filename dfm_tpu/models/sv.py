@@ -1,0 +1,210 @@
+"""Stochastic-volatility DFM via Rao-Blackwellized particle Kalman filter
+(config S5, BASELINE.json:11; SURVEY.md sections 3.5, 7.1 M5).
+
+Model:  y_t = Lam f_t + eps_t, eps ~ N(0, diag R);
+        f_t = A f_{t-1} + eta_t, eta_t ~ N(0, diag(exp(h_t)));
+        h_t = h_{t-1} + sigma_h * xi_t          (factor-innovation log-vols).
+
+Conditional on the log-vol path {h_t} the model is linear-Gaussian, so a
+particle filter need only sample h (Rao-Blackwellization): each particle
+carries an EXACT Kalman state (x^m, P^m) plus its h^m, and the marginal
+likelihood increment per particle is the Kalman innovation density.
+
+TPU layout (the whole point of this implementation):
+
+  - The info-form observation reductions b_t = Lam'R^{-1}y_t (T, k) and
+    C = Lam'R^{-1}Lam (k, k) are PARTICLE-INDEPENDENT — computed once as one
+    big MXU matmul before the scan.  Per-particle, per-step work is pure
+    k x k (batched Cholesky over M particles inside a lax.scan over T).
+  - Particle WEIGHTS need only the particle-dependent loglik pieces
+    (-2 x_p.b + x_p'C x_p - u'P_f u + log|G^m|); the large shared terms
+    (n log 2pi + log|R| + y'R^{-1}y) are identical across particles, so they
+    cancel in normalized weights and are added to the total loglik outside
+    the softmax — which also sidesteps the f32 large-term cancellation that
+    the non-SV filter solves with a residual pass (info_filter docstring).
+  - Resampling is jit-safe systematic resampling (sorted uniform positions +
+    searchsorted + gather), triggered by ESS < M/2 through lax.cond.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.linalg import sym
+from ..ssm.params import SSMParams
+
+__all__ = ["SVSpec", "SVResult", "sv_filter", "sv_fit"]
+
+_LOG2PI = 1.8378770664093453
+
+
+@dataclasses.dataclass(frozen=True)
+class SVSpec:
+    n_factors: int
+    n_particles: int = 512
+    ess_frac: float = 0.5         # resample when ESS < ess_frac * M
+    sigma_h: float = 0.1          # log-vol random-walk scale
+    h0_scale: float = 0.1         # prior std of h_0 around its center
+
+
+class SVResult(NamedTuple):
+    loglik: jax.Array             # scalar marginal loglik estimate
+    f_mean: jax.Array             # (T, k) weighted filtered factor means
+    h_mean: jax.Array             # (T, k) weighted filtered log-vols
+    ess: jax.Array                # (T,) effective sample size per step
+    n_resamples: jax.Array        # scalar
+
+
+def _systematic_indices(logW, key):
+    """Jit-safe systematic resampling indices (M,) from normalized logW."""
+    M = logW.shape[0]
+    W = jnp.exp(logW)
+    cum = jnp.cumsum(W)
+    cum = cum / cum[-1]
+    u = jax.random.uniform(key, (), dtype=cum.dtype)
+    pos = (jnp.arange(M, dtype=cum.dtype) + u) / M
+    return jnp.clip(jnp.searchsorted(cum, pos), 0, M - 1)
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _sv_filter_impl(Y, p: SSMParams, h_center, key, spec: SVSpec):
+    dtype = Y.dtype
+    T, N = Y.shape
+    k = spec.n_factors
+    M = spec.n_particles
+    I_k = jnp.eye(k, dtype=dtype)
+    A = p.A
+
+    # Shared (particle-independent) observation reductions — one big matmul.
+    Rinv = 1.0 / p.R
+    G0 = p.Lam * Rinv[:, None]
+    B = Y @ G0                                        # (T, k)
+    C = p.Lam.T @ G0                                  # (k, k)
+    c2 = jnp.einsum("tn,n,tn->t", Y, Rinv, Y)         # (T,)
+    ldR = jnp.sum(jnp.log(p.R))
+    shared = -0.5 * (N * _LOG2PI + ldR + c2)          # (T,)
+
+    k0, k1, k2 = jax.random.split(key, 3)
+    h = h_center[None, :] + spec.h0_scale * jax.random.normal(
+        k0, (M, k), dtype)
+    x = jnp.broadcast_to(p.mu0, (M, k)).astype(dtype)
+    P = jnp.broadcast_to(p.P0, (M, k, k)).astype(dtype)
+    logW = jnp.full((M,), -jnp.log(float(M)), dtype)
+
+    def step(carry, inp):
+        x, P, h, logW, key, n_rs = carry
+        y_b, t_shared = inp
+        key, kh, kr = jax.random.split(key, 3)
+        # Propagate log-vols; per-particle predicted moments.
+        h = h + spec.sigma_h * jax.random.normal(kh, (M, k), dtype)
+        x_p = x @ A.T
+        P_p = jnp.einsum("ij,mjl,kl->mik", A, P, A)
+        P_p = P_p + jnp.exp(h)[:, :, None] * I_k[None]
+        # Info-form update, batched over particles (k x k only).
+        Lp = jnp.linalg.cholesky(sym(P_p) + 1e-6 * I_k[None])
+        CL = jnp.einsum("kl,mln->mkn", C, Lp)
+        Gm = I_k[None] + jnp.einsum("mlk,mln->mkn", Lp, CL)
+        Lg = jnp.linalg.cholesky(Gm)
+        LpT = jnp.swapaxes(Lp, -1, -2)
+        P_f = jnp.einsum("mkl,mln->mkn",
+                         Lp, jax.scipy.linalg.cho_solve((Lg, True), LpT))
+        P_f = sym(P_f)
+        u = y_b[None, :] - x_p @ C.T                  # (M, k)
+        x_f = x_p + jnp.einsum("mkl,ml->mk", P_f, u)
+        logdetG = 2.0 * jnp.sum(
+            jnp.log(jnp.diagonal(Lg, axis1=-2, axis2=-1)), axis=-1)
+        # Particle-dependent loglik pieces (shared terms cancel in weights).
+        quad_p = (-2.0 * (x_p @ y_b) + jnp.einsum("mk,kl,ml->m", x_p, C, x_p)
+                  - jnp.einsum("mk,mkl,ml->m", u, P_f, u))
+        lw = -0.5 * (logdetG + quad_p)
+        tot = logW + lw
+        mx = jnp.max(tot)
+        ll_rel = mx + jnp.log(jnp.sum(jnp.exp(tot - mx)))
+        ll_t = ll_rel + t_shared
+        logW = tot - ll_rel                           # normalized
+        ess = 1.0 / jnp.sum(jnp.exp(2.0 * logW))
+
+        def do_resample(args):
+            x_f, P_f, h, logW, kr = args
+            idx = _systematic_indices(logW, kr)
+            return (x_f[idx], P_f[idx], h[idx],
+                    jnp.full((M,), -jnp.log(float(M)), dtype), 1)
+
+        def no_resample(args):
+            x_f, P_f, h, logW, _ = args
+            return x_f, P_f, h, logW, 0
+
+        x_f, P_f, h, logW, did = lax.cond(
+            ess < spec.ess_frac * M, do_resample, no_resample,
+            (x_f, P_f, h, logW, kr))
+        # Weighted filtered means BEFORE resampling would be ideal; after
+        # resampling weights are uniform so the gathered mean is identical.
+        W = jnp.exp(logW)
+        f_mean = W @ x_f
+        h_mean = W @ h
+        return ((x_f, P_f, h, logW, key, n_rs + did),
+                (ll_t, f_mean, h_mean, ess))
+
+    (carry, (lls, f_mean, h_mean, ess)) = lax.scan(
+        step, (x, P, h, logW, k1, 0), (B, shared))
+    return SVResult(loglik=jnp.sum(lls), f_mean=f_mean, h_mean=h_mean,
+                    ess=ess, n_resamples=carry[5])
+
+
+def sv_filter(Y, p: SSMParams, spec: SVSpec,
+              key: Optional[jax.Array] = None,
+              h_center: Optional[jax.Array] = None) -> SVResult:
+    """Rao-Blackwellized particle Kalman filter for the SV-DFM.
+
+    ``p`` supplies (Lam, A, R, mu0, P0); the factor-innovation covariance is
+    NOT p.Q but diag(exp(h_t)) with h_0 ~ N(h_center, h0_scale^2 I) — pass
+    ``h_center=log(diag(Q_hat))`` from a standard EM pre-fit (default).
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    dtype = Y.dtype
+    p = p.astype(dtype)
+    if h_center is None:
+        h_center = jnp.log(jnp.clip(jnp.diagonal(p.Q), 1e-8, None))
+    return _sv_filter_impl(Y, p, jnp.asarray(h_center, dtype), key, spec)
+
+
+@dataclasses.dataclass
+class SVFit:
+    params: object               # cpu_ref.SSMParams from the EM pre-fit
+    result: SVResult
+    vol_paths: np.ndarray        # (T, k) E[exp(h_t/2)] proxy: exp(h_mean/2)
+    loglik: float
+
+
+def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
+           key: Optional[jax.Array] = None, backend: str = "tpu",
+           standardize: bool = True) -> SVFit:
+    """Two-stage estimation (standard for RBPF SV models):
+
+    1. EM pre-fit of the homoskedastic DFM (Lam, A, Q, R) — info-form path.
+    2. RBPF over log-vol paths with h centered on log diag(Q_hat), yielding
+       the SV marginal likelihood, filtered factors, and vol paths.
+    """
+    from ..api import DynamicFactorModel, fit as _fit
+    from ..ssm.params import SSMParams as JP
+    model = DynamicFactorModel(n_factors=spec.n_factors,
+                               standardize=standardize)
+    pre = _fit(model, Y, backend=backend, max_iters=em_iters)
+    Yz = np.asarray(Y, np.float64)
+    if pre.standardizer is not None:
+        Yz = pre.standardizer.transform(Yz)
+    dtype = (jnp.float64 if jax.config.jax_enable_x64
+             and jax.default_backend() == "cpu" else jnp.float32)
+    pj = JP.from_numpy(pre.params, dtype=dtype)
+    res = sv_filter(jnp.asarray(Yz, dtype), pj, spec, key=key)
+    return SVFit(params=pre.params, result=res,
+                 vol_paths=np.exp(0.5 * np.asarray(res.h_mean, np.float64)),
+                 loglik=float(res.loglik))

@@ -1,0 +1,90 @@
+"""SV-DFM / Rao-Blackwellized particle filter tests (SURVEY.md section 4.2.6).
+
+Key oracle: in the degenerate limit sigma_h = 0, h0_scale = 0 every particle
+carries the same h path, so the RBPF log-likelihood must equal the EXACT
+Kalman loglik of the homoskedastic model with Q = diag(exp(h_center)) — a
+whole-pipeline equality, not a statistical approximation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.models.sv import SVSpec, sv_filter, sv_fit
+from dfm_tpu.ssm.params import SSMParams as JP
+from dfm_tpu.utils import dgp
+
+
+def test_rbpf_equals_kf_in_linear_gaussian_limit():
+    rng = np.random.default_rng(41)
+    k = 3
+    p = dgp.dfm_params(20, k, rng)
+    Y, _ = dgp.simulate(p, 80, rng)
+    spec = SVSpec(n_factors=k, n_particles=8, sigma_h=0.0, h0_scale=0.0)
+    # h pinned at log diag(Q): the conditional model has Q_t = diag(diag(Q)).
+    pj = JP.from_numpy(p, jnp.float64)
+    res = sv_filter(jnp.asarray(Y), pj, spec, key=jax.random.PRNGKey(1))
+    p_diag = cpu_ref.SSMParams(p.Lam, p.A, np.diag(np.diag(p.Q)), p.R,
+                               p.mu0, p.P0)
+    ll_kf = cpu_ref.kalman_filter(Y, p_diag).loglik
+    assert abs(float(res.loglik) - ll_kf) < 1e-7 * abs(ll_kf)
+
+
+def test_rbpf_loglik_converges_with_particles():
+    """With vol randomness on, the PF loglik estimate should approach the
+    exact KF loglik as M grows when the DGP is actually homoskedastic."""
+    rng = np.random.default_rng(42)
+    k = 2
+    p = dgp.dfm_params(15, k, rng)
+    Y, _ = dgp.simulate(p, 60, rng)
+    p_diag = cpu_ref.SSMParams(p.Lam, p.A, np.diag(np.diag(p.Q)), p.R,
+                               p.mu0, p.P0)
+    ll_kf = cpu_ref.kalman_filter(Y, p_diag).loglik
+    pj = JP.from_numpy(p, jnp.float64)
+    errs = []
+    for M in (16, 256):
+        spec = SVSpec(n_factors=k, n_particles=M, sigma_h=0.03,
+                      h0_scale=0.05)
+        res = sv_filter(jnp.asarray(Y), pj, spec, key=jax.random.PRNGKey(2))
+        errs.append(abs(float(res.loglik) - ll_kf) / abs(ll_kf))
+    assert errs[1] < errs[0] + 1e-4, errs
+    assert errs[1] < 5e-3, errs
+
+
+def test_rbpf_deterministic_given_key():
+    rng = np.random.default_rng(43)
+    p = dgp.dfm_params(10, 2, rng)
+    Y, _ = dgp.simulate(p, 40, rng)
+    pj = JP.from_numpy(p, jnp.float64)
+    spec = SVSpec(n_factors=2, n_particles=64, sigma_h=0.1)
+    r1 = sv_filter(jnp.asarray(Y), pj, spec, key=jax.random.PRNGKey(7))
+    r2 = sv_filter(jnp.asarray(Y), pj, spec, key=jax.random.PRNGKey(7))
+    assert float(r1.loglik) == float(r2.loglik)
+    r3 = sv_filter(jnp.asarray(Y), pj, spec, key=jax.random.PRNGKey(8))
+    assert float(r1.loglik) != float(r3.loglik)
+
+
+def test_rbpf_tracks_volatility():
+    rng = np.random.default_rng(44)
+    k = 1
+    Y, F, H, p = dgp.simulate_sv(40, 400, k, rng, vol_walk_scale=0.15)
+    pj = JP.from_numpy(p, jnp.float64)
+    spec = SVSpec(n_factors=k, n_particles=512, sigma_h=0.15, h0_scale=0.3)
+    res = sv_filter(jnp.asarray(Y), pj, spec, key=jax.random.PRNGKey(3))
+    h_est = np.asarray(res.h_mean)[:, 0]
+    corr = np.corrcoef(h_est[50:], H[50:, 0])[0, 1]
+    assert corr > 0.5, corr
+    assert np.all(np.asarray(res.ess) >= 1.0)
+    assert int(res.n_resamples) > 0
+
+
+def test_sv_fit_two_stage_runs():
+    rng = np.random.default_rng(45)
+    Y, F, H, _ = dgp.simulate_sv(25, 120, 2, rng)
+    fitres = sv_fit(Y, SVSpec(n_factors=2, n_particles=128), em_iters=5,
+                    backend="cpu", key=jax.random.PRNGKey(4))
+    assert np.isfinite(fitres.loglik)
+    assert fitres.vol_paths.shape == (120, 2)
+    assert np.all(fitres.vol_paths > 0)
