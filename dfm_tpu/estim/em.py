@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from ..ops.linalg import sym, solve_psd
 from ..ssm.kalman import kalman_filter, rts_smoother
 from ..ssm.info_filter import info_filter
+from ..ssm.parallel_filter import pit_filter, pit_smoother
 from ..ssm.params import SSMParams, SmootherResult
 
 __all__ = ["EMConfig", "em_step", "em_fit", "em_fit_scan", "run_em_loop",
@@ -29,9 +30,11 @@ __all__ = ["EMConfig", "em_step", "em_fit", "em_fit_scan", "run_em_loop",
 class EMConfig:
     """Static EM switches (hashable -> usable as a jit static argument).
 
-    filter: "dense" (N x N innovation covariance — small-N oracle path) or
-            "info" (information form, k x k scan — the N-scalable TPU path,
-            see ``ssm.info_filter``).
+    filter: "dense" (N x N innovation covariance — small-N oracle path),
+            "info" (information form, k x k sequential scan — the N-scalable
+            TPU path, see ``ssm.info_filter``), or "pit" (parallel-in-time
+            associative scan for both filter and smoother, see
+            ``ssm.parallel_filter`` — the T-scalable TPU path).
     """
     estimate_A: bool = True
     estimate_Q: bool = True
@@ -40,7 +43,11 @@ class EMConfig:
     filter: str = "dense"
 
     def filter_fn(self):
-        return {"dense": kalman_filter, "info": info_filter}[self.filter]
+        return {"dense": kalman_filter, "info": info_filter,
+                "pit": pit_filter}[self.filter]
+
+    def smoother_fn(self):
+        return pit_smoother if self.filter == "pit" else rts_smoother
 
 
 def moments(sm: SmootherResult):
@@ -121,7 +128,7 @@ def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig):
 def _em_step_impl(Y, mask, p: SSMParams, cfg: EMConfig, has_mask: bool):
     m = mask if has_mask else None
     kf = cfg.filter_fn()(Y, p, mask=m)
-    sm = rts_smoother(kf, p)
+    sm = cfg.smoother_fn()(kf, p)
     p_new = _m_step(Y, m, sm, p, cfg)
     return p_new, kf.loglik
 
@@ -185,7 +192,7 @@ def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
 
     def body(p, _):
         kf = cfg.filter_fn()(Y, p, mask=m)
-        sm = rts_smoother(kf, p)
+        sm = cfg.smoother_fn()(kf, p)
         return _m_step(Y, m, sm, p, cfg), kf.loglik
 
     return jax.lax.scan(body, p0, None, length=n_iters)

@@ -1,0 +1,109 @@
+"""Work-efficient blocked prefix scan for expensive element algebras.
+
+``lax.associative_scan`` has log-depth but does ~2T combine invocations, and
+for the Kalman element algebra each combine carries several k x k solves —
+measured SLOWER than the plain sequential scan at T=500, k=10 on TPU v5 lite
+(the sequential scan's cost is per-step dispatch overhead, not FLOPs).
+
+``blocked_scan`` instead does S + B sequential steps (T = S*B) where every
+step's combine is BATCHED over the B blocks:
+
+  phase 1  within-block inclusive prefixes — lax.scan over S, batch B
+  phase 2  inclusive prefix of the B block products — lax.scan over B
+  phase 3  one batched combine applying block offsets to phase-1 results
+
+With S ~ sqrt(T) the sequential depth drops from T to ~2*sqrt(T) while every
+remaining step amortizes its dispatch overhead over B lanes.  Exact (same
+element algebra, associativity only) — equivalence with both the sequential
+and the associative_scan paths is tested.
+
+``combine(a, b)`` must accept arbitrary leading batch dims and compose a
+(earlier in sequence) with b (later).  For reverse=True the array is flipped
+and combine is called as combine(later, earlier) — matching the convention
+``lax.associative_scan(..., reverse=True)`` uses, so the same combine works
+for both this and the associative path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["blocked_scan"]
+
+
+def _take(tree, idx):
+    return jax.tree.map(lambda x: x[idx], tree)
+
+
+def _flip(tree):
+    return jax.tree.map(lambda x: jnp.flip(x, axis=0), tree)
+
+
+def blocked_scan(combine: Callable, elems, block_size: int | None = None,
+                 reverse: bool = False):
+    """Inclusive prefix (suffix if reverse) products of ``elems`` under
+    ``combine``; leading axis is the sequence axis."""
+    T = jax.tree.leaves(elems)[0].shape[0]
+    if reverse:
+        out = blocked_scan(combine, _flip(elems), block_size, reverse=False)
+        return _flip(out)
+    if block_size is None:
+        block_size = max(1, int(math.sqrt(T)))
+    S = min(block_size, T)
+    B = T // S
+    T0 = B * S
+
+    main = jax.tree.map(
+        lambda x: jnp.moveaxis(x[:T0].reshape((B, S) + x.shape[1:]), 0, 1),
+        elems)                                    # (S, B, ...)
+
+    def step(carry, es):
+        new = combine(carry, es)
+        return new, new
+
+    init = _take(main, 0)                         # (B, ...)
+    if S > 1:
+        _, rest = lax.scan(step, init, _take(main, slice(1, None)))
+        within = jax.tree.map(
+            lambda a, b: jnp.concatenate([a[None], b], axis=0), init, rest)
+    else:
+        within = jax.tree.map(lambda x: x[None], init)   # (S, B, ...)
+
+    # Phase 2: inclusive prefix over the B block products.
+    products = _take(within, S - 1)               # (B, ...)
+    first = _take(products, 0)
+    if B > 1:
+        _, incl_rest = lax.scan(step, first, _take(products, slice(1, None)))
+        offsets = jax.tree.map(
+            lambda a, b: jnp.concatenate([a[None], b], axis=0),
+            first, incl_rest)                     # (B, ...) inclusive
+        # Phase 3: offset blocks 1..B-1 with the product of all earlier blocks.
+        off = jax.tree.map(lambda x: x[:-1], offsets)          # (B-1, ...)
+        off_b = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[:, None],
+                                       (B - 1, S) + x.shape[1:]), off)
+        tail_blocks = jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1)[1:],
+                                   within)        # (B-1, S, ...)
+        combined = combine(off_b, tail_blocks)
+        full = jax.tree.map(
+            lambda w, c: jnp.concatenate(
+                [jnp.moveaxis(w, 0, 1)[:1], c], axis=0).reshape(
+                    (T0,) + w.shape[2:]),
+            within, combined)
+    else:
+        full = jax.tree.map(
+            lambda w: jnp.moveaxis(w, 0, 1).reshape((T0,) + w.shape[2:]),
+            within)
+
+    if T0 < T:
+        # Sequential tail for the remainder (< S elements).
+        carry0 = _take(full, T0 - 1)
+        _, tail = lax.scan(step, carry0, _take(elems, slice(T0, None)))
+        full = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), full, tail)
+    return full

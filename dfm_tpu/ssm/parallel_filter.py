@@ -1,0 +1,220 @@
+"""Parallel-in-time Kalman filtering/smoothing via ``lax.associative_scan``.
+
+The sequential T-step scan is the wall-clock floor of the whole framework
+(SURVEY.md section 7.2 item 3): 500-1000 dependent k x k steps leave the TPU
+idle.  Bayesian filtering is associative (Sarkka & Garcia-Fernandez,
+"Temporal Parallelization of Bayesian Smoothers", IEEE TAC 2021 —
+PAPERS.md:6): each step is an element of a semigroup whose product yields the
+filtered posterior, so the T-fold product runs as a log2(T)-depth prefix scan
+of BATCHED k x k algebra — exactly what the TPU wants.
+
+Filtering element a_t = (A, b, C, eta, J); combination (i earlier, j later):
+
+    D   = (I + C_i J_j)^{-1}
+    A   = A_j D A_i
+    b   = A_j D (b_i + C_i eta_j) + b_j
+    C   = A_j D C_i A_j' + C_j
+    E   = (I + J_j C_i)^{-1}
+    eta = A_i' E (eta_j - J_j b_i) + eta_i
+    J   = A_i' E J_j A_i + J_i
+
+After the inclusive prefix product, (b_t, C_t) ARE the filtered moments.
+
+The elements are initialized from the same information-form observation
+statistics as the sequential path (ObsStats; per-t C_t, b_t) via push-through
+identities so nothing N x N is ever formed:
+
+    A_t = (I + Q C_t)^{-1} F            b_t = Q (I + C_t Q)^{-1} bobs_t
+    C_t = (I + Q C_t)^{-1} Q            eta_t = F' (I + C_t Q)^{-1} bobs_t
+    J_t = F' (I + C_t Q)^{-1} C_t F
+
+(t=0 uses P0/mu0 with A_0 = 0.)  The log-likelihood is then assembled with
+zero sequential steps: predicted moments are one batched matmul off the
+filtered ones, and the Woodbury quadratic reuses the cancellation-free
+residual pass of ``info_filter``.
+
+The RTS smoother parallelizes the same way with affine elements
+(E, g, L): E = E_i E_j, g = E_i g_j + g_i, L = E_i L_j E_i' + L_i under a
+reverse prefix product.
+
+Equivalence with the sequential scans is tested to fp tolerance; the EM
+wrapper selects this path with ``EMConfig(filter="pit")``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.linalg import sym, psd_cholesky, chol_solve, chol_logdet
+from ..ops.scan import blocked_scan
+from .info_filter import (ObsStats, obs_stats, loglik_terms_local,
+                          loglik_from_terms)
+from .params import SSMParams, FilterResult, SmootherResult
+
+__all__ = ["pit_filter", "pit_smoother", "pit_filter_smoother"]
+
+_LOG2PI = 1.8378770664093453
+
+
+def _filter_elements(stats: ObsStats, A, Q, mu0, P0):
+    """Batched element construction from info-form stats; all k x k."""
+    dtype = stats.b.dtype
+    T = stats.b.shape[0]
+    k = A.shape[0]
+    I_k = jnp.eye(k, dtype=dtype)
+    C_t = stats.C
+    if C_t.ndim == 2:
+        C_t = jnp.broadcast_to(C_t, (T, k, k))
+    bobs = stats.b
+
+    # Generic elements (t >= 1): push-through forms with Q.
+    M = I_k[None] + jnp.einsum("kl,tlm->tkm", Q, C_t)     # I + Q C_t
+    Minv_F = jnp.linalg.solve(M, jnp.broadcast_to(A, (T, k, k)))
+    Minv_Q = jnp.linalg.solve(M, jnp.broadcast_to(Q, (T, k, k)))
+    # (I + C Q)^{-1} b  =  solve(I + C Q, b)
+    N_ = I_k[None] + jnp.einsum("tkl,lm->tkm", C_t, Q)    # I + C_t Q
+    Ninv_b = jnp.linalg.solve(N_, bobs[..., None])[..., 0]
+    A_el = Minv_F                                          # (I+QC)^-1 F
+    b_el = jnp.einsum("kl,tl->tk", Q, Ninv_b)              # Q (I+CQ)^-1 b
+    C_el = sym(Minv_Q)                                     # (I+QC)^-1 Q
+    eta_el = jnp.einsum("lk,tl->tk", A, Ninv_b)            # F'(I+CQ)^-1 b
+    NinvC = jnp.linalg.solve(N_, C_t)
+    J_el = sym(jnp.einsum("lk,tlm,mn->tkn", A,
+                          NinvC, A))                       # F'(I+CQ)^-1 C F
+
+    # t = 0 element: posterior from the prior (mu0, P0); A_0 = 0.
+    M0 = I_k + P0 @ C_t[0]
+    b0 = mu0 + P0 @ jnp.linalg.solve(
+        I_k + C_t[0] @ P0, bobs[0] - C_t[0] @ mu0)
+    C0 = sym(jnp.linalg.solve(M0, P0))
+    A_el = A_el.at[0].set(jnp.zeros((k, k), dtype))
+    b_el = b_el.at[0].set(b0)
+    C_el = C_el.at[0].set(C0)
+    eta_el = eta_el.at[0].set(jnp.zeros((k,), dtype))
+    J_el = J_el.at[0].set(jnp.zeros((k, k), dtype))
+    return (A_el, b_el, C_el, eta_el, J_el)
+
+
+def _combine_filter(ei, ej):
+    """Associative filtering-element product (ei earlier, ej later)."""
+    Ai, bi, Ci, etai, Ji = ei
+    Aj, bj, Cj, etaj, Jj = ej
+    k = Ai.shape[-1]
+    I_k = jnp.eye(k, dtype=Ai.dtype)
+    D = I_k + Ci @ Jj if Ai.ndim == 2 else \
+        I_k[None] + jnp.einsum("...kl,...lm->...km", Ci, Jj)
+    # batched general solves (D is not symmetric).
+    AjD = jnp.linalg.solve(jnp.swapaxes(D, -1, -2),
+                           jnp.swapaxes(Aj, -1, -2))
+    AjD = jnp.swapaxes(AjD, -1, -2)                       # A_j D^{-1}
+    A = AjD @ Ai
+    b = jnp.einsum("...kl,...l->...k", AjD,
+                   bi + jnp.einsum("...kl,...l->...k", Ci, etaj)) + bj
+    C = sym(AjD @ Ci @ jnp.swapaxes(Aj, -1, -2) + Cj)
+    E = I_k + jnp.einsum("...kl,...lm->...km", Jj, Ci) if Ai.ndim > 2 \
+        else I_k + Jj @ Ci
+    AiT = jnp.swapaxes(Ai, -1, -2)
+    EinvRHS = jnp.linalg.solve(
+        E, (etaj - jnp.einsum("...kl,...l->...k", Jj, bi))[..., None])
+    eta = jnp.einsum("...kl,...l->...k", AiT, EinvRHS[..., 0]) + etai
+    EinvJjAi = jnp.linalg.solve(E, Jj @ Ai)
+    J = sym(AiT @ EinvJjAi + Ji)
+    return (A, b, C, eta, J)
+
+
+def pit_filter(Y: jax.Array, p: SSMParams,
+               mask: Optional[jax.Array] = None,
+               scan_impl: str = "blocked") -> FilterResult:
+    """Parallel-in-time information-form filter; same contract as
+    ``info_filter`` (exact loglik, predicted/filtered moments).
+
+    scan_impl: "blocked" (work-efficient sqrt(T)-depth blocked scan — the
+    fast path on TPU, see ops.scan) or "associative" (log-depth
+    lax.associative_scan — more parallelism, ~2T combines)."""
+    p = p.astype(Y.dtype)
+    stats = obs_stats(Y, p.Lam, p.R, mask=mask)
+    elems = _filter_elements(stats, p.A, p.Q, p.mu0, p.P0)
+    if scan_impl == "blocked":
+        pref = blocked_scan(_combine_filter, elems)
+    else:
+        pref = lax.associative_scan(_combine_filter, elems)
+    x_f, P_f = pref[1], pref[2]
+
+    # Predicted moments: one batched matmul off the filtered ones.
+    x_pred = jnp.concatenate([p.mu0[None], x_f[:-1] @ p.A.T], axis=0)
+    P_pred = jnp.concatenate(
+        [p.P0[None],
+         sym(jnp.einsum("ij,tjl,kl->tik", p.A, P_f[:-1], p.A) + p.Q[None])],
+        axis=0)
+
+    # Log-likelihood, zero sequential steps: batched logdet + residual pass.
+    k = p.A.shape[0]
+    T = Y.shape[0]
+    C_t = stats.C
+    if C_t.ndim == 2:
+        C_t = jnp.broadcast_to(C_t, (T, k, k))
+    Lp = psd_cholesky(P_pred)
+    G = jnp.eye(k, dtype=Y.dtype)[None] + jnp.einsum(
+        "tlk,tlm,tmn->tkn", Lp, C_t, Lp)
+    logdetG = chol_logdet(psd_cholesky(G, jitter=0.0))
+    quad_R, U = loglik_terms_local(Y, p.Lam, p.R, x_pred, mask)
+    ll = loglik_from_terms(stats, logdetG, P_f, quad_R, U)
+    return FilterResult(x_pred, P_pred, x_f, P_f, ll)
+
+
+def _smoother_elements(kf: FilterResult, A):
+    """Affine smoothing elements (E, g, L); last element anchors at T-1."""
+    T, k = kf.x_filt.shape
+    Pp_next = kf.P_pred[1:]
+    L = psd_cholesky(Pp_next)
+    APf = jnp.einsum("ij,tjk->tik", A, kf.P_filt[:-1])
+    J = jnp.swapaxes(jax.vmap(chol_solve)(L, APf), -1, -2)  # (T-1, k, k)
+    E = jnp.concatenate([J, jnp.zeros((1, k, k), J.dtype)], axis=0)
+    g_head = kf.x_filt[:-1] - jnp.einsum("tkl,tl->tk", J, kf.x_pred[1:])
+    g = jnp.concatenate([g_head, kf.x_filt[-1:]], axis=0)
+    L_head = sym(kf.P_filt[:-1]
+                 - jnp.einsum("tkl,tlm,tnm->tkn", J, Pp_next, J))
+    L_el = jnp.concatenate([L_head, kf.P_filt[-1:]], axis=0)
+    return (E, g, L_el), J
+
+
+def _combine_smoother(elater, eearlier):
+    """Compose x_t = E x_{t+1} + g + noise(L) elements.
+
+    NOTE argument order: ``lax.associative_scan(..., reverse=True)`` computes
+    r[t] = x[T-1] * ... * x[t], i.e. the EARLIER-in-time element arrives as
+    the SECOND argument (verified empirically; easy to get backwards).  The
+    earlier element is the outer map: E = E_early E_late, etc.
+    """
+    El, gl, Ll = elater
+    Ee, ge, Le = eearlier
+    E = Ee @ El
+    g = jnp.einsum("...kl,...l->...k", Ee, gl) + ge
+    L = sym(Ee @ Ll @ jnp.swapaxes(Ee, -1, -2) + Le)
+    return (E, g, L)
+
+
+def pit_smoother(kf: FilterResult, p: SSMParams,
+                 scan_impl: str = "blocked") -> SmootherResult:
+    """Parallel-in-time RTS smoother; same contract as ``rts_smoother``."""
+    dtype = kf.x_filt.dtype
+    p = p.astype(dtype)
+    T, k = kf.x_filt.shape
+    elems, J = _smoother_elements(kf, p.A)
+    if scan_impl == "blocked":
+        suf = blocked_scan(_combine_smoother, elems, reverse=True)
+    else:
+        suf = lax.associative_scan(_combine_smoother, elems, reverse=True)
+    x_sm, P_sm = suf[1], suf[2]
+    P_lag_tail = jnp.einsum("tij,tkj->tik", P_sm[1:], J)
+    P_lag = jnp.concatenate([jnp.zeros((1, k, k), dtype), P_lag_tail], axis=0)
+    return SmootherResult(x_sm, P_sm, P_lag)
+
+
+def pit_filter_smoother(Y, p, mask=None):
+    kf = pit_filter(Y, p, mask=mask)
+    return kf, pit_smoother(kf, p)

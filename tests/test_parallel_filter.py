@@ -1,0 +1,92 @@
+"""Parallel-in-time filter/smoother == sequential (SURVEY.md section 4.2.5).
+
+Covers both scan implementations (work-efficient blocked scan and
+lax.associative_scan), masked and unmasked, divisible and non-divisible T,
+plus EM-through-pit equivalence and the blocked_scan utility itself.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dfm_tpu.estim.em import EMConfig, em_fit
+from dfm_tpu.ops.scan import blocked_scan
+from dfm_tpu.ssm.info_filter import info_filter
+from dfm_tpu.ssm.kalman import rts_smoother
+from dfm_tpu.ssm.parallel_filter import pit_filter, pit_smoother
+from dfm_tpu.ssm.params import SSMParams as JP
+from dfm_tpu.utils import dgp
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(51)
+    p = dgp.dfm_params(33, 3, rng)
+    Y, _ = dgp.simulate(p, 90, rng)
+    return p, Y
+
+
+def test_blocked_scan_matches_cumulative_matmul():
+    rng = np.random.default_rng(52)
+    Ms = jnp.asarray(rng.standard_normal((23, 3, 3)) * 0.5)
+    ref = jax.lax.associative_scan(lambda a, b: a @ b, Ms)
+    for bs in (1, 4, 5, 23, 40):
+        out = blocked_scan(lambda a, b: a @ b, Ms, block_size=bs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-10, err_msg=f"bs={bs}")
+    rev_ref = jax.lax.associative_scan(lambda a, b: a @ b, Ms, reverse=True)
+    out = blocked_scan(lambda a, b: a @ b, Ms, block_size=5, reverse=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(rev_ref),
+                               atol=1e-10)
+
+
+@pytest.mark.parametrize("impl", ["blocked", "associative"])
+@pytest.mark.parametrize("masked", [False, True])
+def test_pit_filter_matches_sequential(setup, impl, masked):
+    p, Y = setup
+    pj = JP.from_numpy(p, jnp.float64)
+    mask = None
+    if masked:
+        rng = np.random.default_rng(53)
+        W = dgp.random_mask(*Y.shape, rng, 0.3)
+        W[5] = 0.0
+        mask = jnp.asarray(W)
+    kf_s = info_filter(jnp.asarray(Y), pj, mask=mask)
+    kf_p = pit_filter(jnp.asarray(Y), pj, mask=mask, scan_impl=impl)
+    assert abs(float(kf_p.loglik) - float(kf_s.loglik)) < 1e-7 * abs(
+        float(kf_s.loglik))
+    np.testing.assert_allclose(np.asarray(kf_p.x_filt),
+                               np.asarray(kf_s.x_filt), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(kf_p.P_filt),
+                               np.asarray(kf_s.P_filt), atol=1e-9)
+    sm_s = rts_smoother(kf_s, pj)
+    sm_p = pit_smoother(kf_p, pj, scan_impl=impl)
+    np.testing.assert_allclose(np.asarray(sm_p.x_sm),
+                               np.asarray(sm_s.x_sm), atol=1e-8)
+    np.testing.assert_allclose(np.asarray(sm_p.P_lag),
+                               np.asarray(sm_s.P_lag), atol=1e-8)
+
+
+def test_pit_non_divisible_lengths(setup):
+    p, _ = setup
+    rng = np.random.default_rng(54)
+    for T in (7, 29, 97):
+        Y, _ = dgp.simulate(p, T, rng)
+        pj = JP.from_numpy(p, jnp.float64)
+        kf_s = info_filter(jnp.asarray(Y), pj)
+        kf_p = pit_filter(jnp.asarray(Y), pj)
+        assert abs(float(kf_p.loglik) - float(kf_s.loglik)) < 1e-9 * abs(
+            float(kf_s.loglik)), T
+
+
+def test_em_with_pit_matches_info(setup):
+    p, Y = setup
+    from dfm_tpu.backends import cpu_ref
+    p0 = cpu_ref.pca_init((Y - Y.mean(0)) / Y.std(0), 3)
+    Yz = jnp.asarray((Y - Y.mean(0)) / Y.std(0))
+    pj = JP.from_numpy(p0, jnp.float64)
+    _, lls_i, _ = em_fit(Yz, pj, max_iters=5, cfg=EMConfig(filter="info"))
+    _, lls_p, _ = em_fit(Yz, pj, max_iters=5, cfg=EMConfig(filter="pit"))
+    np.testing.assert_allclose(np.asarray(lls_p), np.asarray(lls_i),
+                               rtol=1e-9)
