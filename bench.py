@@ -69,7 +69,9 @@ def main():
     dtype = jnp.float32
     Yj = jax.device_put(jnp.asarray(Y, dtype))
     pj = JP.from_numpy(p0, dtype=dtype)
-    cfg = EMConfig(filter="info")
+    # Steady-state accelerated E-step (exact-to-tolerance; see ssm/steady.py),
+    # overridable for A/B runs via DFM_BENCH_FILTER=info|pit|ss.
+    cfg = EMConfig(filter=os.environ.get("DFM_BENCH_FILTER", "ss"))
 
     # NOTE: jax.block_until_ready is a no-op on the axon PJRT plugin
     # (measured: returns in 0.1 ms while the program is still running);

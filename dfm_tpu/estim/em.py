@@ -32,15 +32,19 @@ class EMConfig:
 
     filter: "dense" (N x N innovation covariance — small-N oracle path),
             "info" (information form, k x k sequential scan — the N-scalable
-            TPU path, see ``ssm.info_filter``), or "pit" (parallel-in-time
+            TPU path, see ``ssm.info_filter``), "pit" (parallel-in-time
             associative scan for both filter and smoother, see
-            ``ssm.parallel_filter`` — the T-scalable TPU path).
+            ``ssm.parallel_filter``), or "ss" (steady-state accelerated —
+            ~3*tau sequential covariance steps + blocked affine mean scans,
+            see ``ssm.steady``; falls back to exact when masked/short).
     """
     estimate_A: bool = True
     estimate_Q: bool = True
     estimate_init: bool = False
     r_floor: float = 1e-6
     filter: str = "dense"
+    tau: int = 96        # steady-state horizon (filter="ss" only); raise for
+                         # very persistent factor dynamics (see ssm.steady)
 
     def filter_fn(self):
         return {"dense": kalman_filter, "info": info_filter,
@@ -48,6 +52,15 @@ class EMConfig:
 
     def smoother_fn(self):
         return pit_smoother if self.filter == "pit" else rts_smoother
+
+    def e_step(self, Y, mask, p):
+        """Filter + smoother under the configured implementation."""
+        if self.filter == "ss":
+            from ..ssm.steady import ss_filter_smoother
+            kf, sm, _ = ss_filter_smoother(Y, p, mask=mask, tau=self.tau)
+            return kf, sm
+        kf = self.filter_fn()(Y, p, mask=mask)
+        return kf, self.smoother_fn()(kf, p)
 
 
 def moments(sm: SmootherResult):
@@ -127,8 +140,7 @@ def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig):
 @partial(jax.jit, static_argnames=("cfg", "has_mask"))
 def _em_step_impl(Y, mask, p: SSMParams, cfg: EMConfig, has_mask: bool):
     m = mask if has_mask else None
-    kf = cfg.filter_fn()(Y, p, mask=m)
-    sm = cfg.smoother_fn()(kf, p)
+    kf, sm = cfg.e_step(Y, m, p)
     p_new = _m_step(Y, m, sm, p, cfg)
     return p_new, kf.loglik
 
@@ -191,8 +203,7 @@ def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
     m = mask if has_mask else None
 
     def body(p, _):
-        kf = cfg.filter_fn()(Y, p, mask=m)
-        sm = cfg.smoother_fn()(kf, p)
+        kf, sm = cfg.e_step(Y, m, p)
         return _m_step(Y, m, sm, p, cfg), kf.loglik
 
     return jax.lax.scan(body, p0, None, length=n_iters)

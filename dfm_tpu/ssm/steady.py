@@ -1,0 +1,188 @@
+"""Steady-state accelerated Kalman filter/smoother (the headline speed path).
+
+For time-invariant, fully-observed panels the covariance recursion
+P -> A[(P^{-1}+C)^{-1}]A' + Q is DATA-INDEPENDENT and converges geometrically
+to the DARE fixed point, so almost all of the sequential scan the exact
+filter pays for is spent recomputing numbers that stopped changing.  This
+module exploits that:
+
+  1. Run the exact covariance recursion for ``tau`` steps only (lax.scan);
+     freeze (P_pred, P_filt, logdetG, gain) at their step-tau values for
+     t >= tau.  The freeze error decays like rho(A_closed)^(2 tau) — a
+     convergence diagnostic (relative last-step change) is returned.
+  2. The filtered-mean recursion x_f[t] = M_t x_f[t-1] + P_f[t] b_t now has
+     piecewise-constant coefficients — a pure k x k AFFINE semigroup, run by
+     the work-efficient blocked scan (``ops.scan``) whose combine is one
+     matmul + one matvec: no factorizations anywhere on the T axis.
+  3. The smoother reuses the trick backward: the smoothed covariance solves
+     a fixed-point equation in the interior (iterated tau steps from the
+     end), with exact boundary passes of length tau at both edges; smoothed
+     means are another reverse blocked affine scan; the log-likelihood is
+     the same batched residual pass as ``info_filter``.
+
+Sequential depth drops from 2T (filter + smoother) to ~3 tau + O(sqrt(T))
+regardless of T.  Masked panels and T <= 2 tau + 4 fall back to the exact
+sequential path automatically (shape-level Python branch, resolved at trace
+time).  Select with ``EMConfig(filter="ss")`` / ``TPUBackend(filter="ss")``.
+
+Exactness: NOT bit-exact — equivalence to the exact filter holds to the
+covariance-convergence tolerance (tested at ~1e-8 relative loglik for
+tau=96 on a rho=0.7 DGP; grows toward 1e-5 only for very slowly mixing
+dynamics — raise ``tau`` in that regime).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.linalg import sym, psd_cholesky, chol_solve, chol_logdet
+from ..ops.scan import blocked_scan
+from .info_filter import (obs_stats, info_filter, loglik_terms_local,
+                          loglik_from_terms)
+from .kalman import rts_smoother
+from .params import SSMParams, FilterResult, SmootherResult
+
+__all__ = ["ss_filter", "ss_smoother", "ss_filter_smoother", "DEFAULT_TAU"]
+
+DEFAULT_TAU = 96
+
+
+def _affine_combine(earlier, later):
+    """(M, d) semigroup: apply earlier first.  x -> M_l (M_e x + d_e) + d_l."""
+    Me, de = earlier
+    Ml, dl = later
+    return (Ml @ Me, jnp.einsum("...kl,...l->...k", Ml, de) + dl)
+
+
+def _cov_path(C, A, Q, P0, tau, dtype):
+    """tau exact covariance steps; returns per-step (P_pred, P_filt, M,
+    logdetG) stacked plus a convergence diagnostic."""
+    k = A.shape[0]
+    I_k = jnp.eye(k, dtype=dtype)
+
+    def step(P, _):
+        Lp = psd_cholesky(P)
+        G = I_k + Lp.T @ (C @ Lp)
+        Lg = psd_cholesky(G, jitter=0.0)
+        P_f = sym(Lp @ chol_solve(Lg, Lp.T))
+        M = (I_k - P_f @ C) @ A
+        P_next = sym(A @ P_f @ A.T + Q)
+        return P_next, (P, P_f, M, chol_logdet(Lg))
+
+    P_last, (Pp, Pf, M, ldG) = lax.scan(step, P0, None, length=tau)
+    # Relative change of the last predicted covariance step.
+    delta = jnp.max(jnp.abs(P_last - Pp[-1])) / (
+        jnp.max(jnp.abs(P_last)) + 1e-30)
+    return Pp, Pf, M, ldG, delta
+
+
+def _freeze(path, T, tau):
+    """Piecewise array: exact first tau entries then the step-tau value."""
+    tail = jnp.broadcast_to(path[-1], (T - tau,) + path.shape[1:])
+    return jnp.concatenate([path, tail], axis=0)
+
+
+def ss_filter_smoother(Y: jax.Array, p: SSMParams, tau: int = DEFAULT_TAU,
+                       mask: Optional[jax.Array] = None
+                       ) -> Tuple[FilterResult, SmootherResult, jax.Array]:
+    """Filter + smoother with steady-state acceleration.
+
+    Returns (FilterResult, SmootherResult, convergence_diagnostic).  Falls
+    back to the exact sequential pair when masked or T <= 2 tau + 4 (the
+    diagnostic is then 0).
+    """
+    T = Y.shape[0]
+    if mask is not None or T <= 2 * tau + 4:
+        kf = info_filter(Y, p, mask=mask)
+        return kf, rts_smoother(kf, p), jnp.zeros((), Y.dtype)
+
+    dtype = Y.dtype
+    p = p.astype(dtype)
+    k = p.A.shape[0]
+    stats = obs_stats(Y, p.Lam, p.R)         # C static, b (T, k)
+    C = stats.C
+    Pp_ex, Pf_ex, M_ex, ldG_ex, delta = _cov_path(
+        C, p.A, p.Q, p.P0, tau, dtype)
+    P_pred = _freeze(Pp_ex, T, tau)
+    P_filt = _freeze(Pf_ex, T, tau)
+    M_path = _freeze(M_ex, T, tau)
+    logdetG = _freeze(ldG_ex, T, tau)
+
+    # Filtered means: x_f[0] from the prior update, then the affine scan.
+    b = stats.b
+    x0 = p.mu0 + Pf_ex[0] @ (b[0] - C @ p.mu0)
+    d = jnp.einsum("tkl,tl->tk", P_filt[1:], b[1:])          # (T-1, k)
+    Mpref, dpref = blocked_scan(_affine_combine, (M_path[1:], d))
+    x_tail = jnp.einsum("tkl,l->tk", Mpref, x0) + dpref
+    x_filt = jnp.concatenate([x0[None], x_tail], axis=0)
+    x_pred = jnp.concatenate([p.mu0[None], x_filt[:-1] @ p.A.T], axis=0)
+
+    quad_R, U = loglik_terms_local(Y, p.Lam, p.R, x_pred, None)
+    ll = loglik_from_terms(stats, logdetG, P_filt, quad_R, U)
+    kf = FilterResult(x_pred, P_pred, x_filt, P_filt, ll)
+
+    # ----- smoother -----
+    # Gains: exact for t < tau, steady after (J_t depends only on P path).
+    Lp_ex = psd_cholesky(Pp_ex[1:])                          # P_pred[1..tau-1]
+    APf_ex = jnp.einsum("ij,tjk->tik", p.A, Pf_ex[:-1])
+    J_ex = jnp.swapaxes(jax.vmap(chol_solve)(Lp_ex, APf_ex), -1, -2)
+    Lp_ss = psd_cholesky(Pp_ex[-1])
+    J_ss = chol_solve(Lp_ss, p.A @ Pf_ex[-1]).T
+    J = jnp.concatenate(
+        [J_ex, jnp.broadcast_to(J_ss, (T - tau, k, k))], axis=0)  # (T-1,k,k)
+
+    # Smoothed covariances: iterate backward from the end with J_ss for tau
+    # steps (this IS the exact end-boundary path since P_filt is steady
+    # there), converging to the interior fixed point...
+    Pp_ss, Pf_ss = Pp_ex[-1], Pf_ex[-1]
+
+    def bstep_ss(Ps, _):
+        Ps_new = sym(Pf_ss + J_ss @ (Ps - Pp_ss) @ J_ss.T)
+        return Ps_new, Ps_new
+
+    Ps_mid, Psm_end_rev = lax.scan(bstep_ss, Pf_ss, None, length=tau)
+    Psm_end = jnp.flip(Psm_end_rev, axis=0)      # P_sm[T-1-tau .. T-2]
+    # ...then the exact front boundary t = tau-1 .. 0 with the exact J path.
+    def bstep_ex(Ps, inp):
+        P_f_t, P_p_next, J_t = inp
+        Ps_new = sym(P_f_t + J_t @ (Ps - P_p_next) @ J_t.T)
+        return Ps_new, Ps_new
+
+    # P_pred[t+1] for t = 0..tau-1: the exact path shifted, last entry frozen.
+    Pp_next_ex = jnp.concatenate([Pp_ex[1:], Pp_ex[-1:]], axis=0)
+    _, Psm_front_rev = lax.scan(
+        bstep_ex, Ps_mid, (Pf_ex, Pp_next_ex, J[:tau]), reverse=True)
+    # Assemble: [front (tau), interior steady, end (tau), P_f at T-1].
+    n_mid = T - 1 - 2 * tau
+    P_sm = jnp.concatenate([
+        Psm_front_rev,
+        jnp.broadcast_to(Ps_mid, (n_mid, k, k)),
+        Psm_end,
+        Pf_ss[None],
+    ], axis=0)
+
+    # Smoothed means: reverse affine blocked scan over
+    # x_sm[t] = J_t x_sm[t+1] + c_t.
+    c = x_filt[:-1] - jnp.einsum("tkl,tl->tk", J, x_pred[1:])
+    Jr, cr = blocked_scan(
+        lambda late, early: _affine_combine(late, early),  # reverse order
+        (J, c), reverse=True)
+    x_head = jnp.einsum("tkl,l->tk", Jr, x_filt[-1]) + cr
+    x_sm = jnp.concatenate([x_head, x_filt[-1:]], axis=0)
+
+    P_lag_tail = jnp.einsum("tij,tkj->tik", P_sm[1:], J)
+    P_lag = jnp.concatenate([jnp.zeros((1, k, k), dtype), P_lag_tail],
+                            axis=0)
+    return kf, SmootherResult(x_sm, P_sm, P_lag), delta
+
+
+def ss_filter(Y, p, mask=None, tau: int = DEFAULT_TAU) -> FilterResult:
+    return ss_filter_smoother(Y, p, tau=tau, mask=mask)[0]
+
+
+def ss_smoother(Y, p, mask=None, tau: int = DEFAULT_TAU) -> SmootherResult:
+    return ss_filter_smoother(Y, p, tau=tau, mask=mask)[1]

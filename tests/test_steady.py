@@ -1,0 +1,97 @@
+"""Steady-state accelerated filter/smoother == exact (ssm/steady.py).
+
+The acceleration freezes the covariance path after tau steps; on a
+well-mixing DGP (spectral radius 0.7) the result is exact to machine
+precision, which these tests pin.  Also covers the masked/short-T fallback
+and EM-through-ss equivalence.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.estim.em import EMConfig, em_fit
+from dfm_tpu.ssm.info_filter import info_filter
+from dfm_tpu.ssm.kalman import rts_smoother
+from dfm_tpu.ssm.steady import ss_filter_smoother
+from dfm_tpu.ssm.params import SSMParams as JP
+from dfm_tpu.utils import dgp
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(61)
+    p = dgp.dfm_params(35, 3, rng)
+    Y, _ = dgp.simulate(p, 400, rng)
+    return p, Y
+
+
+def test_ss_matches_exact_filter_smoother(setup):
+    p, Y = setup
+    pj = JP.from_numpy(p, jnp.float64)
+    kf_s = info_filter(jnp.asarray(Y), pj)
+    sm_s = rts_smoother(kf_s, pj)
+    kf, sm, delta = ss_filter_smoother(jnp.asarray(Y), pj, tau=96)
+    assert float(delta) < 1e-12          # covariance path fully converged
+    assert abs(float(kf.loglik) - float(kf_s.loglik)) < 1e-9 * abs(
+        float(kf_s.loglik))
+    np.testing.assert_allclose(np.asarray(kf.x_filt),
+                               np.asarray(kf_s.x_filt), atol=1e-10)
+    np.testing.assert_allclose(np.asarray(sm.x_sm),
+                               np.asarray(sm_s.x_sm), atol=1e-10)
+    np.testing.assert_allclose(np.asarray(sm.P_sm),
+                               np.asarray(sm_s.P_sm), atol=1e-10)
+    np.testing.assert_allclose(np.asarray(sm.P_lag),
+                               np.asarray(sm_s.P_lag), atol=1e-10)
+
+
+def test_ss_fallback_short_T(setup):
+    p, _ = setup
+    rng = np.random.default_rng(62)
+    Y, _ = dgp.simulate(p, 50, rng)
+    pj = JP.from_numpy(p, jnp.float64)
+    kf, sm, delta = ss_filter_smoother(jnp.asarray(Y), pj, tau=96)
+    kf_s = info_filter(jnp.asarray(Y), pj)
+    assert float(kf.loglik) == float(kf_s.loglik)   # exact fallback
+
+
+def test_ss_fallback_masked(setup):
+    p, Y = setup
+    rng = np.random.default_rng(63)
+    W = dgp.random_mask(*Y.shape, rng, 0.2)
+    pj = JP.from_numpy(p, jnp.float64)
+    kf, sm, _ = ss_filter_smoother(jnp.asarray(Y), pj, tau=96,
+                                   mask=jnp.asarray(W))
+    kf_s = info_filter(jnp.asarray(Y), pj, mask=jnp.asarray(W))
+    assert float(kf.loglik) == float(kf_s.loglik)
+
+
+def test_em_through_ss_matches_info(setup):
+    p, Y = setup
+    Yz = (Y - Y.mean(0)) / Y.std(0)
+    p0 = cpu_ref.pca_init(Yz, 3)
+    pj = JP.from_numpy(p0, jnp.float64)
+    _, lls_i, _ = em_fit(jnp.asarray(Yz), pj, max_iters=5,
+                         cfg=EMConfig(filter="info"))
+    _, lls_s, _ = em_fit(jnp.asarray(Yz), pj, max_iters=5,
+                         cfg=EMConfig(filter="ss"))
+    np.testing.assert_allclose(np.asarray(lls_s), np.asarray(lls_i),
+                               rtol=1e-10)
+
+
+def test_ss_diagnostic_flags_slow_mixing():
+    """With near-unit-root dynamics and WEAK data (the closed-loop mixing is
+    what matters — many informative series converge the covariance fast
+    regardless of A), a small tau must be reported as unconverged rather
+    than silently returning garbage."""
+    rng = np.random.default_rng(64)
+    k = 1
+    A = 0.9995 * np.eye(k)
+    p = cpu_ref.SSMParams(0.05 * np.ones((1, k)), A, 1e-3 * np.eye(k),
+                          np.array([100.0]), np.zeros(k),
+                          5.0 * np.eye(k))
+    Y, _ = dgp.simulate(p, 300, rng)
+    pj = JP.from_numpy(p, jnp.float64)
+    _, _, delta = ss_filter_smoother(jnp.asarray(Y), pj, tau=8)
+    assert float(delta) > 1e-6, float(delta)
