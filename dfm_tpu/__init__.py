@@ -12,11 +12,15 @@ mesh).
 from .api import (DynamicFactorModel, FitResult, fit, forecast,
                   Backend, CPUBackend, TPUBackend, ShardedBackend,
                   register_backend, get_backend)
+from .estim.select import bai_ng_ic, select_n_factors, targeted_predictors
+from .estim.evaluate import oos_evaluate
 
 __version__ = "0.1.0"
 
 __all__ = [
     "DynamicFactorModel", "FitResult", "fit", "forecast",
     "Backend", "CPUBackend", "TPUBackend", "ShardedBackend",
-    "register_backend", "get_backend", "__version__",
+    "register_backend", "get_backend",
+    "bai_ng_ic", "select_n_factors", "targeted_predictors", "oos_evaluate",
+    "__version__",
 ]

@@ -289,12 +289,17 @@ def fit(model: DynamicFactorModel,
         max_iters: int = 50,
         tol: float = 1e-6,
         init: Optional[cpu_ref.SSMParams] = None,
-        callback: Optional[Callable] = None) -> FitResult:
+        callback: Optional[Callable] = None,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 10) -> FitResult:
     """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
 
     Y    : (T, N) panel; NaNs mark missing observations.
     mask : optional explicit {0,1} mask, combined with the NaN pattern.
     backend : "cpu", "tpu", a Backend instance, or a registered name.
+    checkpoint_path : if set, EM params are saved there every
+        ``checkpoint_every`` iterations (atomic npz) and a compatible
+        existing checkpoint is used as the warm start (resume).
     """
     Y = np.asarray(Y, dtype=np.float64)
     if Y.ndim != 2:
@@ -313,6 +318,11 @@ def fit(model: DynamicFactorModel,
     Wm = W if any_missing else None
     Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
 
+    if init is None and checkpoint_path is not None:
+        from .utils.checkpoint import load_checkpoint
+        ck = load_checkpoint(checkpoint_path)
+        if ck is not None and ck[0].Lam.shape == (N, model.n_factors):
+            init = ck[0]
     if init is None:
         init = cpu_ref.pca_init(Yz, model.n_factors,
                                 static=(model.dynamics == "static"), mask=Wm)
@@ -327,10 +337,18 @@ def fit(model: DynamicFactorModel,
         rec = {"iter": it, "loglik": float(ll), "secs": now - t_prev}
         t_prev = now
         history.append(rec)
+        if checkpoint_path is not None and (it + 1) % checkpoint_every == 0:
+            from .utils.checkpoint import save_checkpoint
+            save_checkpoint(checkpoint_path, p, it,
+                            [h["loglik"] for h in history])
         if callback is not None:
             callback(it, ll, p)
 
     params, lls, converged = b.run_em(Yz, Wm, init, model, max_iters, tol, _cb)
+    if checkpoint_path is not None:
+        from .utils.checkpoint import save_checkpoint
+        save_checkpoint(checkpoint_path, params, len(lls),
+                        [h["loglik"] for h in history])
     x_sm, P_sm = b.smooth(Yz, Wm, params)
     return FitResult(params=params, logliks=np.asarray(lls),
                      factors=x_sm, factor_cov=P_sm,

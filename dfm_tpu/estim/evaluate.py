@@ -1,0 +1,78 @@
+"""Pseudo out-of-sample forecast evaluation (SURVEY.md R9 / section 3.2).
+
+Expanding-window loop: re-fit on Y[:t0], forecast h steps ahead, collect
+errors at t0 + h - 1, compare against naive benchmarks.  Embarrassingly
+parallel over windows — each window's fit is an independent EM run, so the
+loop simply reuses whatever backend it is given (TPU backends amortize
+compilation across windows because shapes repeat when ``window="rolling"``;
+expanding windows re-trace per origin, which is why rolling is the default).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..api import DynamicFactorModel, fit, forecast
+
+__all__ = ["oos_evaluate", "OOSResult"]
+
+
+@dataclasses.dataclass
+class OOSResult:
+    origins: np.ndarray        # (W,) forecast origins t0 (exclusive end)
+    errors: np.ndarray         # (W, N) forecast errors at horizon h
+    rmse: np.ndarray           # (N,) per-series RMSE
+    rmse_naive: np.ndarray     # (N,) RMSE of the last-value benchmark
+    rmse_mean: np.ndarray      # (N,) RMSE of the in-sample-mean benchmark
+    horizon: int
+
+    @property
+    def rel_rmse(self) -> np.ndarray:
+        """RMSE relative to the naive last-value forecast (<1 == better)."""
+        return self.rmse / np.maximum(self.rmse_naive, 1e-300)
+
+
+def oos_evaluate(model: DynamicFactorModel, Y: np.ndarray,
+                 horizon: int = 1,
+                 n_windows: int = 20,
+                 min_train: Optional[int] = None,
+                 window: str = "rolling",
+                 backend="cpu",
+                 max_iters: int = 20,
+                 origins: Optional[Sequence[int]] = None) -> OOSResult:
+    """Pseudo-OOS evaluation of h-step DFM forecasts.
+
+    window: "rolling" keeps the train length fixed (same shapes -> one XLA
+    compile for all windows); "expanding" grows it (reference behavior).
+    """
+    Y = np.asarray(Y, np.float64)
+    T, N = Y.shape
+    if min_train is None:
+        min_train = max(40, T // 2)
+    if origins is None:
+        last = T - horizon
+        origins = np.unique(np.linspace(min_train, last, n_windows,
+                                        dtype=int))
+    else:
+        origins = np.asarray(list(origins), dtype=int)
+
+    errors = np.zeros((len(origins), N))
+    naive = np.zeros((len(origins), N))
+    meanb = np.zeros((len(origins), N))
+    for w, t0 in enumerate(origins):
+        lo = max(0, t0 - min_train) if window == "rolling" else 0
+        Ytr = Y[lo:t0]
+        res = fit(model, Ytr, backend=backend, max_iters=max_iters)
+        y_hat, _ = forecast(res, horizon)
+        truth = Y[t0 + horizon - 1]
+        errors[w] = truth - y_hat[-1]
+        naive[w] = truth - Ytr[-1]
+        meanb[w] = truth - Ytr.mean(0)
+    rmse = np.sqrt((errors ** 2).mean(0))
+    return OOSResult(origins=np.asarray(origins), errors=errors, rmse=rmse,
+                     rmse_naive=np.sqrt((naive ** 2).mean(0)),
+                     rmse_mean=np.sqrt((meanb ** 2).mean(0)),
+                     horizon=horizon)

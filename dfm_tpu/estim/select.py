@@ -1,0 +1,140 @@
+"""Factor-number selection and targeted predictors (SURVEY.md R7/R8).
+
+Bai-Ng (2002) information criteria choose the number of factors from the
+PCA residual variance profile (one SVD gives every k at once); Bai-Ng
+(2008)-style targeted predictors pre-select the series entering factor
+extraction with an elastic-net regression on a forecast target.
+
+Both are small host-side model-selection utilities — NumPy float64, run once
+before the device path starts (same placement as data prep, SURVEY.md R2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["bai_ng_ic", "select_n_factors", "lasso_path",
+           "targeted_predictors"]
+
+
+@dataclasses.dataclass
+class ICResult:
+    k_icp1: int
+    k_icp2: int
+    k_icp3: int
+    icp1: np.ndarray    # (k_max + 1,) criterion values, index = k
+    icp2: np.ndarray
+    icp3: np.ndarray
+    V: np.ndarray       # residual variance profile V(k)
+
+    @property
+    def k_best(self) -> int:
+        """ICp2 is the standard conservative default."""
+        return self.k_icp2
+
+
+def bai_ng_ic(Y: np.ndarray, k_max: int = 15) -> ICResult:
+    """Bai-Ng (2002) ICp1-3 over k = 0..k_max from one SVD.
+
+    Y must be standardized (T, N).  V(k) = (1/NT) sum of squared PCA
+    residuals with k factors = (1/NT) * sum_{j>k} s_j^2.
+    """
+    Y = np.asarray(Y, np.float64)
+    T, N = Y.shape
+    k_max = int(min(k_max, min(T, N) - 1))
+    s = np.linalg.svd(Y, compute_uv=False)
+    total = np.sum(s ** 2)
+    tail = total - np.cumsum(np.concatenate([[0.0], s[: k_max] ** 2]))
+    V = tail / (N * T)                                 # V(0..k_max)
+    ks = np.arange(k_max + 1)
+    NT = N * T
+    c1 = (N + T) / NT * np.log(NT / (N + T))
+    m = min(N, T)
+    c2 = (N + T) / NT * np.log(m)
+    c3 = np.log(m) / m
+    logV = np.log(np.maximum(V, 1e-300))
+    icp1 = logV + ks * c1
+    icp2 = logV + ks * c2
+    icp3 = logV + ks * c3
+    return ICResult(int(np.argmin(icp1)), int(np.argmin(icp2)),
+                    int(np.argmin(icp3)), icp1, icp2, icp3, V)
+
+
+def select_n_factors(Y: np.ndarray, k_max: int = 15,
+                     criterion: str = "icp2") -> int:
+    """Convenience wrapper; criterion in {'icp1','icp2','icp3'}."""
+    res = bai_ng_ic(Y, k_max=k_max)
+    return {"icp1": res.k_icp1, "icp2": res.k_icp2,
+            "icp3": res.k_icp3}[criterion]
+
+
+def lasso_path(X: np.ndarray, y: np.ndarray, lam: float,
+               alpha: float = 1.0, max_iters: int = 500,
+               tol: float = 1e-8) -> np.ndarray:
+    """Elastic-net coefficients by cyclic coordinate descent.
+
+    Minimizes (1/2T)||y - X b||^2 + lam*(alpha*|b|_1 + (1-alpha)/2*|b|_2^2).
+    X is assumed column-standardized.  Small, dependency-free — the
+    reference used a GLMNet binding for this role [SURVEY.md R8].
+    """
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.float64)
+    T, N = X.shape
+    b = np.zeros(N)
+    col_sq = (X ** 2).sum(0) / T + lam * (1.0 - alpha)
+    r = y.copy()
+    for _ in range(max_iters):
+        max_delta = 0.0
+        for j in range(N):
+            bj_old = b[j]
+            rho = X[:, j] @ r / T + col_sq[j] * bj_old - lam * (
+                1.0 - alpha) * bj_old
+            bj = np.sign(rho) * max(abs(rho) - lam * alpha, 0.0) / col_sq[j]
+            if bj != bj_old:
+                r -= X[:, j] * (bj - bj_old)
+                b[j] = bj
+                max_delta = max(max_delta, abs(bj - bj_old))
+        if max_delta < tol:
+            break
+    return b
+
+
+def targeted_predictors(Y: np.ndarray, target: np.ndarray,
+                        horizon: int = 1, lam: Optional[float] = None,
+                        n_keep: Optional[int] = None,
+                        alpha: float = 0.9) -> np.ndarray:
+    """Indices of series worth extracting factors from, for a given target.
+
+    Regresses target_{t+h} on the panel at t with an elastic net; keeps the
+    series with nonzero coefficients (or the top ``n_keep`` by |coef|).  If
+    ``lam`` is None a small grid is scanned and the sparsest solution
+    keeping >= max(10, N/10) series is used.
+    """
+    Y = np.asarray(Y, np.float64)
+    target = np.asarray(target, np.float64)
+    T, N = Y.shape
+    X = Y[: T - horizon]
+    yv = target[horizon:]
+    X = (X - X.mean(0)) / np.maximum(X.std(0), 1e-12)
+    yv = (yv - yv.mean()) / max(yv.std(), 1e-12)
+    min_keep = max(10, N // 10)
+    if lam is not None:
+        lams = [lam]
+    else:
+        lam_max = np.max(np.abs(X.T @ yv)) / len(yv)
+        lams = [lam_max * f for f in (0.5, 0.2, 0.1, 0.05, 0.02, 0.01)]
+    last = None
+    for l in lams:
+        b = lasso_path(X, yv, l, alpha=alpha)
+        nz = np.flatnonzero(b != 0.0)
+        last = (b, nz)
+        if len(nz) >= min_keep:
+            break
+    b, nz = last
+    if n_keep is not None:
+        order = np.argsort(-np.abs(b))
+        return np.sort(order[:n_keep])
+    return nz if len(nz) else np.arange(N)

@@ -1,0 +1,53 @@
+"""EM checkpoint/resume (SURVEY.md section 5, checkpoint row).
+
+EM state is a small pytree (Lam, A, Q, R, mu0, P0) plus the loglik history
+and iteration counter — ``numpy.savez`` is the right tool (orbax would be
+overkill for kilobytes of dense arrays; no sharded state ever needs saving
+because params are replicated or trivially gatherable).  ``api.fit`` wires
+this up via ``checkpoint_path`` / ``checkpoint_every`` and resumes
+automatically from a compatible checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..backends.cpu_ref import SSMParams
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+_FIELDS = ("Lam", "A", "Q", "R", "mu0", "P0")
+
+
+def save_checkpoint(path: str, params, it: int, logliks) -> None:
+    """Atomic write (tmp + rename) of EM state."""
+    arrays = {f: np.asarray(getattr(params, f), np.float64) for f in _FIELDS}
+    arrays["iter"] = np.asarray(it)
+    arrays["logliks"] = np.asarray(logliks, np.float64)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_checkpoint(path: str) -> Optional[Tuple[SSMParams, int, np.ndarray]]:
+    """Returns (params, next_iter, logliks) or None if absent/unreadable."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            params = SSMParams(*(z[f] for f in _FIELDS))
+            return params, int(z["iter"]), np.asarray(z["logliks"])
+    except Exception:
+        return None

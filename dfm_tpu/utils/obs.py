@@ -1,0 +1,58 @@
+"""Observability helpers (SURVEY.md section 5: metrics/logging/tracing rows).
+
+``JsonlLogger`` is a fit-callback that appends per-iteration records
+(iter, loglik, dloglik, secs, iters/sec) to a JSONL file — the sink the
+bench harness consumes.  ``profile_trace`` wraps ``jax.profiler.trace`` for
+Perfetto dumps and degrades to a no-op where the profiler is unavailable
+(the axon PJRT plugin does not support every profiler hook).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Optional
+
+__all__ = ["JsonlLogger", "profile_trace"]
+
+
+class JsonlLogger:
+    """Per-iteration EM record sink: pass as ``fit(..., callback=logger)``."""
+
+    def __init__(self, path: str, extra: Optional[dict] = None):
+        self.path = path
+        self.extra = extra or {}
+        self._t_prev = time.perf_counter()
+        self._ll_prev = None
+
+    def __call__(self, it: int, loglik: float, params=None) -> None:
+        now = time.perf_counter()
+        secs = now - self._t_prev
+        self._t_prev = now
+        rec = {
+            "iter": int(it),
+            "loglik": float(loglik),
+            "dloglik": (None if self._ll_prev is None
+                        else float(loglik) - self._ll_prev),
+            "secs": secs,
+            "iters_per_sec": (1.0 / secs) if secs > 0 else None,
+            **self.extra,
+        }
+        self._ll_prev = float(loglik)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]):
+    """``with profile_trace("/tmp/trace"):`` — Perfetto trace if possible."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    try:
+        with jax.profiler.trace(log_dir):
+            yield
+    except Exception:
+        yield
