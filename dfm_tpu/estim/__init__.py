@@ -4,9 +4,11 @@ from .em import EMConfig, em_step, em_fit, em_fit_scan, run_em_loop
 from .select import (bai_ng_ic, select_n_factors, lasso_path,
                      targeted_predictors)
 from .evaluate import oos_evaluate, OOSResult
+from .diffusion import diffusion_index_forecast, DIForecast
 
 __all__ = [
     "EMConfig", "em_step", "em_fit", "em_fit_scan", "run_em_loop",
     "bai_ng_ic", "select_n_factors", "lasso_path", "targeted_predictors",
     "oos_evaluate", "OOSResult",
+    "diffusion_index_forecast", "DIForecast",
 ]

@@ -1,0 +1,65 @@
+"""Diffusion-index (factor-augmented) forecasting — SURVEY.md R9.
+
+Stock-Watson style h-step direct forecast: regress target_{t+h} on current
+factors and lags of factors/target, then apply at the end of sample.  This
+is the workhorse use of extracted factors in the reference package's
+domain; composes with ``api.fit`` (use ``FitResult.factors``) and
+``estim.select.targeted_predictors``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..utils.data import lag_matrix
+
+__all__ = ["diffusion_index_forecast", "DIForecast"]
+
+
+@dataclasses.dataclass
+class DIForecast:
+    forecast: float               # point forecast of target_{T+h}
+    coef: np.ndarray              # regression coefficients
+    fitted: np.ndarray            # in-sample fitted values
+    resid: np.ndarray
+    r2: float
+
+
+def _design(F: np.ndarray, target: np.ndarray, f_lags: int, y_lags: int):
+    """Rows t -> [1, F_t, F_{t-1}.., y_t, y_{t-1}..]; valid t range."""
+    T = len(target)
+    start = max(f_lags, y_lags)
+    cols = [np.ones((T - start, 1)), F[start:]]
+    if f_lags > 0:
+        cols.append(lag_matrix(F, f_lags)[start - f_lags:])
+    if y_lags > 0:
+        cols.append(lag_matrix(target, y_lags)[start - y_lags:])
+    return np.concatenate(cols, axis=1), start
+
+
+def diffusion_index_forecast(factors: np.ndarray, target: np.ndarray,
+                             horizon: int = 1, f_lags: int = 0,
+                             y_lags: int = 1,
+                             ridge: float = 1e-8) -> DIForecast:
+    """Direct h-step forecast target_{T+h} from factors.
+
+    factors : (T, k) estimated factor path (e.g. ``FitResult.factors``).
+    target  : (T,) series to forecast (need not be in the panel).
+    """
+    F = np.asarray(factors, np.float64)
+    y = np.asarray(target, np.float64)
+    T = len(y)
+    X_all, start = _design(F, y, f_lags, y_lags)
+    X = X_all[: T - start - horizon]
+    z = y[start + horizon:]
+    XtX = X.T @ X + ridge * np.eye(X.shape[1])
+    beta = np.linalg.solve(XtX, X.T @ z)
+    fitted = X @ beta
+    resid = z - fitted
+    r2 = 1.0 - resid.var() / max(z.var(), 1e-300)
+    x_T = X_all[-1]
+    return DIForecast(forecast=float(x_T @ beta), coef=beta,
+                      fitted=fitted, resid=resid, r2=float(r2))

@@ -1,0 +1,47 @@
+"""Factor-space alignment utilities (SURVEY.md section 4.2.3).
+
+Factor models are identified only up to an invertible k x k rotation; raw
+loadings/factors from two fits are not comparable entrywise.  These helpers
+produce the least-squares alignment map and rotation-invariant comparison
+metrics, used by recovery tests and available to users comparing fits.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+__all__ = ["procrustes", "align_factors", "factor_r2", "trace_r2"]
+
+
+def procrustes(F_hat: np.ndarray, F_ref: np.ndarray) -> np.ndarray:
+    """Orthogonal Procrustes: rotation O minimizing ||F_hat O - F_ref||_F."""
+    U, _, Vt = np.linalg.svd(np.asarray(F_hat).T @ np.asarray(F_ref),
+                             full_matrices=False)
+    return U @ Vt
+
+
+def align_factors(F_hat: np.ndarray, F_ref: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """General least-squares alignment (rotation + scale): returns
+    (F_hat @ B, B) with B = argmin ||F_hat B - F_ref||."""
+    F_hat = np.asarray(F_hat, np.float64)
+    F_ref = np.asarray(F_ref, np.float64)
+    B, *_ = np.linalg.lstsq(F_hat, F_ref, rcond=None)
+    return F_hat @ B, B
+
+
+def factor_r2(F_hat: np.ndarray, F_ref: np.ndarray) -> np.ndarray:
+    """Per-reference-factor R^2 of the aligned estimate (1 = recovered)."""
+    aligned, _ = align_factors(F_hat, F_ref)
+    resid = F_ref - aligned
+    return 1.0 - resid.var(axis=0) / np.maximum(F_ref.var(axis=0), 1e-300)
+
+
+def trace_r2(F_hat: np.ndarray, F_ref: np.ndarray) -> float:
+    """Trace R^2 (canonical-correlation style summary in [0, 1])."""
+    aligned, _ = align_factors(F_hat, F_ref)
+    num = np.sum((F_ref - aligned) ** 2)
+    den = np.sum((F_ref - F_ref.mean(0)) ** 2)
+    return float(1.0 - num / max(den, 1e-300))

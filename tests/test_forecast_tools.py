@@ -1,0 +1,56 @@
+"""Diffusion-index forecasting + factor alignment utilities."""
+
+import numpy as np
+import pytest
+
+from dfm_tpu.api import DynamicFactorModel, fit
+from dfm_tpu.estim.diffusion import diffusion_index_forecast
+from dfm_tpu.utils import dgp
+from dfm_tpu.utils.rotation import (align_factors, factor_r2, procrustes,
+                                    trace_r2)
+
+
+def test_diffusion_index_recovers_linear_map():
+    """If target_{t+1} = c + b'F_t exactly, the DI forecast is exact."""
+    rng = np.random.default_rng(81)
+    T, k = 200, 3
+    F = rng.standard_normal((T, k))
+    b = np.array([1.0, -2.0, 0.5])
+    target = np.zeros(T)
+    target[1:] = 0.3 + F[:-1] @ b
+    res = diffusion_index_forecast(F, target, horizon=1, y_lags=0)
+    assert res.r2 > 0.999999
+    expect = 0.3 + F[-1] @ b
+    assert abs(res.forecast - expect) < 1e-6
+
+
+def test_diffusion_index_with_lags_runs():
+    rng = np.random.default_rng(82)
+    p = dgp.dfm_params(25, 2, rng, spectral_radius=0.8)
+    Y, F = dgp.simulate(p, 180, rng)
+    r = fit(DynamicFactorModel(n_factors=2), Y, backend="cpu", max_iters=10)
+    res = diffusion_index_forecast(r.factors, Y[:, 0], horizon=2,
+                                   f_lags=1, y_lags=2)
+    assert np.isfinite(res.forecast)
+    assert 0.0 <= res.r2 <= 1.0
+
+
+def test_procrustes_undoes_rotation():
+    rng = np.random.default_rng(83)
+    F = rng.standard_normal((150, 3))
+    Q, _ = np.linalg.qr(rng.standard_normal((3, 3)))
+    O = procrustes(F @ Q, F)
+    np.testing.assert_allclose((F @ Q) @ O, F, atol=1e-10)
+    np.testing.assert_allclose(O, Q.T, atol=1e-10)
+
+
+def test_factor_r2_on_estimated_model():
+    rng = np.random.default_rng(84)
+    p = dgp.dfm_params(60, 2, rng, noise_scale=0.3)
+    Y, F = dgp.simulate(p, 300, rng)
+    r = fit(DynamicFactorModel(n_factors=2), Y, backend="cpu", max_iters=20)
+    r2 = factor_r2(r.factors, F)
+    assert np.all(r2 > 0.9), r2
+    assert trace_r2(r.factors, F) > 0.9
+    aligned, B = align_factors(r.factors, F)
+    assert aligned.shape == F.shape and B.shape == (2, 2)
