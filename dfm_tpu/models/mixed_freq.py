@@ -108,9 +108,24 @@ def _blocked(EffT, L, k):
     return EffT.reshape(T, L, k, L, k)
 
 
-@partial(jax.jit, static_argnames=("spec",))
-def mf_em_step(Y, mask, p: MFParams, spec: MixedFreqSpec):
-    """One constrained EM iteration.  Returns (new_params, entry loglik)."""
+def _identity_reduce(x):
+    return x
+
+
+def mf_em_core(Y, mask, p: MFParams, spec: MixedFreqSpec,
+               reduce_tree=_identity_reduce):
+    """Shared single-device / per-shard EM body.
+
+    ``spec`` describes the LOCAL series block (its n_monthly/n_quarterly are
+    per-shard counts under sharding); ``reduce_tree`` sums pytrees of
+    k-sized reductions across shards (identity on one device, psum in
+    ``parallel.sharded_mf``).  The k x k scans and dynamics M-step are
+    replicated; loading/noise rows are local — same device boundary as the
+    plain sharded EM (SURVEY.md section 3.1).
+    """
+    from ..ssm.info_filter import (obs_stats, info_scan, loglik_terms_local,
+                                   loglik_from_terms)
+    from ..ssm.params import FilterResult
     k, L = spec.n_factors, spec.n_lags
     Nm = spec.n_monthly
     dtype = Y.dtype
@@ -118,7 +133,12 @@ def mf_em_step(Y, mask, p: MFParams, spec: MixedFreqSpec):
     T = Y.shape[0]
 
     aug = augment(p, spec)
-    kf = info_filter(Y, aug, mask=mask)
+    stats = reduce_tree(obs_stats(Y, aug.Lam, aug.R, mask=mask))
+    xp, Pp, xf, Pf, logdetG = info_scan(stats, aug.A, aug.Q, aug.mu0, aug.P0)
+    quad_R, U = reduce_tree(
+        loglik_terms_local(Y, aug.Lam, aug.R, xp, mask))
+    kf = FilterResult(xp, Pp, xf, Pf,
+                      loglik_from_terms(stats, logdetG, Pf, quad_R, U))
     sm = rts_smoother(kf, aug)
 
     x, P = sm.x_sm, sm.P_sm                       # (T, m), (T, m, m)
@@ -174,7 +194,14 @@ def mf_em_step(Y, mask, p: MFParams, spec: MixedFreqSpec):
     if spec.estimate_init:
         mu0 = x[0]
         P0 = sym(P[0])
-    return MFParams(Lam_m, Lam_q, A, Q, R, mu0, P0), kf.loglik
+    return MFParams(Lam_m, Lam_q, A, Q, R, mu0, P0), kf.loglik, sm
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def mf_em_step(Y, mask, p: MFParams, spec: MixedFreqSpec):
+    """One constrained EM iteration.  Returns (new_params, entry loglik)."""
+    p_new, ll, _ = mf_em_core(Y, mask, p, spec)
+    return p_new, ll
 
 
 def mf_pca_init(Y: np.ndarray, mask: np.ndarray,
