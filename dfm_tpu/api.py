@@ -125,17 +125,26 @@ class TPUBackend(Backend):
     "highest" keeps true-f32 products (multi-pass bf16 on the MXU) and
     measured 7e-7 relative; it is the default.  Set "default" to trade
     accuracy for raw speed in benchmarks.
+
+    fused_chunk: EM iterations fused into one XLA program between host
+    round-trips.  Program dispatch costs ~60-100 ms on tunneled devices
+    (docs/PERF.md) versus <1 ms of compute per iteration, so chunking is a
+    large real-world win; the convergence check still sees every
+    iteration's loglik (the fused scan emits them all).  Callbacks fire per
+    iteration but receive the chunk-entry params (per-iter params never
+    leave the device).  Set 1 for exact per-iteration params in callbacks.
     """
 
     name = "tpu"
 
     def __init__(self, dtype=None, filter: str = "auto",
-                 matmul_precision: str = "highest"):
+                 matmul_precision: str = "highest", fused_chunk: int = 8):
         self.dtype = dtype
-        if filter not in ("auto", "dense", "info"):
+        if filter not in ("auto", "dense", "info", "ss", "pit"):
             raise ValueError(f"unknown filter {filter!r}")
         self.filter = filter
         self.matmul_precision = matmul_precision
+        self.fused_chunk = max(1, int(fused_chunk))
 
     def _filter_for(self, N: int) -> str:
         if self.filter == "auto":
@@ -157,7 +166,7 @@ class TPUBackend(Backend):
 
     def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
         import jax.numpy as jnp
-        from .estim.em import EMConfig, em_fit
+        from .estim.em import EMConfig, em_fit, em_fit_scan
         from .ssm.params import SSMParams as JaxParams
         dt = self._dtype()
         Yj = jnp.asarray(Y, dt)
@@ -168,10 +177,43 @@ class TPUBackend(Backend):
                        estimate_init=model.estimate_init,
                        filter=self._filter_for(Y.shape[1]))
         with self._precision_ctx():
-            p, lls, converged = em_fit(Yj, pj, mask=mj, cfg=cfg,
-                                       max_iters=max_iters, tol=tol,
-                                       callback=callback)
+            if self.fused_chunk <= 1:
+                p, lls, converged = em_fit(Yj, pj, mask=mj, cfg=cfg,
+                                           max_iters=max_iters, tol=tol,
+                                           callback=callback)
+                return p.to_numpy(), np.asarray(lls), converged
+            p, lls, converged = self._run_em_chunked(
+                Yj, mj, pj, cfg, max_iters, tol, callback, em_fit_scan)
         return p.to_numpy(), np.asarray(lls), converged
+
+    def _run_em_chunked(self, Yj, mj, pj, cfg, max_iters, tol, callback,
+                        em_fit_scan):
+        """Fused-chunk driver: one XLA program per ``fused_chunk`` iters."""
+        from .estim.em import em_progress, noise_floor_for
+        floor = noise_floor_for(Yj.dtype)
+        lls: list = []
+        converged = False
+        p = pj
+        it = 0
+        while it < max_iters:
+            n = min(self.fused_chunk, max_iters - it)
+            p_entry = p
+            p, chunk = em_fit_scan(Yj, p, n, mask=mj, cfg=cfg)
+            chunk = np.asarray(chunk, np.float64)
+            stop = False
+            for j, ll in enumerate(chunk):
+                lls.append(float(ll))
+                if callback is not None:
+                    callback(it + j, float(ll), p_entry)
+                state = em_progress(lls, tol, floor)
+                if state != "continue":
+                    converged = state == "converged"
+                    stop = True
+                    break
+            if stop:
+                break
+            it += n
+        return p, np.asarray(lls), converged
 
     def smooth(self, Y, mask, params):
         import jax.numpy as jnp
@@ -181,8 +223,11 @@ class TPUBackend(Backend):
         dt = self._dtype()
         Yj = jnp.asarray(Y, dt)
         mj = jnp.asarray(mask, dt) if mask is not None else None
-        ff = {"dense": kalman_filter,
-              "info": info_filter}[self._filter_for(Y.shape[1])]
+        # A single smooth is not the hot path: ss/pit fall back to the
+        # sequential info form here.
+        ff = {"dense": kalman_filter, "info": info_filter,
+              "ss": info_filter, "pit": info_filter}[
+                  self._filter_for(Y.shape[1])]
         pj = JaxParams.from_numpy(params, dtype=dt)
         with self._precision_ctx():
             kf = ff(Yj, pj, mask=mj)

@@ -297,7 +297,9 @@ def mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
         p, ll = mf_em_step(Yj, Wj, entering, spec)
         return ll, entering
 
-    lls, converged = run_em_loop(step, max_iters, tol, callback)
+    from ..estim.em import noise_floor_for
+    lls, converged = run_em_loop(step, max_iters, tol, callback,
+                                 noise_floor=noise_floor_for(dtype))
 
     aug = augment(p, spec)
     kf = info_filter(Yj, aug, mask=Wj)

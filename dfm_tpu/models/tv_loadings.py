@@ -302,7 +302,9 @@ def tvl_fit(Y: np.ndarray, spec: TVLSpec,
         state.update(Lam_t=Lam_t_new, p=p_new, F=F)
         return ll, entering
 
-    lls, converged = run_em_loop(step, spec.n_rounds, spec.tol, callback)
+    from ..estim.em import noise_floor_for
+    lls, converged = run_em_loop(step, spec.n_rounds, spec.tol, callback,
+                                 noise_floor=noise_floor_for(dtype))
 
     Lam_t = state["Lam_t"]
     F = state["F"]

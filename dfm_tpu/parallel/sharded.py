@@ -191,5 +191,7 @@ def sharded_em_fit(Y, p0, mask=None, mesh=None, cfg: EMConfig = EMConfig(),
         cb_params = drv.params_numpy(entering) if callback is not None else None
         return ll, cb_params
 
-    lls, converged = run_em_loop(step, max_iters, tol, callback)
+    from ..estim.em import noise_floor_for
+    lls, converged = run_em_loop(step, max_iters, tol, callback,
+                                 noise_floor=noise_floor_for(drv.Y.dtype))
     return drv.params_numpy(), np.asarray(lls), converged, drv

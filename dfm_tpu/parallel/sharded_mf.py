@@ -143,7 +143,9 @@ def sharded_mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
         state["sm"] = (x_sm, P_sm)
         return ll, entering
 
-    lls, converged = run_em_loop(step, max_iters, tol, callback)
+    from ..estim.em import noise_floor_for
+    lls, converged = run_em_loop(step, max_iters, tol, callback,
+                                 noise_floor=noise_floor_for(dtype))
 
     # The last step's smoother is at the pre-update params; run one more
     # E-pass at the final params for the reported factors/nowcast.
