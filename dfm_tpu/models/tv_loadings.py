@@ -44,7 +44,7 @@ from ..ssm.params import SSMParams
 from ..estim.em import run_em_loop
 
 __all__ = ["TVLSpec", "TVLParams", "tvl_fit", "TVLResult",
-           "factor_pass_tv", "loading_pass"]
+           "factor_pass_tv", "loading_pass", "tvl_round_core"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,19 +98,22 @@ def obs_stats_tv(Y, Lam_t, R, mask=None) -> ObsStats:
     return ObsStats(b, C, n, ldR)
 
 
-def factor_pass_tv(Y, Lam_t, p: TVLParams, mask=None):
+def factor_pass_tv(Y, Lam_t, p: TVLParams, mask=None,
+                   reduce_tree=lambda x: x):
     """Filter + RTS smoother over factors given loading paths.
 
     Returns (FilterResult, SmootherResult); loglik is conditional on Lam_t.
+    ``reduce_tree`` sums the series-axis reductions across shards (identity
+    on one device, psum in ``parallel.sharded_tvl``).
     """
-    stats = obs_stats_tv(Y, Lam_t, p.R, mask=mask)
+    stats = reduce_tree(obs_stats_tv(Y, Lam_t, p.R, mask=mask))
     xp, Pp, xf, Pf, logdetG = info_scan(stats, p.A, p.Q, p.mu0, p.P0)
     V = Y - jnp.einsum("tnk,tk->tn", Lam_t, xp)
     if mask is not None:
         V = mask.astype(Y.dtype) * jnp.nan_to_num(V)
     VR = V / p.R[None, :]
-    quad_R = jnp.einsum("tn,tn->t", V, VR)
-    U = jnp.einsum("tn,tnk->tk", VR, Lam_t)
+    quad_R, U = reduce_tree((jnp.einsum("tn,tn->t", V, VR),
+                             jnp.einsum("tn,tnk->tk", VR, Lam_t)))
     ll = loglik_from_terms(stats, logdetG, Pf, quad_R, U)
     kf = FilterResult(xp, Pp, xf, Pf, ll)
     dummy = SSMParams(Lam=Lam_t[0], A=p.A, Q=p.Q, R=p.R, mu0=p.mu0, P0=p.P0)
@@ -199,16 +202,21 @@ def loading_pass(Y, F, p: TVLParams, mask=None):
 # Driver
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("spec", "has_mask"))
-def _tvl_round(Y, mask, Lam_t, p: TVLParams, spec: TVLSpec, has_mask: bool):
-    """One alternation round.  Returns (Lam_t', params', loglik, F_sm)."""
-    m = mask if has_mask else None
+def tvl_round_core(Y, mask, Lam_t, p: TVLParams, spec: TVLSpec,
+                   reduce_tree=lambda x: x):
+    """One alternation round (shared single-device / per-shard body).
+
+    Returns (Lam_t', params', loglik, F_sm).  Only the A-step's k-sized
+    observation reductions cross shards; the B-step loading chains, R and
+    tau2 updates are per-series local (SURVEY.md section 2.3 layout).
+    """
+    m = mask
     dtype = Y.dtype
     T, N = Y.shape
     k = spec.n_factors
 
     # A-step: factors given loadings.
-    kf, sm = factor_pass_tv(Y, Lam_t, p, mask=m)
+    kf, sm = factor_pass_tv(Y, Lam_t, p, mask=m, reduce_tree=reduce_tree)
     F = sm.x_sm
 
     # Factor-dynamics M-bits (exact given the factor smoother).
@@ -224,7 +232,7 @@ def _tvl_round(Y, mask, Lam_t, p: TVLParams, spec: TVLSpec, has_mask: bool):
     lam_sm, P_sm_l, incr = loading_pass(Y, F, p, mask=m)
 
     # R update: conditional residuals + loading-uncertainty smear.
-    W = mask.astype(dtype) if has_mask else jnp.ones_like(Y)
+    W = mask.astype(dtype) if mask is not None else jnp.ones_like(Y)
     Yz = jnp.nan_to_num(Y) * W
     resid = Yz - W * jnp.einsum("tnk,tk->tn", lam_sm, F)
     smear = jnp.einsum("tn,tnkl,tk,tl->n", W, P_sm_l, F, F)
@@ -239,6 +247,11 @@ def _tvl_round(Y, mask, Lam_t, p: TVLParams, spec: TVLSpec, has_mask: bool):
     p_new = TVLParams(Lam0=lam_sm[0], tau2=tau2, A=A, Q=Q, R=R,
                       mu0=p.mu0, P0=p.P0)
     return lam_sm, p_new, kf.loglik, F
+
+
+@partial(jax.jit, static_argnames=("spec", "has_mask"))
+def _tvl_round(Y, mask, Lam_t, p: TVLParams, spec: TVLSpec, has_mask: bool):
+    return tvl_round_core(Y, mask if has_mask else None, Lam_t, p, spec)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,128 @@
+"""Series-sharded time-varying-loadings estimation.
+
+The TVL model shards even better than the plain DFM: the B-step's N
+independent loading chains and the R/tau2 updates are entirely shard-local
+(each device scans its own (n_local, k) random-walk chains), so per round
+the ONLY communication is the psum of the A-step's k-sized observation
+reductions — while the dominant compute, the (N, k, k) loading-covariance
+scans, splits N-ways.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..estim.em import run_em_loop, noise_floor_for
+from ..models.tv_loadings import (TVLParams, TVLResult, TVLSpec,
+                                  tvl_round_core)
+from .mesh import SERIES_AXIS, make_mesh
+
+__all__ = ["sharded_tvl_fit"]
+
+
+def _psum_tree(tree):
+    return jax.tree.map(lambda x: lax.psum(x, SERIES_AXIS), tree)
+
+
+@partial(jax.jit, static_argnames=("mesh", "spec"))
+def _sharded_tvl_round_impl(Y, W, Lam_t, Lam0, tau2, R, A, Q, mu0, P0,
+                            mesh: Mesh, spec: TVLSpec):
+    def body(Y_s, W_s, Lam_t_s, Lam0_s, tau2_s, R_s, A, Q, mu0, P0):
+        p_s = TVLParams(Lam0_s, tau2_s, A, Q, R_s, mu0, P0)
+        Lam_t_new, p_new, ll, F = tvl_round_core(
+            Y_s, W_s, Lam_t_s, p_s, spec, reduce_tree=_psum_tree)
+        return (Lam_t_new, p_new.Lam0, p_new.tau2, p_new.R,
+                p_new.A, p_new.Q, ll, F)
+
+    col = P(None, SERIES_AXIS)
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(col, col, P(None, SERIES_AXIS, None),
+                  P(SERIES_AXIS, None), P(SERIES_AXIS), P(SERIES_AXIS),
+                  P(), P(), P(), P()),
+        out_specs=(P(None, SERIES_AXIS, None), P(SERIES_AXIS, None),
+                   P(SERIES_AXIS), P(SERIES_AXIS), P(), P(), P(), P()),
+        check_vma=False)
+    return mapped(Y, W, Lam_t, Lam0, tau2, R, A, Q, mu0, P0)
+
+
+def sharded_tvl_fit(Y: np.ndarray, spec: TVLSpec,
+                    mask: Optional[np.ndarray] = None,
+                    mesh: Optional[Mesh] = None,
+                    dtype=jnp.float32, callback=None,
+                    init: Optional[TVLParams] = None) -> TVLResult:
+    """Multi-device ``tvl_fit``; mirrors its contract."""
+    from ..backends.cpu_ref import pca_init
+    from ..utils.data import build_mask
+    Y = np.asarray(Y, np.float64)
+    T, N = Y.shape
+    k = spec.n_factors
+    mesh = mesh if mesh is not None else make_mesh()
+    D = int(mesh.devices.size)
+
+    W = build_mask(Y)
+    if mask is not None:
+        W = W * np.asarray(mask, np.float64)
+    Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
+    if init is None:
+        any_missing = bool((W == 0).any())
+        p0 = pca_init(Yz, k, mask=W if any_missing else None)
+        init = TVLParams(
+            Lam0=jnp.asarray(p0.Lam), tau2=jnp.full((N,), 1e-4),
+            A=jnp.asarray(p0.A), Q=jnp.asarray(p0.Q), R=jnp.asarray(p0.R),
+            mu0=jnp.asarray(p0.mu0), P0=jnp.asarray(p0.P0))
+
+    pad = (-N) % D
+    Np = N + pad
+    if pad:
+        Yz = np.concatenate([Yz, np.zeros((T, pad))], axis=1)
+        W = np.concatenate([W, np.zeros((T, pad))], axis=1)
+    Lam0 = np.concatenate(
+        [np.asarray(init.Lam0, np.float64), np.zeros((pad, k))], axis=0)
+    tau2 = np.concatenate(
+        [np.asarray(init.tau2, np.float64), np.full(pad, 1e-4)])
+    R = np.concatenate([np.asarray(init.R, np.float64), np.ones(pad)])
+
+    state = {
+        "Y": jnp.asarray(Yz, dtype), "W": jnp.asarray(W, dtype),
+        "Lam_t": jnp.broadcast_to(jnp.asarray(Lam0, dtype), (T, Np, k)),
+        "Lam0": jnp.asarray(Lam0, dtype), "tau2": jnp.asarray(tau2, dtype),
+        "R": jnp.asarray(R, dtype),
+        "A": jnp.asarray(init.A, dtype), "Q": jnp.asarray(init.Q, dtype),
+        "mu0": jnp.asarray(init.mu0, dtype),
+        "P0": jnp.asarray(init.P0, dtype), "F": None,
+    }
+
+    def step(it):
+        out = _sharded_tvl_round_impl(
+            state["Y"], state["W"], state["Lam_t"], state["Lam0"],
+            state["tau2"], state["R"], state["A"], state["Q"],
+            state["mu0"], state["P0"], mesh, spec)
+        (state["Lam_t"], state["Lam0"], state["tau2"], state["R"],
+         state["A"], state["Q"], ll, state["F"]) = out
+        return ll, None
+
+    lls, converged = run_em_loop(step, spec.n_rounds, spec.tol, callback,
+                                 noise_floor=noise_floor_for(dtype))
+
+    Lam_t = np.asarray(state["Lam_t"], np.float64)[:, :N]
+    F = np.asarray(state["F"], np.float64)
+    common = np.einsum("tnk,tk->tn", Lam_t, F)
+    p_final = TVLParams(
+        Lam0=jnp.asarray(np.asarray(state["Lam0"], np.float64)[:N]),
+        tau2=jnp.asarray(np.asarray(state["tau2"], np.float64)[:N]),
+        A=jnp.asarray(np.asarray(state["A"], np.float64)),
+        Q=jnp.asarray(np.asarray(state["Q"], np.float64)),
+        R=jnp.asarray(np.asarray(state["R"], np.float64)[:N]),
+        mu0=jnp.asarray(np.asarray(state["mu0"], np.float64)),
+        P0=jnp.asarray(np.asarray(state["P0"], np.float64)))
+    return TVLResult(params=p_final, loadings=Lam_t, factors=F,
+                     logliks=np.asarray(lls), common=common,
+                     converged=converged, spec=spec)
