@@ -67,3 +67,42 @@ def test_graft_entry_contract():
     ll = float(out[0])
     assert np.isfinite(ll)
     ge.dryrun_multichip(min(jax.device_count(), 8))
+
+
+def _driver_env():
+    """Env as the driver sees it: none of conftest's provisioning applies."""
+    import os
+    env = dict(os.environ)
+    for k in ("XLA_FLAGS", "JAX_PLATFORMS", "_DFM_DRYRUN_CHILD"):
+        env.pop(k, None)
+    return env
+
+
+def _run_driver_style(code):
+    import os
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, env=_driver_env(),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=900)  # > the 600s inner dryrun subprocess timeout
+
+
+def test_dryrun_multichip_driver_context():
+    """The VERDICT r1 failure: plain import + dryrun, no conftest, no env.
+
+    dryrun_multichip must self-provision the 8-device CPU topology.
+    """
+    proc = _run_driver_style(
+        "import __graft_entry__ as g; g.dryrun_multichip(8)")
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "dryrun_multichip(8): ok" in proc.stdout
+
+
+def test_dryrun_multichip_after_backend_init():
+    """Backend already initialized with too few devices -> subprocess path."""
+    proc = _run_driver_style(
+        "import jax; jax.config.update('jax_platforms','cpu'); jax.devices();"
+        "import __graft_entry__ as g; g.dryrun_multichip(8)")
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "dryrun_multichip(8): ok" in proc.stdout
