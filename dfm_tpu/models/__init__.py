@@ -8,11 +8,11 @@ loadings, stochastic-volatility via particle Kalman filtering.
 from .mixed_freq import (MixedFreqSpec, MFParams, MFResult, augment,
                          mf_em_step, mf_fit, mf_pca_init)
 from .tv_loadings import TVLSpec, TVLParams, TVLResult, tvl_fit
-from .sv import SVSpec, SVResult, sv_filter, sv_fit
+from .sv import SVSpec, SVResult, SVFit, sv_filter, sv_smooth_h, sv_fit
 
 __all__ = [
     "MixedFreqSpec", "MFParams", "MFResult", "augment",
     "mf_em_step", "mf_fit", "mf_pca_init",
     "TVLSpec", "TVLParams", "TVLResult", "tvl_fit",
-    "SVSpec", "SVResult", "sv_filter", "sv_fit",
+    "SVSpec", "SVResult", "SVFit", "sv_filter", "sv_smooth_h", "sv_fit",
 ]

@@ -10,20 +10,39 @@ particle filter need only sample h (Rao-Blackwellization): each particle
 carries an EXACT Kalman state (x^m, P^m) plus its h^m, and the marginal
 likelihood increment per particle is the Kalman innovation density.
 
-TPU layout (the whole point of this implementation):
+TPU layout:
 
-  - The info-form observation reductions b_t = Lam'R^{-1}y_t (T, k) and
-    C = Lam'R^{-1}Lam (k, k) are PARTICLE-INDEPENDENT — computed once as one
-    big MXU matmul before the scan.  Per-particle, per-step work is pure
-    k x k (batched Cholesky over M particles inside a lax.scan over T).
-  - Particle WEIGHTS need only the particle-dependent loglik pieces
-    (-2 x_p.b + x_p'C x_p - u'P_f u + log|G^m|); the large shared terms
-    (n log 2pi + log|R| + y'R^{-1}y) are identical across particles, so they
-    cancel in normalized weights and are added to the total loglik outside
-    the softmax — which also sidesteps the f32 large-term cancellation that
-    the non-SV filter solves with a residual pass (info_filter docstring).
+  - The k x k info-form state update is batched over M particles inside a
+    lax.scan over T (batched Cholesky on the MXU-adjacent VPU path).
+  - Loglik / weight pieces come in two forms (``SVSpec.quad_form``):
+      * ``"residual"`` (default, cancellation-free): per-particle residuals
+        V = y_t - Lam x_p are formed explicitly and v'R^{-1}v is a sum of
+        positives — the RBPF analog of the residual pass the non-SV
+        ``info_filter`` uses (its docstring measured ~1e-3 f32 error for the
+        expanded form).  Costs one (M,k)x(k,N) + one (M,N)x(N,k) MXU matmul
+        per step.
+      * ``"expanded"`` (fast): v'R^{-1}v expanded as c2 - 2 x_p.b + x_p'Cx_p
+        with the particle-independent reductions b_t = Lam'R^{-1}y_t and
+        C = Lam'R^{-1}Lam precomputed as one big matmul.  Per-step work is
+        pure k x k, but the expansion cancels in f32 at large N, so the
+        REPORTED loglik (not the normalized weights, where shared terms
+        cancel) is only ~1e-3-accurate — use for timing runs.
+    In both forms the particle-independent constant -(n log 2pi + log|R|)/2
+    (plus -c2_t/2 in the expanded form) is added OUTSIDE the jitted scan in
+    float64 on host, and the T per-step increments are summed in float64, so
+    accumulation error does not grow with T.
   - Resampling is jit-safe systematic resampling (sorted uniform positions +
-    searchsorted + gather), triggered by ESS < M/2 through lax.cond.
+    searchsorted + gather), triggered by ESS < ess_frac * M through lax.cond.
+
+Estimation (``sv_fit``) is particle EM (a.k.a. Monte-Carlo EM):
+
+  E-step: RBPF forward pass storing the particle h-cloud and weights, then
+          FFBS (forward-filtering backward-sampling) draws smoothed h
+          trajectories using the random-walk transition density.
+  M-step: closed-form update of the per-factor vol-walk scale
+          sigma_h,j^2 = E[ (h_t,j - h_t-1,j)^2 ] over draws and steps, and of
+          the h_0 prior center.  sigma_h is a traced argument of the jitted
+          filter, so EM iterations do not recompile.
 """
 
 from __future__ import annotations
@@ -40,7 +59,8 @@ from jax import lax
 from ..ops.linalg import sym
 from ..ssm.params import SSMParams
 
-__all__ = ["SVSpec", "SVResult", "sv_filter", "sv_fit"]
+__all__ = ["SVSpec", "SVResult", "SVFit", "sv_filter", "sv_smooth_h",
+           "sv_fit"]
 
 _LOG2PI = 1.8378770664093453
 
@@ -50,16 +70,21 @@ class SVSpec:
     n_factors: int
     n_particles: int = 512
     ess_frac: float = 0.5         # resample when ESS < ess_frac * M
-    sigma_h: float = 0.1          # log-vol random-walk scale
+    sigma_h: float = 0.1          # initial log-vol random-walk scale
     h0_scale: float = 0.1         # prior std of h_0 around its center
+    quad_form: str = "residual"   # "residual" (exact) | "expanded" (fast)
+    n_smooth_draws: int = 64      # FFBS trajectories for smoothing / EM
 
 
 class SVResult(NamedTuple):
-    loglik: jax.Array             # scalar marginal loglik estimate
+    loglik: np.ndarray            # scalar marginal loglik (f64 host assembly)
     f_mean: jax.Array             # (T, k) weighted filtered factor means
     h_mean: jax.Array             # (T, k) weighted filtered log-vols
     ess: jax.Array                # (T,) effective sample size per step
     n_resamples: jax.Array        # scalar
+    h_particles: jax.Array        # (T, M, k) filtering h-cloud (post-resample)
+    logw: jax.Array               # (T, M) matching normalized log-weights
+    lls: np.ndarray               # (T,) per-step loglik increments (f64)
 
 
 def _systematic_indices(logW, key):
@@ -73,37 +98,34 @@ def _systematic_indices(logW, key):
     return jnp.clip(jnp.searchsorted(cum, pos), 0, M - 1)
 
 
-@partial(jax.jit, static_argnames=("spec",))
-def _sv_filter_impl(Y, p: SSMParams, h_center, key, spec: SVSpec):
+@partial(jax.jit, static_argnames=("k", "M", "ess_frac", "residual"))
+def _sv_filter_impl(Y, p: SSMParams, h_center, sigma_h, h0_scale, key,
+                    k: int, M: int, ess_frac: float, residual: bool):
+    # Statics are the individual shape/branch fields, NOT the whole SVSpec:
+    # sweeping spec.sigma_h (particle EM, grid profiling) must not recompile.
     dtype = Y.dtype
     T, N = Y.shape
-    k = spec.n_factors
-    M = spec.n_particles
     I_k = jnp.eye(k, dtype=dtype)
     A = p.A
 
-    # Shared (particle-independent) observation reductions — one big matmul.
     Rinv = 1.0 / p.R
-    G0 = p.Lam * Rinv[:, None]
-    B = Y @ G0                                        # (T, k)
+    G0 = p.Lam * Rinv[:, None]                        # R^{-1} Lam, (N, k)
     C = p.Lam.T @ G0                                  # (k, k)
-    c2 = jnp.einsum("tn,n,tn->t", Y, Rinv, Y)         # (T,)
-    ldR = jnp.sum(jnp.log(p.R))
-    shared = -0.5 * (N * _LOG2PI + ldR + c2)          # (T,)
+    LamT = p.Lam.T
+    B = Y @ G0                                        # (T, k)
 
-    k0, k1, k2 = jax.random.split(key, 3)
-    h = h_center[None, :] + spec.h0_scale * jax.random.normal(
-        k0, (M, k), dtype)
+    k0, k1 = jax.random.split(key)
+    h = h_center[None, :] + h0_scale * jax.random.normal(k0, (M, k), dtype)
     x = jnp.broadcast_to(p.mu0, (M, k)).astype(dtype)
     P = jnp.broadcast_to(p.P0, (M, k, k)).astype(dtype)
     logW = jnp.full((M,), -jnp.log(float(M)), dtype)
 
     def step(carry, inp):
         x, P, h, logW, key, n_rs = carry
-        y_b, t_shared = inp
+        y_t, b_t = inp
         key, kh, kr = jax.random.split(key, 3)
         # Propagate log-vols; per-particle predicted moments.
-        h = h + spec.sigma_h * jax.random.normal(kh, (M, k), dtype)
+        h = h + sigma_h[None, :] * jax.random.normal(kh, (M, k), dtype)
         x_p = x @ A.T
         P_p = jnp.einsum("ij,mjl,kl->mik", A, P, A)
         P_p = P_p + jnp.exp(h)[:, :, None] * I_k[None]
@@ -116,18 +138,25 @@ def _sv_filter_impl(Y, p: SSMParams, h_center, key, spec: SVSpec):
         P_f = jnp.einsum("mkl,mln->mkn",
                          Lp, jax.scipy.linalg.cho_solve((Lg, True), LpT))
         P_f = sym(P_f)
-        u = y_b[None, :] - x_p @ C.T                  # (M, k)
+        if residual:
+            # Cancellation-free: true residuals per particle (module docstring).
+            V = y_t[None, :] - x_p @ LamT             # (M, N)
+            VR = V * Rinv[None, :]
+            c2_p = jnp.einsum("mn,mn->m", V, VR)      # v'R^{-1}v >= 0 directly
+            u = VR @ p.Lam                            # Lam'R^{-1}v, (M, k)
+            quad = c2_p - jnp.einsum("mk,mkl,ml->m", u, P_f, u)
+        else:
+            u = b_t[None, :] - x_p @ C.T              # (M, k)
+            quad = (-2.0 * (x_p @ b_t)
+                    + jnp.einsum("mk,kl,ml->m", x_p, C, x_p)
+                    - jnp.einsum("mk,mkl,ml->m", u, P_f, u))
         x_f = x_p + jnp.einsum("mkl,ml->mk", P_f, u)
         logdetG = 2.0 * jnp.sum(
             jnp.log(jnp.diagonal(Lg, axis1=-2, axis2=-1)), axis=-1)
-        # Particle-dependent loglik pieces (shared terms cancel in weights).
-        quad_p = (-2.0 * (x_p @ y_b) + jnp.einsum("mk,kl,ml->m", x_p, C, x_p)
-                  - jnp.einsum("mk,mkl,ml->m", u, P_f, u))
-        lw = -0.5 * (logdetG + quad_p)
+        lw = -0.5 * (logdetG + quad)
         tot = logW + lw
         mx = jnp.max(tot)
         ll_rel = mx + jnp.log(jnp.sum(jnp.exp(tot - mx)))
-        ll_t = ll_rel + t_shared
         logW = tot - ll_rel                           # normalized
         ess = 1.0 / jnp.sum(jnp.exp(2.0 * logW))
 
@@ -142,30 +171,37 @@ def _sv_filter_impl(Y, p: SSMParams, h_center, key, spec: SVSpec):
             return x_f, P_f, h, logW, 0
 
         x_f, P_f, h, logW, did = lax.cond(
-            ess < spec.ess_frac * M, do_resample, no_resample,
+            ess < ess_frac * M, do_resample, no_resample,
             (x_f, P_f, h, logW, kr))
-        # Weighted filtered means BEFORE resampling would be ideal; after
-        # resampling weights are uniform so the gathered mean is identical.
+        # Weighted filtered means; after resampling weights are uniform so
+        # the gathered mean represents the same distribution.
         W = jnp.exp(logW)
         f_mean = W @ x_f
         h_mean = W @ h
         return ((x_f, P_f, h, logW, key, n_rs + did),
-                (ll_t, f_mean, h_mean, ess))
+                (ll_rel, f_mean, h_mean, ess, h, logW))
 
-    (carry, (lls, f_mean, h_mean, ess)) = lax.scan(
-        step, (x, P, h, logW, k1, 0), (B, shared))
-    return SVResult(loglik=jnp.sum(lls), f_mean=f_mean, h_mean=h_mean,
-                    ess=ess, n_resamples=carry[5])
+    (carry, (ll_rel, f_mean, h_mean, ess, h_hist, logw_hist)) = lax.scan(
+        step, (x, P, h, logW, k1, 0), (Y, B))
+    return ll_rel, f_mean, h_mean, ess, carry[5], h_hist, logw_hist
+
+
+def _as_sigma_vec(sigma_h, k, dtype):
+    s = jnp.asarray(sigma_h, dtype)
+    return jnp.broadcast_to(s, (k,)) if s.ndim == 0 else s
 
 
 def sv_filter(Y, p: SSMParams, spec: SVSpec,
               key: Optional[jax.Array] = None,
-              h_center: Optional[jax.Array] = None) -> SVResult:
+              h_center: Optional[jax.Array] = None,
+              sigma_h=None) -> SVResult:
     """Rao-Blackwellized particle Kalman filter for the SV-DFM.
 
     ``p`` supplies (Lam, A, R, mu0, P0); the factor-innovation covariance is
     NOT p.Q but diag(exp(h_t)) with h_0 ~ N(h_center, h0_scale^2 I) — pass
     ``h_center=log(diag(Q_hat))`` from a standard EM pre-fit (default).
+    ``sigma_h`` (scalar or (k,)) overrides ``spec.sigma_h`` — it is a traced
+    argument, so sweeping it (particle EM) does not recompile.
     """
     if key is None:
         key = jax.random.PRNGKey(0)
@@ -173,25 +209,98 @@ def sv_filter(Y, p: SSMParams, spec: SVSpec,
     p = p.astype(dtype)
     if h_center is None:
         h_center = jnp.log(jnp.clip(jnp.diagonal(p.Q), 1e-8, None))
-    return _sv_filter_impl(Y, p, jnp.asarray(h_center, dtype), key, spec)
+    sig = _as_sigma_vec(spec.sigma_h if sigma_h is None else sigma_h,
+                        spec.n_factors, dtype)
+    h0s = jnp.asarray(spec.h0_scale, dtype)
+    ll_rel, f_mean, h_mean, ess, n_rs, h_hist, logw_hist = _sv_filter_impl(
+        Y, p, jnp.asarray(h_center, dtype), sig, h0s, key,
+        k=spec.n_factors, M=spec.n_particles, ess_frac=spec.ess_frac,
+        residual=spec.quad_form == "residual")
+    # Host float64 assembly of the particle-independent constant and the
+    # total: no f32 accumulation error over T (module docstring).
+    T, N = Y.shape
+    R64 = np.asarray(p.R, np.float64)
+    const = -0.5 * (N * _LOG2PI + np.sum(np.log(R64)))
+    lls = np.asarray(ll_rel, np.float64) + const
+    if spec.quad_form != "residual":
+        Y64 = np.asarray(Y, np.float64)
+        lls -= 0.5 * np.einsum("tn,n,tn->t", Y64, 1.0 / R64, Y64)
+    return SVResult(loglik=np.sum(lls), f_mean=f_mean, h_mean=h_mean,
+                    ess=ess, n_resamples=n_rs, h_particles=h_hist,
+                    logw=logw_hist, lls=lls)
+
+
+@partial(jax.jit, static_argnames=("n_draws",))
+def _ffbs_impl(h_hist, logw_hist, sigma_h, key, n_draws: int):
+    T, M, k = h_hist.shape
+    dtype = h_hist.dtype
+    s2 = jnp.maximum(sigma_h.astype(dtype) ** 2, 1e-20)
+    kT, kb = jax.random.split(key)
+    g = jax.random.gumbel(kT, (n_draws, M), dtype)
+    idx = jnp.argmax(logw_hist[-1][None, :] + g, axis=1)
+    h_last = h_hist[-1][idx]                          # (S, k)
+
+    def back(h_next, inp):
+        h_t, logw_t, k_t = inp
+        d2 = jnp.sum((h_next[:, None, :] - h_t[None, :, :]) ** 2
+                     / s2[None, None, :], axis=-1)    # (S, M)
+        logbw = logw_t[None, :] - 0.5 * d2
+        g = jax.random.gumbel(k_t, logbw.shape, dtype)
+        idx = jnp.argmax(logbw + g, axis=1)
+        h_s = h_t[idx]
+        return h_s, h_s
+
+    keys = jax.random.split(kb, T - 1)
+    _, hs = lax.scan(back, h_last,
+                     (h_hist[:-1], logw_hist[:-1], keys), reverse=True)
+    return jnp.concatenate([hs, h_last[None]], axis=0)   # (T, S, k)
+
+
+def sv_smooth_h(res: SVResult, sigma_h, key, n_draws: int = 64) -> jax.Array:
+    """FFBS: draw ``n_draws`` smoothed log-vol trajectories, shape (T, S, k).
+
+    Backward weights combine the stored filtering weights with the
+    random-walk transition density N(h_{t+1}; h_t, diag(sigma_h^2));
+    sampling is jit-safe via the Gumbel-max trick.
+    """
+    k = res.h_particles.shape[-1]
+    sig = _as_sigma_vec(sigma_h, k, res.h_particles.dtype)
+    return _ffbs_impl(res.h_particles, res.logw, sig, key, n_draws)
 
 
 @dataclasses.dataclass
 class SVFit:
     params: object               # cpu_ref.SSMParams from the EM pre-fit
-    result: SVResult
-    vol_paths: np.ndarray        # (T, k) E[exp(h_t/2)] proxy: exp(h_mean/2)
+    result: SVResult             # filter output at the final SV parameters
+    vol_paths: np.ndarray        # (T, k) smoothed vol proxy exp(h_smooth/2)
     loglik: float
+    sigma_h: np.ndarray = None   # (k,) estimated vol-walk scales
+    h_center: np.ndarray = None  # (k,) estimated h_0 prior center
+    h_smooth: np.ndarray = None  # (T, k) FFBS-smoothed log-vol means
+    logliks: np.ndarray = None   # per-SV-iteration marginal logliks
 
 
 def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
            key: Optional[jax.Array] = None, backend: str = "tpu",
-           standardize: bool = True) -> SVFit:
-    """Two-stage estimation (standard for RBPF SV models):
+           standardize: bool = True, sv_iters: int = 10,
+           sv_accel: float = 3.0, estimate_sv: bool = True) -> SVFit:
+    """SV-DFM estimation (BASELINE.json:11; SURVEY.md section 3.5):
 
     1. EM pre-fit of the homoskedastic DFM (Lam, A, Q, R) — info-form path.
-    2. RBPF over log-vol paths with h centered on log diag(Q_hat), yielding
-       the SV marginal likelihood, filtered factors, and vol paths.
+    2. Particle EM for the SV law: RBPF E-step + FFBS h-trajectory draws,
+       closed-form M-step for the per-factor vol-walk scale sigma_h and the
+       h_0 center (module docstring).  ``estimate_sv=False`` reproduces the
+       old two-stage behavior (filter once at spec.sigma_h).
+
+    ``sv_accel`` over-relaxes the M-step in the log domain
+    (sigma <- sigma * (sigma_EM/sigma)^accel): plain EM for a random-walk
+    variance contracts very slowly (~0.95/iter measured on simulated data,
+    the missing-information fraction is large), and over-relaxation stays
+    convergent for accel << 2/(1-contraction) — 3.0 is far inside that and
+    was verified stable at the fixed point on simulated panels.
+
+    The marginal loglik is a particle estimate, so it is monotone only up to
+    Monte-Carlo noise; convergence is left to the fixed ``sv_iters`` budget.
     """
     from ..api import DynamicFactorModel, fit as _fit
     from ..ssm.params import SSMParams as JP
@@ -204,7 +313,60 @@ def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
     dtype = (jnp.float64 if jax.config.jax_enable_x64
              and jax.default_backend() == "cpu" else jnp.float32)
     pj = JP.from_numpy(pre.params, dtype=dtype)
-    res = sv_filter(jnp.asarray(Yz, dtype), pj, spec, key=key)
+    Yj = jnp.asarray(Yz, dtype)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    k = spec.n_factors
+    sigma = jnp.full((k,), spec.sigma_h, dtype)
+    h_center = jnp.log(jnp.clip(jnp.diagonal(pj.Q), 1e-8, None))
+    if sv_iters <= 0:
+        estimate_sv = False
+    SIGMA_FLOOR = 1e-4   # below this the model is effectively homoskedastic
+    if estimate_sv:
+        sigma = jnp.maximum(sigma, SIGMA_FLOOR)   # log-step needs sigma > 0
+
+    def e_step(key, sigma, h_center, smooth):
+        kf_, ks_ = jax.random.split(key)
+        res = sv_filter(Yj, pj, spec, key=kf_, h_center=h_center,
+                        sigma_h=sigma)
+        H = (sv_smooth_h(res, sigma, ks_, spec.n_smooth_draws)
+             if smooth else None)
+        return res, H
+
+    logliks = []
+    prev_step = None
+    for _ in range(sv_iters if estimate_sv else 1):
+        key, k_ = jax.random.split(key)
+        res, H = e_step(k_, sigma, h_center, smooth=estimate_sv)
+        logliks.append(float(res.loglik))
+        if estimate_sv:
+            dH = jnp.diff(H, axis=0)
+            sigma_em = jnp.sqrt(jnp.mean(dH ** 2, axis=(0, 1)))
+            # Over-relaxed log-domain step, with two safeguards: fall back
+            # to plain EM (accel 1) per factor when the step direction flips
+            # (over-relaxation oscillates when EM contracts fast), and floor
+            # sigma so a collapsed estimate cannot divide-by-zero or NaN.
+            step = jnp.log(jnp.maximum(sigma_em, SIGMA_FLOOR)) - jnp.log(sigma)
+            accel = (jnp.where(step * prev_step < 0, 1.0, sv_accel)
+                     if prev_step is not None else sv_accel)
+            sigma = jnp.maximum(sigma * jnp.exp(accel * step), SIGMA_FLOOR)
+            prev_step = step
+            h_center = jnp.mean(H[0], axis=0)
+    if estimate_sv:
+        # One final E-step at the returned (sigma_h, h_center), so result /
+        # loglik / h_smooth are consistent with the reported parameters.
+        key, k_ = jax.random.split(key)
+        res, H = e_step(k_, sigma, h_center, smooth=True)
+        logliks.append(float(res.loglik))
+    # Without estimation no FFBS pass runs (keeps the filter-only timing
+    # path pure); the smoothed proxy is then the filtered h mean.
+    h_smooth = np.asarray(jnp.mean(H, axis=1) if H is not None
+                          else res.h_mean, np.float64)
     return SVFit(params=pre.params, result=res,
-                 vol_paths=np.exp(0.5 * np.asarray(res.h_mean, np.float64)),
-                 loglik=float(res.loglik))
+                 vol_paths=np.exp(0.5 * h_smooth),
+                 loglik=logliks[-1],
+                 sigma_h=np.asarray(sigma, np.float64),
+                 h_center=np.asarray(h_center, np.float64),
+                 h_smooth=h_smooth,
+                 logliks=np.asarray(logliks))
