@@ -104,7 +104,7 @@ def main():
     # a device->host transfer is the only reliable execution barrier here.
     def timed_run(Yj):
         t0 = time.perf_counter()
-        _, lls = em_fit_scan(Yj, pj, n_iters, cfg=cfg)
+        _, lls, _ = em_fit_scan(Yj, pj, n_iters, cfg=cfg)
         lls = np.asarray(lls)  # forces completion
         return time.perf_counter() - t0, lls
 

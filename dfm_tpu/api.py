@@ -80,7 +80,12 @@ class FitResult:
 
 
 class Backend:
-    """Backend interface: estimate params and smooth factors."""
+    """Backend interface: estimate params and smooth factors.
+
+    ``run_em`` returns (params, logliks, converged[, params_iters]) — the
+    optional 4th element reports how many EM updates the returned params
+    embody (used for checkpoint labeling; defaults to len(logliks)).
+    """
 
     name = "abstract"
 
@@ -101,7 +106,7 @@ class CPUBackend(Backend):
             Y, p0, mask=mask, max_iters=max_iters, tol=tol,
             estimate_A=model.estimate_A, estimate_Q=model.estimate_Q,
             estimate_init=model.estimate_init, callback=callback)
-        return p, np.asarray(lls), converged
+        return p, np.asarray(lls), converged, len(lls)
 
     def smooth(self, Y, mask, params):
         kf = cpu_ref.kalman_filter(Y, params, mask=mask)
@@ -178,42 +183,76 @@ class TPUBackend(Backend):
                        filter=self._filter_for(Y.shape[1]))
         with self._precision_ctx():
             if self.fused_chunk <= 1:
-                p, lls, converged = em_fit(Yj, pj, mask=mj, cfg=cfg,
-                                           max_iters=max_iters, tol=tol,
-                                           callback=callback)
-                return p.to_numpy(), np.asarray(lls), converged
-            p, lls, converged = self._run_em_chunked(
+                p, lls, converged, p_iters = em_fit(
+                    Yj, pj, mask=mj, cfg=cfg, max_iters=max_iters, tol=tol,
+                    callback=callback)
+                return p.to_numpy(), np.asarray(lls), converged, p_iters
+            p, lls, converged, p_iters = self._run_em_chunked(
                 Yj, mj, pj, cfg, max_iters, tol, callback, em_fit_scan)
-        return p.to_numpy(), np.asarray(lls), converged
+        return p.to_numpy(), np.asarray(lls), converged, p_iters
 
     def _run_em_chunked(self, Yj, mj, pj, cfg, max_iters, tol, callback,
                         em_fit_scan):
-        """Fused-chunk driver: one XLA program per ``fused_chunk`` iters."""
-        from .estim.em import em_progress, noise_floor_for
+        """Fused-chunk driver: one XLA program per ``fused_chunk`` iters.
+
+        Callbacks receive chunk-entry params; a callback carrying
+        ``wants_params_iter = True`` (api.fit's checkpoint hook) is
+        additionally passed ``params_iter`` — the iteration those params
+        actually embody — so checkpoints are never mislabeled by up to
+        fused_chunk-1 iterations.
+        """
+        from .estim.em import em_progress, noise_floor_for, warn_ss_delta
         floor = noise_floor_for(Yj.dtype)
+        pass_piter = getattr(callback, "wants_params_iter", False)
         lls: list = []
         converged = False
+        diverged = False
+        div_j = 0
+        max_delta = 0.0
         p = pj
         it = 0
+        p_entry = p_entry_prev = pj
+        entry_it = entry_it_prev = 0
         while it < max_iters:
             n = min(self.fused_chunk, max_iters - it)
-            p_entry = p
-            p, chunk = em_fit_scan(Yj, p, n, mask=mj, cfg=cfg)
+            p_entry_prev, entry_it_prev = p_entry, entry_it
+            p_entry, entry_it = p, it
+            p, chunk, deltas = em_fit_scan(Yj, p, n, mask=mj, cfg=cfg)
             chunk = np.asarray(chunk, np.float64)
+            if cfg.filter == "ss":
+                max_delta = max(max_delta, float(np.max(np.asarray(deltas))))
             stop = False
             for j, ll in enumerate(chunk):
                 lls.append(float(ll))
                 if callback is not None:
-                    callback(it + j, float(ll), p_entry)
+                    if pass_piter:
+                        callback(it + j, float(ll), p_entry, params_iter=it)
+                    else:
+                        callback(it + j, float(ll), p_entry)
                 state = em_progress(lls, tol, floor)
                 if state != "continue":
                     converged = state == "converged"
+                    diverged = state == "diverged"
+                    div_j = j
                     stop = True
                     break
             if stop:
+                it += n
                 break
             it += n
-        return p, np.asarray(lls), converged
+        if cfg.filter == "ss":
+            warn_ss_delta(max_delta, cfg.tau)
+        p_iters = it
+        if diverged:
+            # Best available pre-divergence params (per-iter params never
+            # leave the device in the fused scan): the current chunk's entry
+            # — unless the drop was at the chunk's first loglik, which blames
+            # the PREVIOUS chunk's last update, so fall back one more chunk.
+            if div_j > 0:
+                p, p_iters = p_entry, entry_it
+            else:
+                p, p_iters = p_entry_prev, entry_it_prev
+        return p, np.asarray(lls), converged, p_iters
 
     def smooth(self, Y, mask, params):
         import jax.numpy as jnp
@@ -269,7 +308,7 @@ class ShardedBackend(TPUBackend):
                 max_iters=max_iters, tol=tol, dtype=self._dtype(),
                 callback=callback)
         self._drv, self._drv_params = drv, p
-        return p, lls, converged
+        return p, lls, converged, drv.p_iters
 
     def smooth(self, Y, mask, params):
         import jax.numpy as jnp
@@ -363,11 +402,22 @@ def fit(model: DynamicFactorModel,
     Wm = W if any_missing else None
     Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
 
+    fingerprint = None
+    done_iters = 0
+    ck = None
+    if checkpoint_path is not None:
+        from .utils.checkpoint import data_fingerprint
+        fingerprint = data_fingerprint(Y, W if any_missing else None, model)
     if init is None and checkpoint_path is not None:
         from .utils.checkpoint import load_checkpoint
-        ck = load_checkpoint(checkpoint_path)
+        ck = load_checkpoint(checkpoint_path, fingerprint=fingerprint)
         if ck is not None and ck[0].Lam.shape == (N, model.n_factors):
             init = ck[0]
+            # The stored iter counts EM iterations those params embody:
+            # resume with the remaining budget, not max_iters from scratch.
+            done_iters = ck[1]
+        else:
+            ck = None
     if init is None:
         init = cpu_ref.pca_init(Yz, model.n_factors,
                                 static=(model.dynamics == "static"), mask=Wm)
@@ -376,7 +426,7 @@ def fit(model: DynamicFactorModel,
     history: list = []
     t_prev = time.perf_counter()
 
-    def _cb(it, ll, p):
+    def _cb(it, ll, p, params_iter=None):
         nonlocal t_prev
         now = time.perf_counter()
         rec = {"iter": it, "loglik": float(ll), "secs": now - t_prev}
@@ -384,16 +434,35 @@ def fit(model: DynamicFactorModel,
         history.append(rec)
         if checkpoint_path is not None and (it + 1) % checkpoint_every == 0:
             from .utils.checkpoint import save_checkpoint
-            save_checkpoint(checkpoint_path, p, it,
-                            [h["loglik"] for h in history])
+            # p embodies `p_it` completed iterations counted from this run's
+            # start (== it except in the fused-chunk driver, which hands
+            # chunk-entry params); stored globally, offset by the resumed-in
+            # iterations.
+            p_it = it if params_iter is None else params_iter
+            save_checkpoint(checkpoint_path, p, done_iters + p_it,
+                            [h["loglik"] for h in history][:p_it],
+                            fingerprint=fingerprint)
         if callback is not None:
             callback(it, ll, p)
 
-    params, lls, converged = b.run_em(Yz, Wm, init, model, max_iters, tol, _cb)
-    if checkpoint_path is not None:
-        from .utils.checkpoint import save_checkpoint
-        save_checkpoint(checkpoint_path, params, len(lls),
-                        [h["loglik"] for h in history])
+    _cb.wants_params_iter = True
+
+    if ck is not None and done_iters >= max_iters:
+        # The checkpoint already exhausted this budget: return its state
+        # instead of creeping past max_iters one iteration per rerun.
+        params, lls, converged = init, np.asarray(ck[2]), ck[3]
+    else:
+        out = b.run_em(Yz, Wm, init, model, max_iters - done_iters, tol, _cb)
+        params, lls, converged = out[:3]
+        # Built-in backends report how many EM updates the returned params
+        # embody (!= len(lls) after a divergence or mid-chunk stop);
+        # third-party 3-tuple backends default to len(lls).
+        p_iters = out[3] if len(out) > 3 else len(lls)
+        if checkpoint_path is not None:
+            from .utils.checkpoint import save_checkpoint
+            save_checkpoint(checkpoint_path, params, done_iters + p_iters,
+                            [h["loglik"] for h in history],
+                            fingerprint=fingerprint, converged=converged)
     x_sm, P_sm = b.smooth(Yz, Wm, params)
     return FitResult(params=params, logliks=np.asarray(lls),
                      factors=x_sm, factor_cov=P_sm,

@@ -23,7 +23,7 @@ from ..ssm.parallel_filter import pit_filter, pit_smoother
 from ..ssm.params import SSMParams, SmootherResult
 
 __all__ = ["EMConfig", "em_step", "em_fit", "em_fit_scan", "run_em_loop",
-           "em_progress", "noise_floor_for",
+           "em_progress", "noise_floor_for", "warn_ss_delta",
            "moments", "mstep_rows", "mstep_dynamics"]
 
 
@@ -55,13 +55,19 @@ class EMConfig:
         return pit_smoother if self.filter == "pit" else rts_smoother
 
     def e_step(self, Y, mask, p):
-        """Filter + smoother under the configured implementation."""
+        """Filter + smoother under the configured implementation.
+
+        Returns (kf, sm, delta): ``delta`` is the steady-state freeze
+        diagnostic (relative covariance error at the freeze point) for
+        filter="ss", and exact 0 for the exact filters — surfaced so ss
+        users learn when ``tau`` is too small (ADVICE r1 item 1).
+        """
         if self.filter == "ss":
             from ..ssm.steady import ss_filter_smoother
-            kf, sm, _ = ss_filter_smoother(Y, p, mask=mask, tau=self.tau)
-            return kf, sm
+            kf, sm, delta = ss_filter_smoother(Y, p, mask=mask, tau=self.tau)
+            return kf, sm, delta
         kf = self.filter_fn()(Y, p, mask=mask)
-        return kf, self.smoother_fn()(kf, p)
+        return kf, self.smoother_fn()(kf, p), jnp.zeros((), Y.dtype)
 
 
 def moments(sm: SmootherResult):
@@ -141,13 +147,17 @@ def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig):
 @partial(jax.jit, static_argnames=("cfg", "has_mask"))
 def _em_step_impl(Y, mask, p: SSMParams, cfg: EMConfig, has_mask: bool):
     m = mask if has_mask else None
-    kf, sm = cfg.e_step(Y, m, p)
+    kf, sm, delta = cfg.e_step(Y, m, p)
     p_new = _m_step(Y, m, sm, p, cfg)
-    return p_new, kf.loglik
+    return p_new, kf.loglik, delta
 
 
 def em_step(Y, p: SSMParams, mask=None, cfg: EMConfig = EMConfig()):
-    """One EM iteration.  Returns (new_params, loglik at entry params)."""
+    """One EM iteration.
+
+    Returns (new_params, loglik at entry params, ss_delta) — ss_delta is the
+    steady-state freeze diagnostic (0 for exact filters; see EMConfig.e_step).
+    """
     return _em_step_impl(Y, mask, p, cfg, mask is not None)
 
 
@@ -181,41 +191,74 @@ def run_em_loop(step, max_iters: int, tol: float, callback=None,
     ``step(it) -> (loglik, params_for_callback)`` advances one iteration;
     the loglik is at the ENTERING params, matching ``callback(it, ll, p)``.
     See ``em_progress`` for the stopping rule.
+
+    Returns (lls, converged, state) with state in {"converged", "diverged",
+    "maxiter"} — drivers use "diverged" to hand back the entering params of
+    the failing iteration instead of the post-divergence update
+    (ADVICE r1 item 5).
     """
     lls = []
-    converged = False
+    state = "maxiter"
     for it in range(max_iters):
         ll, cb_params = step(it)
         ll = float(ll)
         lls.append(ll)
         if callback is not None:
             callback(it, ll, cb_params)
-        state = em_progress(lls, tol, noise_floor)
-        if state != "continue":
-            converged = state == "converged"
+        progress = em_progress(lls, tol, noise_floor)
+        if progress != "continue":
+            state = progress
             break
-    return lls, converged
+    return lls, state == "converged", state
+
+
+def warn_ss_delta(max_delta: float, tau: int, threshold: float = 1e-4):
+    """Warn when the steady-state freeze error is large enough to bias EM
+    (the delta ss_filter_smoother reports; see ssm.steady)."""
+    if max_delta > threshold:
+        import warnings
+        warnings.warn(
+            f"steady-state filter freeze error {max_delta:.2e} exceeds "
+            f"{threshold:.0e} at tau={tau}; EM moments may be biased — "
+            "raise EMConfig.tau or use filter='info'", RuntimeWarning,
+            stacklevel=3)
 
 
 def em_fit(Y, p0: SSMParams, mask=None, cfg: EMConfig = EMConfig(),
            max_iters: int = 50, tol: float = 1e-6, callback=None):
     """EM driver with relative-loglik convergence.
 
-    Returns (params, loglik history, converged).  ``callback(it, loglik,
+    Returns (params, loglik history, converged, params_iters).
+    ``params_iters`` counts the EM updates the returned params embody (==
+    len(history) except after a divergence).  ``callback(it, loglik,
     params)`` fires per iteration with the params the loglik was evaluated
     at (logging/checkpoint hook — SURVEY.md section 5 observability row).
+    A drop at iteration j means the update in iteration j-1 produced bad
+    params, so on divergence the params ENTERING iteration j-1 (whose
+    loglik is the last pre-drop value) are returned.
     """
     p = p0
+    entering = prev_entering = p0
+    max_delta = 0.0
 
     def step(it):
-        nonlocal p
+        nonlocal p, entering, prev_entering, max_delta
+        prev_entering = entering
         entering = p
-        p, ll = em_step(Y, entering, mask=mask, cfg=cfg)
+        p, ll, delta = em_step(Y, entering, mask=mask, cfg=cfg)
+        if cfg.filter == "ss":
+            max_delta = max(max_delta, float(delta))
         return ll, entering
 
-    lls, converged = run_em_loop(step, max_iters, tol, callback,
-                                 noise_floor=noise_floor_for(Y.dtype))
-    return p, jnp.asarray(lls), converged
+    lls, converged, state = run_em_loop(step, max_iters, tol, callback,
+                                        noise_floor=noise_floor_for(Y.dtype))
+    if cfg.filter == "ss":
+        warn_ss_delta(max_delta, cfg.tau)
+    p_iters = len(lls)
+    if state == "diverged":
+        p = prev_entering
+        p_iters = max(len(lls) - 2, 0)
+    return p, jnp.asarray(lls), converged, p_iters
 
 
 @partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
@@ -223,14 +266,16 @@ def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
     m = mask if has_mask else None
 
     def body(p, _):
-        kf, sm = cfg.e_step(Y, m, p)
-        return _m_step(Y, m, sm, p, cfg), kf.loglik
+        kf, sm, delta = cfg.e_step(Y, m, p)
+        return _m_step(Y, m, sm, p, cfg), (kf.loglik, delta)
 
-    return jax.lax.scan(body, p0, None, length=n_iters)
+    p, (lls, deltas) = jax.lax.scan(body, p0, None, length=n_iters)
+    return p, lls, deltas
 
 
 def em_fit_scan(Y, p0: SSMParams, n_iters: int, mask=None,
                 cfg: EMConfig = EMConfig()):
     """Fixed-iteration EM fused into one XLA program (benchmark path:
-    BASELINE.json:2 'EM iters/sec' measured without host round-trips)."""
+    BASELINE.json:2 'EM iters/sec' measured without host round-trips).
+    Returns (params, logliks (n,), ss_deltas (n,))."""
     return _em_fit_scan_impl(Y, mask, p0, cfg, mask is not None, n_iters)

@@ -291,15 +291,22 @@ def mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
     Wj = jnp.asarray(W, dtype)
     p = init.astype(dtype)
 
+    entering = prev_entering = p
+
     def step(it):
-        nonlocal p
+        nonlocal p, entering, prev_entering
+        prev_entering = entering
         entering = p
         p, ll = mf_em_step(Yj, Wj, entering, spec)
         return ll, entering
 
     from ..estim.em import noise_floor_for
-    lls, converged = run_em_loop(step, max_iters, tol, callback,
-                                 noise_floor=noise_floor_for(dtype))
+    lls, converged, em_state = run_em_loop(
+        step, max_iters, tol, callback, noise_floor=noise_floor_for(dtype))
+    if em_state == "diverged":
+        # Drop at iteration j <- bad update in j-1: restore params entering
+        # j-1 (the last pre-drop loglik's params).
+        p = prev_entering
 
     aug = augment(p, spec)
     kf = info_filter(Yj, aug, mask=Wj)

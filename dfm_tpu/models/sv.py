@@ -82,8 +82,9 @@ class SVResult(NamedTuple):
     h_mean: jax.Array             # (T, k) weighted filtered log-vols
     ess: jax.Array                # (T,) effective sample size per step
     n_resamples: jax.Array        # scalar
-    h_particles: jax.Array        # (T, M, k) filtering h-cloud (post-resample)
-    logw: jax.Array               # (T, M) matching normalized log-weights
+    h_particles: Optional[jax.Array]  # (T, M, k) filtering h-cloud (post-
+                                      # resample); None if store_paths=False
+    logw: Optional[jax.Array]         # (T, M) matching normalized log-weights
     lls: np.ndarray               # (T,) per-step loglik increments (f64)
 
 
@@ -98,9 +99,11 @@ def _systematic_indices(logW, key):
     return jnp.clip(jnp.searchsorted(cum, pos), 0, M - 1)
 
 
-@partial(jax.jit, static_argnames=("k", "M", "ess_frac", "residual"))
+@partial(jax.jit,
+         static_argnames=("k", "M", "ess_frac", "residual", "store_paths"))
 def _sv_filter_impl(Y, p: SSMParams, h_center, sigma_h, h0_scale, key,
-                    k: int, M: int, ess_frac: float, residual: bool):
+                    k: int, M: int, ess_frac: float, residual: bool,
+                    store_paths: bool):
     # Statics are the individual shape/branch fields, NOT the whole SVSpec:
     # sweeping spec.sigma_h (particle EM, grid profiling) must not recompile.
     dtype = Y.dtype
@@ -178,11 +181,19 @@ def _sv_filter_impl(Y, p: SSMParams, h_center, sigma_h, h0_scale, key,
         W = jnp.exp(logW)
         f_mean = W @ x_f
         h_mean = W @ h
-        return ((x_f, P_f, h, logW, key, n_rs + did),
-                (ll_rel, f_mean, h_mean, ess, h, logW))
+        outs = (ll_rel, f_mean, h_mean, ess)
+        if store_paths:
+            # The FFBS smoother needs the filtering cloud; the filter-only
+            # timing path skips this per-step M*(k+1) HBM write.
+            outs = outs + (h, logW)
+        return (x_f, P_f, h, logW, key, n_rs + did), outs
 
-    (carry, (ll_rel, f_mean, h_mean, ess, h_hist, logw_hist)) = lax.scan(
-        step, (x, P, h, logW, k1, 0), (Y, B))
+    carry, outs = lax.scan(step, (x, P, h, logW, k1, 0), (Y, B))
+    if store_paths:
+        ll_rel, f_mean, h_mean, ess, h_hist, logw_hist = outs
+    else:
+        ll_rel, f_mean, h_mean, ess = outs
+        h_hist = logw_hist = None
     return ll_rel, f_mean, h_mean, ess, carry[5], h_hist, logw_hist
 
 
@@ -194,7 +205,7 @@ def _as_sigma_vec(sigma_h, k, dtype):
 def sv_filter(Y, p: SSMParams, spec: SVSpec,
               key: Optional[jax.Array] = None,
               h_center: Optional[jax.Array] = None,
-              sigma_h=None) -> SVResult:
+              sigma_h=None, store_paths: bool = True) -> SVResult:
     """Rao-Blackwellized particle Kalman filter for the SV-DFM.
 
     ``p`` supplies (Lam, A, R, mu0, P0); the factor-innovation covariance is
@@ -202,6 +213,8 @@ def sv_filter(Y, p: SSMParams, spec: SVSpec,
     ``h_center=log(diag(Q_hat))`` from a standard EM pre-fit (default).
     ``sigma_h`` (scalar or (k,)) overrides ``spec.sigma_h`` — it is a traced
     argument, so sweeping it (particle EM) does not recompile.
+    ``store_paths=False`` skips the (T, M, k) particle-history emission
+    (needed only for FFBS smoothing) — the pure filter-timing mode.
     """
     if key is None:
         key = jax.random.PRNGKey(0)
@@ -215,7 +228,7 @@ def sv_filter(Y, p: SSMParams, spec: SVSpec,
     ll_rel, f_mean, h_mean, ess, n_rs, h_hist, logw_hist = _sv_filter_impl(
         Y, p, jnp.asarray(h_center, dtype), sig, h0s, key,
         k=spec.n_factors, M=spec.n_particles, ess_frac=spec.ess_frac,
-        residual=spec.quad_form == "residual")
+        residual=spec.quad_form == "residual", store_paths=store_paths)
     # Host float64 assembly of the particle-independent constant and the
     # total: no f32 accumulation error over T (module docstring).
     T, N = Y.shape
@@ -263,6 +276,10 @@ def sv_smooth_h(res: SVResult, sigma_h, key, n_draws: int = 64) -> jax.Array:
     random-walk transition density N(h_{t+1}; h_t, diag(sigma_h^2));
     sampling is jit-safe via the Gumbel-max trick.
     """
+    if res.h_particles is None:
+        raise ValueError(
+            "sv_smooth_h needs the filtering particle history; run "
+            "sv_filter with store_paths=True")
     k = res.h_particles.shape[-1]
     sig = _as_sigma_vec(sigma_h, k, res.h_particles.dtype)
     return _ffbs_impl(res.h_particles, res.logw, sig, key, n_draws)
@@ -329,7 +346,7 @@ def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
     def e_step(key, sigma, h_center, smooth):
         kf_, ks_ = jax.random.split(key)
         res = sv_filter(Yj, pj, spec, key=kf_, h_center=h_center,
-                        sigma_h=sigma)
+                        sigma_h=sigma, store_paths=smooth)
         H = (sv_smooth_h(res, sigma, ks_, spec.n_smooth_draws)
              if smooth else None)
         return res, H

@@ -306,8 +306,12 @@ def tvl_fit(Y: np.ndarray, spec: TVLSpec,
     F_last = None
 
     state = {"Lam_t": Lam_t, "p": p, "F": None}
+    prev = dict(state)
+    prev2 = dict(state)
 
     def step(it):
+        prev2.update(prev)
+        prev.update(state)
         Lam_t_new, p_new, ll, F = _tvl_round(
             Yj, Wj if Wj is not None else jnp.ones_like(Yj),
             state["Lam_t"], state["p"], spec, Wj is not None)
@@ -316,8 +320,16 @@ def tvl_fit(Y: np.ndarray, spec: TVLSpec,
         return ll, entering
 
     from ..estim.em import noise_floor_for
-    lls, converged = run_em_loop(step, spec.n_rounds, spec.tol, callback,
-                                 noise_floor=noise_floor_for(dtype))
+    lls, converged, em_state = run_em_loop(
+        step, spec.n_rounds, spec.tol, callback,
+        noise_floor=noise_floor_for(dtype))
+    if em_state == "diverged":
+        # Drop at round j <- bad update in j-1: the state ENTERING round j-1
+        # is the last pre-drop one (fall back to its successor if that is
+        # the F-less initial state).
+        best = prev2 if prev2["F"] is not None else prev
+        if best["F"] is not None:
+            state.update(best)
 
     Lam_t = state["Lam_t"]
     F = state["F"]

@@ -184,14 +184,26 @@ def sharded_em_fit(Y, p0, mask=None, mesh=None, cfg: EMConfig = EMConfig(),
     evaluated at.  Returns (params, logliks, converged, driver)."""
     drv = ShardedEM(Y, p0, mask=mask, mesh=mesh, dtype=dtype, cfg=cfg)
 
+    entering = prev_entering = drv.p
+
     def step(it):
+        nonlocal entering, prev_entering
+        prev_entering = entering
         entering = drv.p
         ll = drv.step()
         # Only materialize host params when someone is listening.
-        cb_params = drv.params_numpy(entering) if callback is not None else None
+        cb_params = (drv.params_numpy(entering)
+                     if callback is not None else None)
         return ll, cb_params
 
     from ..estim.em import noise_floor_for
-    lls, converged = run_em_loop(step, max_iters, tol, callback,
-                                 noise_floor=noise_floor_for(drv.Y.dtype))
+    lls, converged, em_state = run_em_loop(
+        step, max_iters, tol, callback,
+        noise_floor=noise_floor_for(drv.Y.dtype))
+    drv.p_iters = len(lls)
+    if em_state == "diverged":
+        # The drop at iteration j was caused by the update in j-1: hand back
+        # the params entering j-1 (the last pre-drop loglik's params).
+        drv.p = prev_entering
+        drv.p_iters = max(len(lls) - 2, 0)
     return drv.params_numpy(), np.asarray(lls), converged, drv

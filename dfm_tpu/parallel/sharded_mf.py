@@ -131,7 +131,12 @@ def sharded_mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
                         R=jnp.asarray(np.concatenate([Rm_[:Nm], Rq_[:Nq]])),
                         mu0=jnp.asarray(mu0_), P0=jnp.asarray(P0_))
 
+    prev = {"arrs": list(state["arrs"]), "rep": list(state["rep"])}
+    prev2 = {"arrs": list(state["arrs"]), "rep": list(state["rep"])}
+
     def step(it):
+        prev2.update(arrs=prev["arrs"], rep=prev["rep"])
+        prev.update(arrs=list(state["arrs"]), rep=list(state["rep"]))
         entering = mk_params() if callback is not None else None
         out = _sharded_mf_step_impl(
             *state["arrs"][:4], *state["arrs"][4:], *state["rep"],
@@ -144,8 +149,12 @@ def sharded_mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
         return ll, entering
 
     from ..estim.em import noise_floor_for
-    lls, converged = run_em_loop(step, max_iters, tol, callback,
-                                 noise_floor=noise_floor_for(dtype))
+    lls, converged, em_state = run_em_loop(
+        step, max_iters, tol, callback, noise_floor=noise_floor_for(dtype))
+    if em_state == "diverged":
+        # Drop at iteration j <- bad update in j-1: restore the state
+        # entering j-1 (the last pre-drop loglik's params).
+        state["arrs"], state["rep"] = prev2["arrs"], prev2["rep"]
 
     # The last step's smoother is at the pre-update params; run one more
     # E-pass at the final params for the reported factors/nowcast.

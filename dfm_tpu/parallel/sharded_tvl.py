@@ -100,7 +100,12 @@ def sharded_tvl_fit(Y: np.ndarray, spec: TVLSpec,
         "P0": jnp.asarray(init.P0, dtype), "F": None,
     }
 
+    prev = dict(state)
+    prev2 = dict(state)
+
     def step(it):
+        prev2.update(prev)
+        prev.update(state)
         out = _sharded_tvl_round_impl(
             state["Y"], state["W"], state["Lam_t"], state["Lam0"],
             state["tau2"], state["R"], state["A"], state["Q"],
@@ -109,8 +114,15 @@ def sharded_tvl_fit(Y: np.ndarray, spec: TVLSpec,
          state["A"], state["Q"], ll, state["F"]) = out
         return ll, None
 
-    lls, converged = run_em_loop(step, spec.n_rounds, spec.tol, callback,
-                                 noise_floor=noise_floor_for(dtype))
+    lls, converged, em_state = run_em_loop(
+        step, spec.n_rounds, spec.tol, callback,
+        noise_floor=noise_floor_for(dtype))
+    if em_state == "diverged":
+        # Drop at round j <- bad update in j-1: the state entering j-1 is
+        # the last pre-drop one (its successor if that one predates F).
+        best = prev2 if prev2.get("F") is not None else prev
+        if best.get("F") is not None:
+            state.update(best)
 
     Lam_t = np.asarray(state["Lam_t"], np.float64)[:, :N]
     F = np.asarray(state["F"], np.float64)

@@ -6,10 +6,18 @@ overkill for kilobytes of dense arrays; no sharded state ever needs saving
 because params are replicated or trivially gatherable).  ``api.fit`` wires
 this up via ``checkpoint_path`` / ``checkpoint_every`` and resumes
 automatically from a compatible checkpoint.
+
+Checkpoints carry a data/model fingerprint (hash of the panel bytes, mask
+pattern and model config — ADVICE r1 item 2) so a checkpoint from a
+different dataset that happens to share (N, k) is never silently used as a
+warm start; the stored ``iter`` counts the EM iterations the params embody,
+letting ``fit`` resume with the remaining budget instead of starting the
+iteration count over.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import tempfile
 from typing import Optional, Tuple
@@ -18,16 +26,32 @@ import numpy as np
 
 from ..backends.cpu_ref import SSMParams
 
-__all__ = ["save_checkpoint", "load_checkpoint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "data_fingerprint"]
 
 _FIELDS = ("Lam", "A", "Q", "R", "mu0", "P0")
 
 
-def save_checkpoint(path: str, params, it: int, logliks) -> None:
+def data_fingerprint(Y: np.ndarray, mask, model) -> str:
+    """Stable hash of (panel bytes, mask pattern, model config)."""
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(np.asarray(Y, np.float64)).tobytes())
+    if mask is not None:
+        h.update(np.ascontiguousarray(
+            np.asarray(mask, np.uint8)).tobytes())
+    h.update(repr(model).encode())
+    return h.hexdigest()
+
+
+def save_checkpoint(path: str, params, it: int, logliks,
+                    fingerprint: Optional[str] = None,
+                    converged: bool = False) -> None:
     """Atomic write (tmp + rename) of EM state."""
     arrays = {f: np.asarray(getattr(params, f), np.float64) for f in _FIELDS}
     arrays["iter"] = np.asarray(it)
     arrays["logliks"] = np.asarray(logliks, np.float64)
+    arrays["converged"] = np.asarray(bool(converged))
+    if fingerprint is not None:
+        arrays["fingerprint"] = np.asarray(fingerprint)
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
@@ -41,13 +65,23 @@ def save_checkpoint(path: str, params, it: int, logliks) -> None:
         raise
 
 
-def load_checkpoint(path: str) -> Optional[Tuple[SSMParams, int, np.ndarray]]:
-    """Returns (params, next_iter, logliks) or None if absent/unreadable."""
+def load_checkpoint(path: str, fingerprint: Optional[str] = None
+                    ) -> Optional[Tuple[SSMParams, int, np.ndarray, bool]]:
+    """Returns (params, completed_iters, logliks, converged) or None if
+    absent, unreadable, or fingerprint-mismatched.  When a fingerprint is
+    expected, a checkpoint WITHOUT one (pre-fingerprint file) is also
+    rejected — accepting it would silently warm-start from possibly-foreign
+    params, the exact failure the fingerprint exists to prevent."""
     if not os.path.exists(path):
         return None
     try:
         with np.load(path) as z:
+            if fingerprint is not None:
+                if ("fingerprint" not in z
+                        or str(z["fingerprint"]) != fingerprint):
+                    return None
             params = SSMParams(*(z[f] for f in _FIELDS))
-            return params, int(z["iter"]), np.asarray(z["logliks"])
+            converged = bool(z["converged"]) if "converged" in z else False
+            return params, int(z["iter"]), np.asarray(z["logliks"]), converged
     except Exception:
         return None

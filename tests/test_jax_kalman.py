@@ -59,7 +59,7 @@ def test_masked_filter_matches_cpu(prob):
 def test_em_step_matches_cpu(prob):
     Y, p = prob
     p_np, ll_np, _ = cr.em_step(Y, p)
-    p_jx, ll_jx = em_step(jnp.asarray(Y), JP.from_numpy(p))
+    p_jx, ll_jx, _ = em_step(jnp.asarray(Y), JP.from_numpy(p))
     np.testing.assert_allclose(ll_jx, ll_np, rtol=1e-10)
     np.testing.assert_allclose(p_jx.Lam, p_np.Lam, atol=1e-8)
     np.testing.assert_allclose(p_jx.A, p_np.A, atol=1e-8)
@@ -72,7 +72,7 @@ def test_em_step_masked_matches_cpu(prob):
     rng = np.random.default_rng(13)
     mask = dgp.random_mask(*Y.shape, rng=rng, frac_missing=0.25)
     p_np, ll_np, _ = cr.em_step(Y, p, mask=mask)
-    p_jx, ll_jx = em_step(jnp.asarray(Y), JP.from_numpy(p),
+    p_jx, ll_jx, _ = em_step(jnp.asarray(Y), JP.from_numpy(p),
                           mask=jnp.asarray(mask))
     np.testing.assert_allclose(ll_jx, ll_np, rtol=1e-10)
     np.testing.assert_allclose(p_jx.Lam, p_np.Lam, atol=1e-8)
@@ -83,14 +83,14 @@ def test_em_fit_matches_cpu_20_iters(prob):
     """S1-shaped end-to-end agreement: 20 EM iterations, loglik path equal."""
     Y, p = prob
     _, lls_np, _ = cr.em_fit(Y, p, max_iters=20, tol=0.0)
-    _, lls_jx, _ = em_fit(jnp.asarray(Y), JP.from_numpy(p), max_iters=20, tol=0.0)
+    _, lls_jx, _, _ = em_fit(jnp.asarray(Y), JP.from_numpy(p), max_iters=20, tol=0.0)
     np.testing.assert_allclose(np.asarray(lls_jx), lls_np, rtol=1e-8)
 
 
 def test_em_fit_scan_equals_python_loop(prob):
     Y, p = prob
-    _, lls_loop, _ = em_fit(jnp.asarray(Y), JP.from_numpy(p), max_iters=10, tol=0.0)
-    _, lls_scan = em_fit_scan(jnp.asarray(Y), JP.from_numpy(p), n_iters=10)
+    _, lls_loop, _, _ = em_fit(jnp.asarray(Y), JP.from_numpy(p), max_iters=10, tol=0.0)
+    _, lls_scan, _ = em_fit_scan(jnp.asarray(Y), JP.from_numpy(p), n_iters=10)
     np.testing.assert_allclose(np.asarray(lls_scan), np.asarray(lls_loop),
                                rtol=1e-10)
 
@@ -118,7 +118,7 @@ def test_static_em_cfg(prob):
     p0 = cr.SSMParams(p.Lam, np.zeros_like(p.A), np.eye(3), p.R,
                       np.zeros(3), np.eye(3))
     p_np, ll_np, _ = cr.em_step(Y, p0, estimate_A=False, estimate_Q=False)
-    p_jx, ll_jx = em_step(jnp.asarray(Y), JP.from_numpy(p0), cfg=cfg)
+    p_jx, ll_jx, _ = em_step(jnp.asarray(Y), JP.from_numpy(p0), cfg=cfg)
     np.testing.assert_allclose(ll_jx, ll_np, rtol=1e-10)
     np.testing.assert_allclose(p_jx.Lam, p_np.Lam, atol=1e-8)
     np.testing.assert_allclose(np.asarray(p_jx.A), p0.A)  # A untouched

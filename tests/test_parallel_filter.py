@@ -86,7 +86,7 @@ def test_em_with_pit_matches_info(setup):
     p0 = cpu_ref.pca_init((Y - Y.mean(0)) / Y.std(0), 3)
     Yz = jnp.asarray((Y - Y.mean(0)) / Y.std(0))
     pj = JP.from_numpy(p0, jnp.float64)
-    _, lls_i, _ = em_fit(Yz, pj, max_iters=5, cfg=EMConfig(filter="info"))
-    _, lls_p, _ = em_fit(Yz, pj, max_iters=5, cfg=EMConfig(filter="pit"))
+    _, lls_i, _, _ = em_fit(Yz, pj, max_iters=5, cfg=EMConfig(filter="info"))
+    _, lls_p, _, _ = em_fit(Yz, pj, max_iters=5, cfg=EMConfig(filter="pit"))
     np.testing.assert_allclose(np.asarray(lls_p), np.asarray(lls_i),
                                rtol=1e-9)

@@ -77,8 +77,8 @@ def test_checkpoint_roundtrip(tmp_path):
     save_checkpoint(path, p, 7, [1.0, 2.0])
     loaded = load_checkpoint(path)
     assert loaded is not None
-    q, it, lls = loaded
-    assert it == 7
+    q, it, lls, converged = loaded
+    assert it == 7 and converged is False
     np.testing.assert_allclose(q.Lam, p.Lam)
     np.testing.assert_allclose(lls, [1.0, 2.0])
     assert load_checkpoint(str(tmp_path / "missing.npz")) is None
@@ -93,11 +93,82 @@ def test_fit_checkpoint_resume(tmp_path):
     r1 = fit(model, Y, backend="cpu", max_iters=5, tol=0.0,
              checkpoint_path=path)
     assert os.path.exists(path)
-    # Resuming warm-starts from the checkpoint: the first loglik of the
-    # resumed run must be >= the last loglik of the first run (EM monotone).
-    r2 = fit(model, Y, backend="cpu", max_iters=3, tol=0.0,
+    # Resuming with a larger budget warm-starts from the checkpoint: the
+    # first loglik of the resumed run must be >= the last loglik of the
+    # first run (EM monotone), and only the remaining iterations run.
+    r2 = fit(model, Y, backend="cpu", max_iters=8, tol=0.0,
              checkpoint_path=path)
     assert r2.logliks[0] >= r1.logliks[-1] - 1e-8
+    assert r2.n_iters == 3
+
+
+def test_checkpoint_fingerprint_rejects_foreign_data(tmp_path):
+    """A checkpoint from different data with the same (N, k) must not be
+    used as a warm start (ADVICE r1 item 2)."""
+    rng = np.random.default_rng(78)
+    p = dgp.dfm_params(15, 2, rng)
+    Ya, _ = dgp.simulate(p, 80, rng)
+    Yb, _ = dgp.simulate(p, 80, rng)      # same shape, different panel
+    model = DynamicFactorModel(n_factors=2)
+    path = str(tmp_path / "em.npz")
+    fit(model, Ya, backend="cpu", max_iters=5, tol=0.0,
+        checkpoint_path=path)
+    fresh = fit(model, Yb, backend="cpu", max_iters=3, tol=0.0)
+    resumed = fit(model, Yb, backend="cpu", max_iters=3, tol=0.0,
+                  checkpoint_path=path)
+    # Fingerprint mismatch -> cold start: identical first loglik to the
+    # checkpoint-free run (same PCA init), and the full iteration budget.
+    assert resumed.logliks[0] == fresh.logliks[0]
+    assert resumed.n_iters == 3
+
+
+def test_checkpoint_resume_iteration_budget(tmp_path):
+    """Resume subtracts completed iterations instead of re-running the full
+    max_iters (ADVICE r1 item 2), including through the fused-chunk TPU
+    driver whose checkpoints are labeled with the params' true iteration
+    (ADVICE r1 item 3)."""
+    from dfm_tpu.api import TPUBackend
+    rng = np.random.default_rng(79)
+    p = dgp.dfm_params(15, 2, rng)
+    Y, _ = dgp.simulate(p, 80, rng)
+    model = DynamicFactorModel(n_factors=2)
+    path = str(tmp_path / "em.npz")
+    fit(model, Y, backend=TPUBackend(fused_chunk=4), max_iters=5, tol=0.0,
+        checkpoint_path=path)
+    ck = load_checkpoint(path)
+    assert ck is not None and ck[1] == 5      # 5 completed iterations
+    r2 = fit(model, Y, backend=TPUBackend(fused_chunk=4), max_iters=7,
+             tol=0.0, checkpoint_path=path)
+    assert r2.n_iters == 2                    # 7 - 5 remaining
+
+
+def test_checkpoint_rerun_does_not_exceed_budget(tmp_path):
+    """Re-running an already-complete fit returns the checkpointed state
+    instead of creeping one extra iteration per invocation."""
+    rng = np.random.default_rng(82)
+    p = dgp.dfm_params(12, 2, rng)
+    Y, _ = dgp.simulate(p, 60, rng)
+    model = DynamicFactorModel(n_factors=2)
+    path = str(tmp_path / "em.npz")
+    r1 = fit(model, Y, backend="cpu", max_iters=4, tol=0.0,
+             checkpoint_path=path)
+    it1 = load_checkpoint(path)[1]
+    r2 = fit(model, Y, backend="cpu", max_iters=4, tol=0.0,
+             checkpoint_path=path)
+    assert load_checkpoint(path)[1] == it1 == 4
+    assert r2.loglik == r1.loglik
+
+
+def test_run_em_loop_reports_divergence():
+    from dfm_tpu.estim.em import run_em_loop
+    seq = [0.0, 1.0, 0.5]                     # real drop at iter 2
+
+    def step(it):
+        return seq[it], None
+
+    lls, converged, state = run_em_loop(step, 10, tol=0.0,
+                                        noise_floor=1e-6)
+    assert state == "diverged" and not converged and len(lls) == 3
 
 
 def test_jsonl_logger(tmp_path):

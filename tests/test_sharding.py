@@ -39,7 +39,7 @@ def test_sharded_em_matches_single_device(panel):
     mesh = make_mesh(8)
     ps, lls_s, _, _ = sharded_em_fit(Yz, p0, mesh=mesh, max_iters=6,
                                      dtype=jnp.float64)
-    pd_, lls_d, _ = em_fit(jnp.asarray(Yz), JP.from_numpy(p0, jnp.float64),
+    pd_, lls_d, _, _ = em_fit(jnp.asarray(Yz), JP.from_numpy(p0, jnp.float64),
                            max_iters=6, cfg=EMConfig(filter="info"))
     np.testing.assert_allclose(lls_s, np.asarray(lls_d), rtol=1e-9)
     np.testing.assert_allclose(ps.Lam, np.asarray(pd_.Lam), atol=1e-7)
@@ -55,7 +55,7 @@ def test_sharded_em_matches_with_mask_and_padding(panel):
     mesh = make_mesh(5)
     ps, lls_s, _, _ = sharded_em_fit(Yz, p0, mask=W, mesh=mesh, max_iters=4,
                                      dtype=jnp.float64)
-    pd_, lls_d, _ = em_fit(jnp.asarray(Yz), JP.from_numpy(p0, jnp.float64),
+    pd_, lls_d, _, _ = em_fit(jnp.asarray(Yz), JP.from_numpy(p0, jnp.float64),
                            mask=jnp.asarray(W), max_iters=4,
                            cfg=EMConfig(filter="info"))
     np.testing.assert_allclose(lls_s, np.asarray(lls_d), rtol=1e-8)

@@ -72,9 +72,9 @@ def test_em_through_ss_matches_info(setup):
     Yz = (Y - Y.mean(0)) / Y.std(0)
     p0 = cpu_ref.pca_init(Yz, 3)
     pj = JP.from_numpy(p0, jnp.float64)
-    _, lls_i, _ = em_fit(jnp.asarray(Yz), pj, max_iters=5,
+    _, lls_i, _, _ = em_fit(jnp.asarray(Yz), pj, max_iters=5,
                          cfg=EMConfig(filter="info"))
-    _, lls_s, _ = em_fit(jnp.asarray(Yz), pj, max_iters=5,
+    _, lls_s, _, _ = em_fit(jnp.asarray(Yz), pj, max_iters=5,
                          cfg=EMConfig(filter="ss"))
     np.testing.assert_allclose(np.asarray(lls_s), np.asarray(lls_i),
                                rtol=1e-10)
@@ -95,3 +95,27 @@ def test_ss_diagnostic_flags_slow_mixing():
     pj = JP.from_numpy(p, jnp.float64)
     _, _, delta = ss_filter_smoother(jnp.asarray(Y), pj, tau=8)
     assert float(delta) > 1e-6, float(delta)
+
+
+def test_ss_delta_surfaced_and_warning(recwarn):
+    """ADVICE r1 item 1: the freeze diagnostic is threaded out of e_step
+    and warn_ss_delta fires above threshold, stays silent below."""
+    import warnings
+    import pytest
+    from dfm_tpu.estim.em import EMConfig, em_step, em_fit_scan, warn_ss_delta
+    rng = np.random.default_rng(81)
+    p = dgp.dfm_params(20, 2, rng, spectral_radius=0.95)
+    Y, _ = dgp.simulate(p, 200, rng)
+    Yz = (Y - Y.mean(0)) / Y.std(0)
+    p0 = cpu_ref.pca_init(Yz, 2)
+    _, _, delta = em_step(jnp.asarray(Yz), JP.from_numpy(p0),
+                          cfg=EMConfig(filter="ss", tau=8))
+    assert float(delta) >= 0.0
+    _, lls, deltas = em_fit_scan(jnp.asarray(Yz), JP.from_numpy(p0),
+                                 n_iters=3, cfg=EMConfig(filter="ss", tau=8))
+    assert deltas.shape == (3,)
+    with pytest.warns(RuntimeWarning, match="steady-state"):
+        warn_ss_delta(1e-2, tau=8)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        warn_ss_delta(1e-6, tau=8)   # below threshold: must not warn
