@@ -471,12 +471,21 @@ def fit(model: DynamicFactorModel,
                      history=history)
 
 
-def forecast(result: FitResult, horizon: int):
+def forecast(result, horizon: int):
     """h-step-ahead forecasts in ORIGINAL data units (de-standardized).
 
     Returns (y_fore (h, N), f_fore (h, k)).  Reference behavior per SURVEY.md
     section 3.2 (filter to T, iterate dynamics, map through loadings).
+    Dispatches across every model family: plain/AR(1) ``FitResult``,
+    mixed-frequency ``MFResult`` (companion-state iteration), and TVL
+    ``TVLResult`` (loadings frozen at T).
     """
+    from .models.mixed_freq import MFResult, mf_forecast
+    from .models.tv_loadings import TVLResult, tvl_forecast
+    if isinstance(result, MFResult):
+        return mf_forecast(result, horizon)
+    if isinstance(result, TVLResult):
+        return tvl_forecast(result, horizon)
     p = result.params
     # Re-filter to the end of sample using smoothed factors' last state:
     x_T = result.factors[-1]

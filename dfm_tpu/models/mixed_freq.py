@@ -41,7 +41,7 @@ from ..ssm.params import SSMParams
 from ..estim.em import run_em_loop
 
 __all__ = ["MixedFreqSpec", "MFParams", "augment", "mf_em_step", "mf_fit",
-           "MFResult"]
+           "mf_forecast", "MFResult"]
 
 MM_WEIGHTS = (1.0 / 3, 2.0 / 3, 1.0, 2.0 / 3, 1.0 / 3)
 
@@ -260,10 +260,42 @@ class MFResult:
     nowcast: np.ndarray          # (T, N) smoothed common component
     converged: bool
     spec: MixedFreqSpec
+    state_T: np.ndarray = None       # (m,) smoothed augmented state at T
+    state_cov_T: np.ndarray = None   # (m, m)
+    standardizer: object = None      # utils.data.Standardizer or None
 
     @property
     def loglik(self):
         return float(self.logliks[-1]) if len(self.logliks) else float("nan")
+
+
+def mf_forecast(result: MFResult, horizon: int):
+    """h-step out-of-sample forecast, mirroring ``api.forecast``'s contract
+    (SURVEY.md section 3.2 extended to the mixed-frequency family).
+
+    Iterates the augmented companion state x_{T+j} = A_aug x_{T+j-1} from
+    the smoothed end-of-sample state and maps through the Mariano-Murasawa
+    loadings, so monthly rows forecast off f_{T+j} and quarterly rows off
+    the weighted lag aggregate automatically.  Returns (y_fore (h, N) in
+    ORIGINAL data units, f_fore (h, k) monthly factors).
+    """
+    if result.state_T is None:
+        raise ValueError("MFResult lacks state_T (old result object?)")
+    spec = result.spec
+    k = spec.n_factors
+    aug = augment(result.params, spec)
+    A = np.asarray(aug.A, np.float64)
+    Lam = np.asarray(aug.Lam, np.float64)
+    x = np.asarray(result.state_T, np.float64)
+    f = np.zeros((horizon, k))
+    y = np.zeros((horizon, Lam.shape[0]))
+    for h in range(horizon):
+        x = A @ x
+        f[h] = x[:k]
+        y[h] = Lam @ x
+    if result.standardizer is not None:
+        y = result.standardizer.inverse(y)
+    return y, f
 
 
 def mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
@@ -319,4 +351,6 @@ def mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
         common = std.inverse(common)
     return MFResult(params=p, logliks=np.asarray(lls),
                     factors=x_sm[:, :k], factor_cov=P_sm[:, :k, :k],
-                    nowcast=common, converged=converged, spec=spec)
+                    nowcast=common, converged=converged, spec=spec,
+                    state_T=x_sm[-1], state_cov_T=P_sm[-1],
+                    standardizer=std)

@@ -43,7 +43,7 @@ from ..ssm.kalman import rts_smoother
 from ..ssm.params import SSMParams
 from ..estim.em import run_em_loop
 
-__all__ = ["TVLSpec", "TVLParams", "tvl_fit", "TVLResult",
+__all__ = ["TVLSpec", "TVLParams", "tvl_fit", "tvl_forecast", "TVLResult",
            "factor_pass_tv", "loading_pass", "tvl_round_core"]
 
 
@@ -267,6 +267,25 @@ class TVLResult:
     @property
     def loglik(self):
         return float(self.logliks[-1]) if len(self.logliks) else float("nan")
+
+
+def tvl_forecast(result: TVLResult, horizon: int):
+    """h-step out-of-sample forecast, mirroring ``api.forecast``'s contract
+    (SURVEY.md section 3.2 extended to the TVL family).
+
+    Loadings are frozen at their end-of-sample smoothed value Lam_T (the
+    random walk's conditional expectation for every future step) and the
+    factor VAR(1) is iterated from the last estimated factor state.
+    Returns (y_fore (h, N), f_fore (h, k)) in the units ``tvl_fit`` saw.
+    """
+    A = np.asarray(result.params.A, np.float64)
+    Lam_T = np.asarray(result.loadings[-1], np.float64)     # (N, k)
+    f = np.zeros((horizon, A.shape[0]))
+    x = np.asarray(result.factors[-1], np.float64)
+    for h in range(horizon):
+        x = A @ x
+        f[h] = x
+    return f @ Lam_T.T, f
 
 
 def tvl_fit(Y: np.ndarray, spec: TVLSpec,

@@ -171,4 +171,6 @@ def sharded_mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
         common = std.inverse(common)
     return MFResult(params=p_final, logliks=np.asarray(lls),
                     factors=x_sm[:, :k], factor_cov=P_sm[:, :k, :k],
-                    nowcast=common, converged=converged, spec=spec)
+                    nowcast=common, converged=converged, spec=spec,
+                    state_T=x_sm[-1], state_cov_T=P_sm[-1],
+                    standardizer=std)
