@@ -195,6 +195,15 @@ class TPUBackend(Backend):
                         em_fit_scan):
         """Fused-chunk driver: one XLA program per ``fused_chunk`` iters.
 
+        Convergence/divergence can only be detected once a chunk's logliks
+        reach the host, by which point the device params embody the WHOLE
+        chunk.  To keep fused fits exactly equivalent to per-iteration fits,
+        a mid-chunk stop replays the chunk's prefix from the stored
+        chunk-entry params (one shorter fused program, compiled once per
+        distinct tail length) so the returned params embody precisely the
+        update count the stopping rule selected — including the divergence
+        rule's "params entering the pre-drop iteration".
+
         Callbacks receive chunk-entry params; a callback carrying
         ``wants_params_iter = True`` (api.fit's checkpoint hook) is
         additionally passed ``params_iter`` — the iteration those params
@@ -206,52 +215,62 @@ class TPUBackend(Backend):
         pass_piter = getattr(callback, "wants_params_iter", False)
         lls: list = []
         converged = False
-        diverged = False
-        div_j = 0
+        stop = False
+        target = 0      # update count the stopping rule selects (from start)
         max_delta = 0.0
         p = pj
         it = 0
         p_entry = p_entry_prev = pj
         entry_it = entry_it_prev = 0
-        while it < max_iters:
+        while it < max_iters and not stop:
             n = min(self.fused_chunk, max_iters - it)
             p_entry_prev, entry_it_prev = p_entry, entry_it
             p_entry, entry_it = p, it
             p, chunk, deltas = em_fit_scan(Yj, p, n, mask=mj, cfg=cfg)
             chunk = np.asarray(chunk, np.float64)
-            if cfg.filter == "ss":
-                max_delta = max(max_delta, float(np.max(np.asarray(deltas))))
-            stop = False
+            consumed = n
             for j, ll in enumerate(chunk):
                 lls.append(float(ll))
                 if callback is not None:
                     if pass_piter:
-                        callback(it + j, float(ll), p_entry, params_iter=it)
+                        callback(it + j, float(ll), p_entry,
+                                 params_iter=entry_it)
                     else:
                         callback(it + j, float(ll), p_entry)
                 state = em_progress(lls, tol, floor)
                 if state != "continue":
                     converged = state == "converged"
-                    diverged = state == "diverged"
-                    div_j = j
+                    # Same update counts run_em_loop-based drivers return:
+                    # converged -> every iteration that ran; diverged ->
+                    # the params entering the pre-drop iteration.
+                    target = (len(lls) if converged
+                              else max(len(lls) - 2, 0))
                     stop = True
+                    consumed = j + 1
                     break
-            if stop:
-                it += n
-                break
+            if cfg.filter == "ss":
+                # Only iterations up to the stop count toward the freeze
+                # warning — post-stop iterations of the chunk ran on the
+                # device but are discarded (and after a divergence their
+                # deltas reflect garbage params).
+                max_delta = max(max_delta,
+                                float(np.max(np.asarray(deltas)[:consumed])))
             it += n
         if cfg.filter == "ss":
             warn_ss_delta(max_delta, cfg.tau)
         p_iters = it
-        if diverged:
-            # Best available pre-divergence params (per-iter params never
-            # leave the device in the fused scan): the current chunk's entry
-            # — unless the drop was at the chunk's first loglik, which blames
-            # the PREVIOUS chunk's last update, so fall back one more chunk.
-            if div_j > 0:
-                p, p_iters = p_entry, entry_it
-            else:
-                p, p_iters = p_entry_prev, entry_it_prev
+        if stop and target != it:
+            # A diverged target can precede the current chunk's entry (drop
+            # at the chunk's first loglik blames the previous chunk's last
+            # update) — replay from whichever stored entry covers it.
+            base, base_it = ((p_entry, entry_it) if target >= entry_it
+                             else (p_entry_prev, entry_it_prev))
+            n_replay = target - base_it
+            p = (base if n_replay == 0
+                 else em_fit_scan(Yj, base, n_replay, mask=mj, cfg=cfg)[0])
+            p_iters = target
+        # (a stop with target == it needs nothing: the chunk end already
+        # embodies exactly `target` updates and p_iters == it == target)
         return p, np.asarray(lls), converged, p_iters
 
     def smooth(self, Y, mask, params):
@@ -280,14 +299,29 @@ class ShardedBackend(TPUBackend):
     ``shard_map`` + psum realization of BASELINE.json:5's distributed design
     (see ``parallel.sharded``).  n_devices=None uses every local device; on a
     single chip this degrades gracefully to a 1-shard mesh.
+
+    filter: "info" (exact information-form scan) or "ss" (steady-state
+    accelerated — the single-chip headline path, replicated k x k under
+    sharding; falls back to info on masked panels).  "auto" means "info".
+
+    fused_chunk: as in ``TPUBackend`` — EM iterations fused into one XLA
+    program (``lax.scan`` over the shard_map body) between host round-trips,
+    so the multi-device path is not program-dispatch-bound (one ~60-100 ms
+    dispatch per chunk instead of per iteration).  Callbacks receive
+    chunk-entry params, unpadded to the true series count.
     """
 
     name = "sharded"
 
-    def __init__(self, dtype=None, n_devices=None,
-                 matmul_precision: str = "highest"):
-        super().__init__(dtype=dtype, filter="info",
-                         matmul_precision=matmul_precision)
+    def __init__(self, dtype=None, n_devices=None, filter: str = "info",
+                 matmul_precision: str = "highest", fused_chunk: int = 8):
+        super().__init__(dtype=dtype,
+                         filter="info" if filter == "auto" else filter,
+                         matmul_precision=matmul_precision,
+                         fused_chunk=fused_chunk)
+        if self.filter not in ("info", "ss"):
+            raise ValueError(
+                f"sharded filter must be 'info' or 'ss'; got {filter!r}")
         self.n_devices = n_devices
         self._drv = None          # ShardedEM from the last run_em
         self._drv_params = None   # the numpy params it ended at
@@ -296,19 +330,55 @@ class ShardedBackend(TPUBackend):
         from .parallel.mesh import make_mesh
         return make_mesh(self.n_devices)
 
+    @staticmethod
+    def _unpad_callback(callback, drv):
+        """Hand callbacks UNPADDED numpy params (checkpoints stay loadable).
+
+        The fused driver re-passes the same chunk-entry params object for
+        every iteration of a chunk; the one-slot identity cache makes the
+        host transfer happen once per chunk, not per iteration."""
+        if callback is None:
+            return None
+        cache: dict = {}
+
+        def wrapped(it, ll, p, **kw):
+            key = id(p)
+            if key not in cache:
+                cache.clear()
+                cache[key] = drv.params_numpy(p)
+            return callback(it, ll, cache[key], **kw)
+
+        wrapped.wants_params_iter = getattr(callback, "wants_params_iter",
+                                            False)
+        return wrapped
+
     def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
         from .estim.em import EMConfig
-        from .parallel.sharded import sharded_em_fit
+        from .parallel.sharded import ShardedEM, sharded_em_fit
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
-                       estimate_init=model.estimate_init, filter="info")
+                       estimate_init=model.estimate_init, filter=self.filter)
         with self._precision_ctx():
-            p, lls, converged, drv = sharded_em_fit(
-                Y, p0, mask=mask, mesh=self._mesh(), cfg=cfg,
-                max_iters=max_iters, tol=tol, dtype=self._dtype(),
-                callback=callback)
-        self._drv, self._drv_params = drv, p
-        return p, lls, converged, drv.p_iters
+            if self.fused_chunk <= 1:
+                p, lls, converged, drv = sharded_em_fit(
+                    Y, p0, mask=mask, mesh=self._mesh(), cfg=cfg,
+                    max_iters=max_iters, tol=tol, dtype=self._dtype(),
+                    callback=callback)
+                self._drv, self._drv_params = drv, p
+                return p, lls, converged, drv.p_iters
+            drv = ShardedEM(Y, p0, mask=mask, mesh=self._mesh(),
+                            dtype=self._dtype(), cfg=cfg)
+
+            def scan_fn(Yj, p, n, mask=None, cfg=None):
+                return drv.run_scan(p, n)
+
+            p, lls, converged, p_iters = self._run_em_chunked(
+                drv.Y, drv.mask, drv.p, drv.cfg, max_iters, tol,
+                self._unpad_callback(callback, drv), scan_fn)
+            drv.p, drv.p_iters = p, p_iters
+            pn = drv.params_numpy()
+        self._drv, self._drv_params = drv, pn
+        return pn, lls, converged, p_iters
 
     def smooth(self, Y, mask, params):
         import jax.numpy as jnp

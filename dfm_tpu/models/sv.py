@@ -99,28 +99,29 @@ def _systematic_indices(logW, key):
     return jnp.clip(jnp.searchsorted(cum, pos), 0, M - 1)
 
 
-@partial(jax.jit,
-         static_argnames=("k", "M", "ess_frac", "residual", "store_paths"))
-def _sv_filter_impl(Y, p: SSMParams, h_center, sigma_h, h0_scale, key,
-                    k: int, M: int, ess_frac: float, residual: bool,
-                    store_paths: bool):
-    # Statics are the individual shape/branch fields, NOT the whole SVSpec:
-    # sweeping spec.sigma_h (particle EM, grid profiling) must not recompile.
-    dtype = Y.dtype
-    T, N = Y.shape
-    I_k = jnp.eye(k, dtype=dtype)
-    A = p.A
+def _rbpf_scan(Y, Lam, R, C, B, A, mu0, P0, h_center, sigma_h, h0_scale, key,
+               k: int, M: int, ess_frac: float, residual: bool,
+               store_paths: bool, reduce_fn=lambda x: x):
+    """The RBPF time scan over a (possibly local) series block.
 
-    Rinv = 1.0 / p.R
-    G0 = p.Lam * Rinv[:, None]                        # R^{-1} Lam, (N, k)
-    C = p.Lam.T @ G0                                  # (k, k)
-    LamT = p.Lam.T
-    B = Y @ G0                                        # (T, k)
+    ``Y (T, n) / Lam (n, k) / R (n,)`` may be one device's shard; ``C/B``
+    are the GLOBAL stats (psum'd by the caller under sharding) and
+    ``reduce_fn`` sums the per-step residual reductions across shards
+    (identity on a single device, psum inside ``shard_map`` — see
+    ``parallel.sharded_sv``).  Everything except those reductions is
+    replicated k/M-sized work, so the single-device and sharded paths run
+    the IDENTICAL op sequence — matched PRNG keys give matching particle
+    paths and resampling decisions up to psum rounding.
+    """
+    dtype = Y.dtype
+    I_k = jnp.eye(k, dtype=dtype)
+    Rinv = 1.0 / R
+    LamT = Lam.T
 
     k0, k1 = jax.random.split(key)
     h = h_center[None, :] + h0_scale * jax.random.normal(k0, (M, k), dtype)
-    x = jnp.broadcast_to(p.mu0, (M, k)).astype(dtype)
-    P = jnp.broadcast_to(p.P0, (M, k, k)).astype(dtype)
+    x = jnp.broadcast_to(mu0, (M, k)).astype(dtype)
+    P = jnp.broadcast_to(P0, (M, k, k)).astype(dtype)
     logW = jnp.full((M,), -jnp.log(float(M)), dtype)
 
     def step(carry, inp):
@@ -143,10 +144,10 @@ def _sv_filter_impl(Y, p: SSMParams, h_center, sigma_h, h0_scale, key,
         P_f = sym(P_f)
         if residual:
             # Cancellation-free: true residuals per particle (module docstring).
-            V = y_t[None, :] - x_p @ LamT             # (M, N)
+            V = y_t[None, :] - x_p @ LamT             # (M, n_local)
             VR = V * Rinv[None, :]
-            c2_p = jnp.einsum("mn,mn->m", V, VR)      # v'R^{-1}v >= 0 directly
-            u = VR @ p.Lam                            # Lam'R^{-1}v, (M, k)
+            c2_p = reduce_fn(jnp.einsum("mn,mn->m", V, VR))  # v'R^{-1}v >= 0
+            u = reduce_fn(VR @ Lam)                   # Lam'R^{-1}v, (M, k)
             quad = c2_p - jnp.einsum("mk,mkl,ml->m", u, P_f, u)
         else:
             u = b_t[None, :] - x_p @ C.T              # (M, k)
@@ -197,9 +198,43 @@ def _sv_filter_impl(Y, p: SSMParams, h_center, sigma_h, h0_scale, key,
     return ll_rel, f_mean, h_mean, ess, carry[5], h_hist, logw_hist
 
 
+@partial(jax.jit,
+         static_argnames=("k", "M", "ess_frac", "residual", "store_paths"))
+def _sv_filter_impl(Y, p: SSMParams, h_center, sigma_h, h0_scale, key,
+                    k: int, M: int, ess_frac: float, residual: bool,
+                    store_paths: bool):
+    # Statics are the individual shape/branch fields, NOT the whole SVSpec:
+    # sweeping spec.sigma_h (particle EM, grid profiling) must not recompile.
+    Rinv = 1.0 / p.R
+    G0 = p.Lam * Rinv[:, None]                        # R^{-1} Lam, (N, k)
+    C = p.Lam.T @ G0                                  # (k, k)
+    B = Y @ G0                                        # (T, k)
+    return _rbpf_scan(Y, p.Lam, p.R, C, B, p.A, p.mu0, p.P0, h_center,
+                      sigma_h, h0_scale, key, k=k, M=M, ess_frac=ess_frac,
+                      residual=residual, store_paths=store_paths)
+
+
 def _as_sigma_vec(sigma_h, k, dtype):
     s = jnp.asarray(sigma_h, dtype)
     return jnp.broadcast_to(s, (k,)) if s.ndim == 0 else s
+
+
+def _host_lls(ll_rel, Y, R64: np.ndarray, residual: bool) -> np.ndarray:
+    """Host float64 assembly of the per-step loglik increments.
+
+    Adds the particle-independent constant -(N log 2pi + log|R|)/2 (plus the
+    -c2_t/2 data term the expanded quad omits in-scan) in float64, so
+    accumulation error does not grow with T (module docstring).  Y and R64
+    must be the UNPADDED panel/noise — shared by ``sv_filter`` and
+    ``parallel.sharded_sv.sharded_sv_filter`` so the two paths cannot drift.
+    """
+    N = Y.shape[1]
+    const = -0.5 * (N * _LOG2PI + np.sum(np.log(R64)))
+    lls = np.asarray(ll_rel, np.float64) + const
+    if not residual:
+        Y64 = np.asarray(Y, np.float64)
+        lls -= 0.5 * np.einsum("tn,n,tn->t", Y64, 1.0 / R64, Y64)
+    return lls
 
 
 def sv_filter(Y, p: SSMParams, spec: SVSpec,
@@ -229,15 +264,8 @@ def sv_filter(Y, p: SSMParams, spec: SVSpec,
         Y, p, jnp.asarray(h_center, dtype), sig, h0s, key,
         k=spec.n_factors, M=spec.n_particles, ess_frac=spec.ess_frac,
         residual=spec.quad_form == "residual", store_paths=store_paths)
-    # Host float64 assembly of the particle-independent constant and the
-    # total: no f32 accumulation error over T (module docstring).
-    T, N = Y.shape
-    R64 = np.asarray(p.R, np.float64)
-    const = -0.5 * (N * _LOG2PI + np.sum(np.log(R64)))
-    lls = np.asarray(ll_rel, np.float64) + const
-    if spec.quad_form != "residual":
-        Y64 = np.asarray(Y, np.float64)
-        lls -= 0.5 * np.einsum("tn,n,tn->t", Y64, 1.0 / R64, Y64)
+    lls = _host_lls(ll_rel, Y, np.asarray(p.R, np.float64),
+                    residual=spec.quad_form == "residual")
     return SVResult(loglik=np.sum(lls), f_mean=f_mean, h_mean=h_mean,
                     ess=ess, n_resamples=n_rs, h_particles=h_hist,
                     logw=logw_hist, lls=lls)

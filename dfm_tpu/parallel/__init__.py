@@ -3,12 +3,15 @@ device mesh with psum collectives — the TPU-native equivalent of the
 reference's (nonexistent) multi-process story, per BASELINE.json:5."""
 
 from .mesh import SERIES_AXIS, make_mesh, pad_panel, unpad_rows
-from .sharded import (ShardedEM, sharded_em_step, sharded_em_fit,
-                      sharded_filter_smoother)
+from .sharded import (ShardedEM, sharded_em_step, sharded_em_scan,
+                      sharded_em_fit, sharded_filter_smoother)
 from .sharded_mf import sharded_mf_fit
+from .sharded_sv import sharded_sv_filter
+from .sharded_tvl import sharded_tvl_fit
 
 __all__ = [
     "SERIES_AXIS", "make_mesh", "pad_panel", "unpad_rows",
-    "ShardedEM", "sharded_em_step", "sharded_em_fit",
-    "sharded_filter_smoother", "sharded_mf_fit",
+    "ShardedEM", "sharded_em_step", "sharded_em_scan", "sharded_em_fit",
+    "sharded_filter_smoother", "sharded_mf_fit", "sharded_sv_filter",
+    "sharded_tvl_fit",
 ]

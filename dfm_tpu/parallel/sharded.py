@@ -44,38 +44,77 @@ from ..ssm.kalman import rts_smoother
 from ..ssm.params import SSMParams, FilterResult
 from .mesh import SERIES_AXIS, make_mesh, pad_panel, unpad_rows
 
-__all__ = ["sharded_em_step", "sharded_em_fit", "sharded_filter_smoother",
-           "ShardedEM"]
+__all__ = ["sharded_em_step", "sharded_em_fit", "sharded_em_scan",
+           "sharded_filter_smoother", "ShardedEM"]
 
 
 def _psum_stats(stats: ObsStats) -> ObsStats:
     return ObsStats(*(lax.psum(x, SERIES_AXIS) for x in stats))
 
 
-def _shard_filter_smoother(Y_s, mask_s, p_s: SSMParams):
+def _shard_filter_smoother(Y_s, mask_s, p_s: SSMParams,
+                           cfg: EMConfig = EMConfig(filter="info"),
+                           gate_s=None):
     """Per-device body: local stats -> psum -> replicated k x k scans.
 
     The loglik quadratic is reduced in a second psum of the per-shard
-    residual terms (see info_filter module docstring's float32 note)."""
+    residual terms (see info_filter module docstring's float32 note).
+    ``cfg.filter == "ss"`` routes the replicated part through the
+    steady-state engine (``ssm.steady.ss_from_stats`` — the single-chip
+    headline speed path, now available under sharding): only the k-sized
+    stats psum and the loglik psum touch the network, so the sequential
+    depth stays ~3*tau + O(sqrt(T)) regardless of T or N.  Masked or short
+    panels fall back to the exact info scan (same rule as
+    ``ss_filter_smoother``; both branches resolve at trace time).
+
+    ``gate_s`` (local (n,) {0,1}, 0 = padded series) marks mesh-divisibility
+    padding on UNMASKED panels.  Padded series carry Lam = 0, R = 1, Y = 0,
+    so they already contribute nothing to any reduction; the gate only fixes
+    the observation COUNT in the loglik constant (and lets the M-step keep
+    the pads pinned, see ``_shard_em_step``) — it must NOT become a mask,
+    which would force the masked (T,k,k) stats path and knock out the ss
+    engine for every padded unmasked panel.
+
+    Returns (kf, sm, delta) with delta the ss freeze diagnostic (0 exact).
+    """
+    T = Y_s.shape[0]
+    use_ss = (cfg.filter == "ss" and mask_s is None and T > 2 * cfg.tau + 4)
     stats = _psum_stats(obs_stats(Y_s, p_s.Lam, p_s.R, mask=mask_s))
-    xp, Pp, xf, Pf, logdetG = info_scan(stats, p_s.A, p_s.Q, p_s.mu0, p_s.P0)
+    if gate_s is not None and mask_s is None:
+        n_real = lax.psum(jnp.sum(gate_s), SERIES_AXIS)
+        stats = stats._replace(n=jnp.full_like(stats.n, n_real))
+    if use_ss:
+        from ..ssm.steady import ss_from_stats
+        xp, Pp, xf, Pf, logdetG, sm, delta = ss_from_stats(
+            stats, p_s, T, cfg.tau)
+    else:
+        xp, Pp, xf, Pf, logdetG = info_scan(stats, p_s.A, p_s.Q,
+                                            p_s.mu0, p_s.P0)
+        delta = jnp.zeros((), Y_s.dtype)
     quad_R, U = loglik_terms_local(Y_s, p_s.Lam, p_s.R, xp, mask_s)
     quad_R = lax.psum(quad_R, SERIES_AXIS)
     U = lax.psum(U, SERIES_AXIS)
     kf = FilterResult(xp, Pp, xf, Pf,
                       loglik_from_terms(stats, logdetG, Pf, quad_R, U))
-    sm = rts_smoother(kf, p_s)
-    return kf, sm
+    if not use_ss:
+        sm = rts_smoother(kf, p_s)
+    return kf, sm, delta
 
 
-def _shard_em_step(Y_s, mask_s, p_s: SSMParams, cfg: EMConfig):
-    kf, sm = _shard_filter_smoother(Y_s, mask_s, p_s)
+def _shard_em_step(Y_s, mask_s, p_s: SSMParams, cfg: EMConfig, gate_s=None):
+    kf, sm, delta = _shard_filter_smoother(Y_s, mask_s, p_s, cfg, gate_s)
     EffT, cross = moments(sm)
     S_ff = EffT.sum(0)
     Lam_s, R_s = mstep_rows(Y_s, mask_s, sm.x_sm, EffT, sm.P_sm, S_ff,
                             cfg.r_floor)
+    if gate_s is not None and mask_s is None:
+        # Keep the pads at their neutral (Lam=0, R=1): the unmasked M-step
+        # would otherwise drive a pad's R to r_floor (its residual is 0),
+        # poisoning ldR = sum log R in the next iteration's loglik.
+        Lam_s = gate_s[:, None] * Lam_s
+        R_s = jnp.where(gate_s > 0, R_s, jnp.ones_like(R_s))
     A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p_s, cfg)
-    return SSMParams(Lam_s, A, Q, R_s, mu0, P0), kf.loglik
+    return SSMParams(Lam_s, A, Q, R_s, mu0, P0), kf.loglik, delta
 
 
 def _param_specs():
@@ -83,36 +122,83 @@ def _param_specs():
                      R=P(SERIES_AXIS), mu0=P(), P0=P())
 
 
-@partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask"))
-def _sharded_em_step_impl(Y, mask, p: SSMParams, mesh: Mesh, cfg: EMConfig,
-                          has_mask: bool):
-    def body(Y_s, mask_s, p_s):
-        return _shard_em_step(Y_s, mask_s if has_mask else None, p_s, cfg)
+@partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask", "has_gate"))
+def _sharded_em_step_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
+                          cfg: EMConfig, has_mask: bool, has_gate: bool):
+    def body(Y_s, mask_s, gate_s, p_s):
+        p_new, ll, delta = _shard_em_step(
+            Y_s, mask_s if has_mask else None, p_s, cfg,
+            gate_s if has_gate else None)
+        return p_new, ll, delta
 
     mapped = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS), _param_specs()),
-        out_specs=(_param_specs(), P()),
+        in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS),
+                  P(SERIES_AXIS), _param_specs()),
+        out_specs=(_param_specs(), P(), P()),
         check_vma=False)
     if mask is None:
         mask = jnp.ones_like(Y)  # placeholder; body ignores it when !has_mask
-    return mapped(Y, mask, p)
+    if gate is None:
+        gate = jnp.ones((Y.shape[1],), Y.dtype)
+    return mapped(Y, mask, gate, p)
 
 
-@partial(jax.jit, static_argnames=("mesh", "has_mask"))
-def _sharded_smooth_impl(Y, mask, p: SSMParams, mesh: Mesh, has_mask: bool):
-    def body(Y_s, mask_s, p_s):
-        kf, sm = _shard_filter_smoother(Y_s, mask_s if has_mask else None, p_s)
+@partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask", "has_gate",
+                                   "n_iters"))
+def _sharded_em_scan_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
+                          cfg: EMConfig, has_mask: bool, has_gate: bool,
+                          n_iters: int):
+    """n EM iterations fused into ONE XLA program: ``lax.scan`` over the
+    shard_map body (VERDICT r2 item 3 — the sharded analog of
+    ``em_fit_scan``).  The per-iteration psums sit inside the scan, so a
+    multi-device fit pays program-dispatch cost once per CHUNK instead of
+    once per iteration (~60-100 ms/dispatch on tunneled devices,
+    docs/PERF.md item 4 — the difference between ~10 and ~400 iters/sec)."""
+    def body(Y_s, mask_s, gate_s, p_s):
+        m = mask_s if has_mask else None
+        g = gate_s if has_gate else None
+
+        def it(p_c, _):
+            p_new, ll, delta = _shard_em_step(Y_s, m, p_c, cfg, g)
+            return p_new, (ll, delta)
+
+        p_f, (lls, deltas) = lax.scan(it, p_s, None, length=n_iters)
+        return p_f, lls, deltas
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS),
+                  P(SERIES_AXIS), _param_specs()),
+        out_specs=(_param_specs(), P(), P()),
+        check_vma=False)
+    if mask is None:
+        mask = jnp.ones_like(Y)
+    if gate is None:
+        gate = jnp.ones((Y.shape[1],), Y.dtype)
+    return mapped(Y, mask, gate, p)
+
+
+@partial(jax.jit, static_argnames=("mesh", "has_mask", "has_gate"))
+def _sharded_smooth_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
+                         has_mask: bool, has_gate: bool):
+    def body(Y_s, mask_s, gate_s, p_s):
+        kf, sm, _ = _shard_filter_smoother(
+            Y_s, mask_s if has_mask else None, p_s,
+            gate_s=gate_s if has_gate else None)
         return sm.x_sm, sm.P_sm, kf.loglik
 
     mapped = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS), _param_specs()),
+        in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS),
+                  P(SERIES_AXIS), _param_specs()),
         out_specs=(P(), P(), P()),
         check_vma=False)
     if mask is None:
         mask = jnp.ones_like(Y)
-    return mapped(Y, mask, p)
+    if gate is None:
+        gate = jnp.ones((Y.shape[1],), Y.dtype)
+    return mapped(Y, mask, gate, p)
 
 
 class ShardedEM:
@@ -131,10 +217,22 @@ class ShardedEM:
         R0 = np.asarray(p0.R)
         Yp, Wp, Lp, Rp, self.n_pad = pad_panel(
             np.asarray(Y, np.float64), mask, Lam0, R0, n_shards)
-        self.has_mask = Wp is not None
-        self.cfg = dataclasses.replace(cfg, filter="info")
+        # A REAL mask (user-supplied / NaN pattern) selects the masked code
+        # paths; mesh-divisibility padding alone does NOT — it is handled by
+        # the row gate so unmasked panels keep the cheap time-invariant
+        # stats and the ss engine (see _shard_filter_smoother).
+        self.has_mask = mask is not None
+        self.has_gate = self.n_pad > 0 and not self.has_mask
+        # "info" and "ss" are the sharded E-step implementations; anything
+        # else (dense/pit/auto) maps to the exact info scan.
+        if cfg.filter != "ss":
+            cfg = dataclasses.replace(cfg, filter="info")
+        self.cfg = cfg
         self.Y = jnp.asarray(Yp, dtype)
         self.mask = jnp.asarray(Wp, dtype) if self.has_mask else None
+        self.gate = (jnp.asarray(
+            np.concatenate([np.ones(Y.shape[1]), np.zeros(self.n_pad)]),
+            dtype) if self.has_gate else None)
         self.p = SSMParams(
             Lam=jnp.asarray(Lp, dtype), A=jnp.asarray(p0.A, dtype),
             Q=jnp.asarray(p0.Q, dtype), R=jnp.asarray(Rp, dtype),
@@ -142,13 +240,25 @@ class ShardedEM:
 
     def step(self):
         """One EM iteration; returns loglik at the entering params."""
-        self.p, ll = _sharded_em_step_impl(
-            self.Y, self.mask, self.p, self.mesh, self.cfg, self.has_mask)
+        self.p, ll, self.last_delta = _sharded_em_step_impl(
+            self.Y, self.mask, self.gate, self.p, self.mesh, self.cfg,
+            self.has_mask, self.has_gate)
         return ll
+
+    def run_scan(self, p: SSMParams, n_iters: int):
+        """n fused EM iterations from ``p`` (does NOT update ``self.p``).
+
+        Returns (params, logliks (n,), ss_deltas (n,)) — the sharded analog
+        of ``estim.em.em_fit_scan``, one XLA dispatch total.
+        """
+        return _sharded_em_scan_impl(self.Y, self.mask, self.gate, p,
+                                     self.mesh, self.cfg, self.has_mask,
+                                     self.has_gate, n_iters)
 
     def smooth(self):
         x_sm, P_sm, ll = _sharded_smooth_impl(
-            self.Y, self.mask, self.p, self.mesh, self.has_mask)
+            self.Y, self.mask, self.gate, self.p, self.mesh, self.has_mask,
+            self.has_gate)
         return x_sm, P_sm, ll
 
     def params_numpy(self, p: Optional[SSMParams] = None):
@@ -163,17 +273,33 @@ class ShardedEM:
             P0=np.asarray(p.P0, np.float64))
 
 
+def _sharded_cfg(cfg: EMConfig) -> EMConfig:
+    return cfg if cfg.filter == "ss" else dataclasses.replace(cfg,
+                                                              filter="info")
+
+
 def sharded_em_step(Y, p, mask=None, mesh=None, cfg: EMConfig = EMConfig()):
-    """Functional one-shot sharded EM step (shapes must already divide)."""
+    """Functional one-shot sharded EM step (shapes must already divide).
+
+    Returns (params, loglik, ss_delta)."""
     mesh = mesh if mesh is not None else make_mesh()
-    return _sharded_em_step_impl(Y, mask, p, mesh,
-                                 dataclasses.replace(cfg, filter="info"),
-                                 mask is not None)
+    return _sharded_em_step_impl(Y, mask, None, p, mesh, _sharded_cfg(cfg),
+                                 mask is not None, False)
+
+
+def sharded_em_scan(Y, p, n_iters: int, mask=None, mesh=None,
+                    cfg: EMConfig = EMConfig()):
+    """n fused sharded EM iterations in one XLA program (shapes must already
+    divide the mesh).  Returns (params, logliks (n,), ss_deltas (n,))."""
+    mesh = mesh if mesh is not None else make_mesh()
+    return _sharded_em_scan_impl(Y, mask, None, p, mesh, _sharded_cfg(cfg),
+                                 mask is not None, False, n_iters)
 
 
 def sharded_filter_smoother(Y, p, mask=None, mesh=None):
     mesh = mesh if mesh is not None else make_mesh()
-    return _sharded_smooth_impl(Y, mask, p, mesh, mask is not None)
+    return _sharded_smooth_impl(Y, mask, None, p, mesh, mask is not None,
+                                False)
 
 
 def sharded_em_fit(Y, p0, mask=None, mesh=None, cfg: EMConfig = EMConfig(),
@@ -185,21 +311,26 @@ def sharded_em_fit(Y, p0, mask=None, mesh=None, cfg: EMConfig = EMConfig(),
     drv = ShardedEM(Y, p0, mask=mask, mesh=mesh, dtype=dtype, cfg=cfg)
 
     entering = prev_entering = drv.p
+    max_delta = 0.0
 
     def step(it):
-        nonlocal entering, prev_entering
+        nonlocal entering, prev_entering, max_delta
         prev_entering = entering
         entering = drv.p
         ll = drv.step()
+        if drv.cfg.filter == "ss":
+            max_delta = max(max_delta, float(drv.last_delta))
         # Only materialize host params when someone is listening.
         cb_params = (drv.params_numpy(entering)
                      if callback is not None else None)
         return ll, cb_params
 
-    from ..estim.em import noise_floor_for
+    from ..estim.em import noise_floor_for, warn_ss_delta
     lls, converged, em_state = run_em_loop(
         step, max_iters, tol, callback,
         noise_floor=noise_floor_for(drv.Y.dtype))
+    if drv.cfg.filter == "ss":
+        warn_ss_delta(max_delta, drv.cfg.tau)
     drv.p_iters = len(lls)
     if em_state == "diverged":
         # The drop at iteration j was caused by the update in j-1: hand back

@@ -1,0 +1,117 @@
+"""Series-sharded Rao-Blackwellized particle filter for the SV-DFM.
+
+Config S5 (BASELINE.json:11) is defined at 10 000 series — the cross-section,
+not the particle cloud, is where the memory and FLOPs grow, so the series
+axis is the one sharded (same 1-D ``"series"`` mesh as the plain DFM).
+
+Layout per device: its own columns of the panel ``Y (T, n_local)``, rows of
+``Lam (n_local, k)`` and ``R (n_local,)``; the particle cloud (x, P, h, logW)
+is REPLICATED — every device propagates the identical M particles from the
+identical PRNG key, so no particle state ever crosses the network.  The only
+collectives are:
+
+  - once, before the scan: psum of the k-sized stats C = Lam'R^{-1}Lam and
+    B = Y R^{-1} Lam (the ``"expanded"`` weight path needs nothing else —
+    ZERO in-scan collectives);
+  - per step, in the default ``"residual"`` weight path: psum of the
+    per-particle residual reductions c2 (M,) and u = Lam'R^{-1}v (M, k) —
+    an O(M k) payload independent of N.
+
+The scan body is the SAME function the single-device filter runs
+(``models.sv._rbpf_scan``) with the reduction hook bound to psum, so matched
+PRNG keys give matching particle paths and resampling decisions up to psum
+rounding — asserted against the single-device filter in
+``tests/test_sharded_sv.py`` on the fake 8-device mesh.
+
+Padded series (N not divisible by the mesh) get Lam = 0, R = 1, Y = 0: their
+residual is identically zero, so they drop out of every reduction; the
+particle-independent loglik constant is assembled host-side from the UNPADDED
+R, exactly as in ``sv_filter``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.sv import (SVSpec, SVResult, _rbpf_scan, _as_sigma_vec,
+                         _host_lls)
+from ..ssm.params import SSMParams
+from .mesh import SERIES_AXIS, make_mesh, pad_panel
+
+__all__ = ["sharded_sv_filter"]
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "M", "ess_frac", "residual",
+                                   "store_paths"))
+def _sharded_sv_impl(Y, Lam, R, A, mu0, P0, h_center, sigma_h, h0_scale, key,
+                     mesh: Mesh, k: int, M: int, ess_frac: float,
+                     residual: bool, store_paths: bool):
+    def body(Y_s, Lam_s, R_s, A, mu0, P0, h_center, sigma_h, h0_scale, key):
+        def psum(x):
+            return lax.psum(x, SERIES_AXIS)
+
+        G0 = Lam_s * (1.0 / R_s)[:, None]
+        C = psum(Lam_s.T @ G0)                        # global (k, k)
+        B = psum(Y_s @ G0)                            # global (T, k)
+        return _rbpf_scan(Y_s, Lam_s, R_s, C, B, A, mu0, P0, h_center,
+                          sigma_h, h0_scale, key, k=k, M=M,
+                          ess_frac=ess_frac, residual=residual,
+                          store_paths=store_paths, reduce_fn=psum)
+
+    rep = P()
+    # _rbpf_scan always returns a 7-tuple; the last two entries are None
+    # when store_paths=False (leafless subtrees — any spec matches).
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, SERIES_AXIS), P(SERIES_AXIS, None), P(SERIES_AXIS),
+                  rep, rep, rep, rep, rep, rep, rep),
+        out_specs=(rep,) * 7,
+        check_vma=False)
+    return mapped(Y, Lam, R, A, mu0, P0, h_center, sigma_h, h0_scale, key)
+
+
+def sharded_sv_filter(Y, p: SSMParams, spec: SVSpec,
+                      key: Optional[jax.Array] = None,
+                      h_center: Optional[jax.Array] = None,
+                      sigma_h=None, store_paths: bool = True,
+                      mesh: Optional[Mesh] = None) -> SVResult:
+    """Multi-device ``sv_filter``; mirrors its contract (see ``models.sv``).
+
+    Pads the series axis to the mesh size automatically; the returned
+    ``SVResult`` is in the same units as the single-device filter.
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    mesh = mesh if mesh is not None else make_mesh()
+    dtype = Y.dtype if hasattr(Y, "dtype") else jnp.float32
+    p = p.astype(dtype)
+    if h_center is None:
+        h_center = jnp.log(jnp.clip(jnp.diagonal(p.Q), 1e-8, None))
+    sig = _as_sigma_vec(spec.sigma_h if sigma_h is None else sigma_h,
+                        spec.n_factors, dtype)
+    h0s = jnp.asarray(spec.h0_scale, dtype)
+
+    R_unpadded = np.asarray(p.R, np.float64)
+    Yp, _, Lp, Rp, _ = pad_panel(np.asarray(Y, np.float64), None,
+                                 np.asarray(p.Lam, np.float64), R_unpadded,
+                                 int(mesh.devices.size))
+    ll_rel, f_mean, h_mean, ess, n_rs, h_hist, logw_hist = _sharded_sv_impl(
+        jnp.asarray(Yp, dtype), jnp.asarray(Lp, dtype),
+        jnp.asarray(Rp, dtype), p.A, p.mu0, p.P0,
+        jnp.asarray(h_center, dtype), sig, h0s, key, mesh,
+        k=spec.n_factors, M=spec.n_particles, ess_frac=spec.ess_frac,
+        residual=spec.quad_form == "residual", store_paths=store_paths)
+    # Shared host float64 assembly, from the UNPADDED panel/R (padded series
+    # contribute nothing in-scan by design).
+    lls = _host_lls(ll_rel, Y, R_unpadded,
+                    residual=spec.quad_form == "residual")
+    return SVResult(loglik=np.sum(lls), f_mean=f_mean, h_mean=h_mean,
+                    ess=ess, n_resamples=n_rs, h_particles=h_hist,
+                    logw=logw_hist, lls=lls)

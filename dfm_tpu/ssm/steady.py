@@ -46,7 +46,8 @@ from .info_filter import (obs_stats, info_filter, loglik_terms_local,
 from .kalman import rts_smoother
 from .params import SSMParams, FilterResult, SmootherResult
 
-__all__ = ["ss_filter", "ss_smoother", "ss_filter_smoother", "DEFAULT_TAU"]
+__all__ = ["ss_filter", "ss_smoother", "ss_filter_smoother", "ss_from_stats",
+           "DEFAULT_TAU"]
 
 DEFAULT_TAU = 96
 
@@ -86,24 +87,18 @@ def _freeze(path, T, tau):
     return jnp.concatenate([path, tail], axis=0)
 
 
-def ss_filter_smoother(Y: jax.Array, p: SSMParams, tau: int = DEFAULT_TAU,
-                       mask: Optional[jax.Array] = None
-                       ) -> Tuple[FilterResult, SmootherResult, jax.Array]:
-    """Filter + smoother with steady-state acceleration.
+def ss_from_stats(stats, p: SSMParams, T: int, tau: int):
+    """The replicated k x k part of the steady-state pass, from GLOBAL stats.
 
-    Returns (FilterResult, SmootherResult, convergence_diagnostic).  Falls
-    back to the exact sequential pair when masked or T <= 2 tau + 4 (the
-    diagnostic is then 0).
+    Everything below depends on the panel only through ``stats`` (already
+    psum'd under sharding — see ``parallel.sharded``), so every device runs it
+    identically.  Returns (x_pred, P_pred, x_filt, P_filt, logdetG, sm,
+    delta); the innovation-quadratic loglik pieces are NOT computed here —
+    callers run ``loglik_terms_local`` on their (local) panel block and
+    assemble with ``loglik_from_terms``.
     """
-    T = Y.shape[0]
-    if mask is not None or T <= 2 * tau + 4:
-        kf = info_filter(Y, p, mask=mask)
-        return kf, rts_smoother(kf, p), jnp.zeros((), Y.dtype)
-
-    dtype = Y.dtype
-    p = p.astype(dtype)
+    dtype = stats.b.dtype
     k = p.A.shape[0]
-    stats = obs_stats(Y, p.Lam, p.R)         # C static, b (T, k)
     C = stats.C
     Pp_ex, Pf_ex, M_ex, ldG_ex, delta = _cov_path(
         C, p.A, p.Q, p.P0, tau, dtype)
@@ -120,10 +115,6 @@ def ss_filter_smoother(Y: jax.Array, p: SSMParams, tau: int = DEFAULT_TAU,
     x_tail = jnp.einsum("tkl,l->tk", Mpref, x0) + dpref
     x_filt = jnp.concatenate([x0[None], x_tail], axis=0)
     x_pred = jnp.concatenate([p.mu0[None], x_filt[:-1] @ p.A.T], axis=0)
-
-    quad_R, U = loglik_terms_local(Y, p.Lam, p.R, x_pred, None)
-    ll = loglik_from_terms(stats, logdetG, P_filt, quad_R, U)
-    kf = FilterResult(x_pred, P_pred, x_filt, P_filt, ll)
 
     # ----- smoother -----
     # Gains: exact for t < tau, steady after (J_t depends only on P path).
@@ -177,7 +168,31 @@ def ss_filter_smoother(Y: jax.Array, p: SSMParams, tau: int = DEFAULT_TAU,
     P_lag_tail = jnp.einsum("tij,tkj->tik", P_sm[1:], J)
     P_lag = jnp.concatenate([jnp.zeros((1, k, k), dtype), P_lag_tail],
                             axis=0)
-    return kf, SmootherResult(x_sm, P_sm, P_lag), delta
+    return (x_pred, P_pred, x_filt, P_filt, logdetG,
+            SmootherResult(x_sm, P_sm, P_lag), delta)
+
+
+def ss_filter_smoother(Y: jax.Array, p: SSMParams, tau: int = DEFAULT_TAU,
+                       mask: Optional[jax.Array] = None
+                       ) -> Tuple[FilterResult, SmootherResult, jax.Array]:
+    """Filter + smoother with steady-state acceleration.
+
+    Returns (FilterResult, SmootherResult, convergence_diagnostic).  Falls
+    back to the exact sequential pair when masked or T <= 2 tau + 4 (the
+    diagnostic is then 0).
+    """
+    T = Y.shape[0]
+    if mask is not None or T <= 2 * tau + 4:
+        kf = info_filter(Y, p, mask=mask)
+        return kf, rts_smoother(kf, p), jnp.zeros((), Y.dtype)
+
+    p = p.astype(Y.dtype)
+    stats = obs_stats(Y, p.Lam, p.R)         # C static, b (T, k)
+    x_pred, P_pred, x_filt, P_filt, logdetG, sm, delta = ss_from_stats(
+        stats, p, T, tau)
+    quad_R, U = loglik_terms_local(Y, p.Lam, p.R, x_pred, None)
+    ll = loglik_from_terms(stats, logdetG, P_filt, quad_R, U)
+    return FilterResult(x_pred, P_pred, x_filt, P_filt, ll), sm, delta
 
 
 def ss_filter(Y, p, mask=None, tau: int = DEFAULT_TAU) -> FilterResult:

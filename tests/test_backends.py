@@ -100,3 +100,66 @@ def test_convergence_flag(panel):
     r = fit(m, panel, backend="cpu", max_iters=200, tol=1e-5)
     assert r.converged
     assert r.n_iters < 200
+
+
+# ---------------------------------------------------------------------------
+# Fused-chunk stop/replay semantics (code-review r4): drive _run_em_chunked
+# with a scripted loglik sequence, representing "params" as the integer
+# number of EM updates they embody — the scan stub advances the counter and
+# serves the scripted logliks, so each replay branch's arithmetic is checked
+# exactly against the per-iteration drivers' contracts.
+# ---------------------------------------------------------------------------
+
+def _run_scripted_chunked(lls_script, fused_chunk, max_iters=None, tol=1e-6):
+    import jax.numpy as jnp
+    from dfm_tpu.api import TPUBackend
+    from dfm_tpu.estim.em import EMConfig
+
+    def scan_fn(Yj, p, n, mask=None, cfg=None):
+        return p + n, jnp.asarray(lls_script[p:p + n]), jnp.zeros((n,))
+
+    b = TPUBackend(fused_chunk=fused_chunk)
+    return b._run_em_chunked(
+        jnp.zeros((2,), jnp.float64), None, 0, EMConfig(filter="info"),
+        max_iters if max_iters is not None else len(lls_script),
+        tol, None, scan_fn)
+
+
+def test_chunked_replay_converged_mid_chunk():
+    # Convergence detected at index 4 (|rel change| < tol): params must
+    # embody 5 updates, not the chunk's 8.
+    lls = [-100.0, -50.0, -30.0, -20.0, -20.0 + 1e-9, -19.0, -18.0, -17.0]
+    p, out_lls, converged, p_iters = _run_scripted_chunked(lls, fused_chunk=8)
+    assert converged and p == 5 and p_iters == 5 and len(out_lls) == 5
+
+
+def test_chunked_replay_diverged_mid_chunk():
+    # Drop at index 4 -> params entering iteration 3 (= 3 updates), the
+    # em_fit divergence contract.
+    lls = [-100.0, -50.0, -30.0, -20.0, -40.0, -10.0, -9.0, -8.0]
+    p, out_lls, converged, p_iters = _run_scripted_chunked(lls, fused_chunk=8)
+    assert not converged and p == 3 and p_iters == 3 and len(out_lls) == 5
+
+
+def test_chunked_replay_drop_at_chunk_start():
+    # fused_chunk=3: drop at global index 3 = first loglik of chunk 2, which
+    # blames chunk 1's last update -> target 2 sits BEFORE the current
+    # chunk entry (3), forcing the p_entry_prev replay branch.
+    lls = [-100.0, -50.0, -30.0, -60.0, -10.0, -9.0]
+    p, out_lls, converged, p_iters = _run_scripted_chunked(lls, fused_chunk=3)
+    assert not converged and p == 2 and p_iters == 2 and len(out_lls) == 4
+
+
+def test_chunked_converged_at_chunk_boundary_no_replay():
+    # Convergence exactly at the chunk's last index: chunk-end params already
+    # embody the target; p must be the unreplayed chunk end (4 updates).
+    lls = [-100.0, -50.0, -30.0, -30.0 + 1e-9, -20.0, -19.0]
+    p, out_lls, converged, p_iters = _run_scripted_chunked(lls, fused_chunk=4)
+    assert converged and p == 4 and p_iters == 4 and len(out_lls) == 4
+
+
+def test_chunked_maxiter_no_stop():
+    lls = [-100.0, -50.0, -30.0, -20.0, -15.0, -12.0]
+    p, out_lls, converged, p_iters = _run_scripted_chunked(
+        lls, fused_chunk=4, tol=0.0)
+    assert not converged and p == 6 and p_iters == 6 and len(out_lls) == 6

@@ -89,3 +89,103 @@ def test_fit_api_sharded_backend_matches_cpu(panel):
                max_iters=8)
     assert abs(r_sh.loglik - r_cpu.loglik) < 1e-5 * abs(r_cpu.loglik)
     np.testing.assert_allclose(r_sh.factors, r_cpu.factors, atol=1e-5)
+
+
+def test_fused_sharded_scan_matches_per_iteration(panel):
+    """One-dispatch fused chunk == per-iteration dispatch == single device
+    (VERDICT r2 item 3)."""
+    from dfm_tpu.parallel.sharded import ShardedEM
+    Yz, p0 = panel
+    mesh = make_mesh(8)
+    drv = ShardedEM(Yz, p0, mesh=mesh, dtype=jnp.float64)
+    p_scan, lls_scan, _ = drv.run_scan(drv.p, 6)
+    # per-iteration dispatch path from the same start
+    lls_iter = [float(drv.step()) for _ in range(6)]
+    np.testing.assert_allclose(np.asarray(lls_scan), lls_iter, rtol=1e-12)
+    # single-device fused scan
+    from dfm_tpu.estim.em import em_fit_scan
+    _, lls_d, _ = em_fit_scan(jnp.asarray(Yz), JP.from_numpy(p0, jnp.float64),
+                              6, cfg=EMConfig(filter="info"))
+    np.testing.assert_allclose(np.asarray(lls_scan), np.asarray(lls_d),
+                               rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(p_scan.Lam), np.asarray(drv.p.Lam),
+                               atol=1e-10)
+
+
+def test_sharded_backend_fused_chunk_matches_unfused(panel):
+    Yz, _ = panel
+    model = DynamicFactorModel(n_factors=3)
+    r1 = fit(model, Yz, max_iters=8,
+             backend=ShardedBackend(dtype=jnp.float64, fused_chunk=1))
+    r3 = fit(model, Yz, max_iters=8,
+             backend=ShardedBackend(dtype=jnp.float64, fused_chunk=3))
+    np.testing.assert_allclose(r3.logliks, r1.logliks, rtol=1e-10)
+    np.testing.assert_allclose(r3.factors, r1.factors, atol=1e-9)
+    np.testing.assert_allclose(r3.params.Lam, r1.params.Lam, atol=1e-9)
+
+
+def test_sharded_ss_filter_matches_info(panel):
+    """ShardedBackend(filter='ss') == sharded info to the ss freeze
+    tolerance (VERDICT r2 item 6).  T=70 <= 2*tau+4 would fall back, so use
+    a small tau to exercise the real steady-state path."""
+    from dfm_tpu.parallel.sharded import sharded_em_scan
+    Yz, p0 = panel
+    mesh = make_mesh(8)
+    pj = JP.from_numpy(p0, jnp.float64)
+    Yj = jnp.asarray(Yz)
+    _, lls_ss, deltas = sharded_em_scan(
+        Yj, pj, 5, mesh=mesh, cfg=EMConfig(filter="ss", tau=24))
+    _, lls_info, _ = sharded_em_scan(
+        Yj, pj, 5, mesh=mesh, cfg=EMConfig(filter="info"))
+    np.testing.assert_allclose(np.asarray(lls_ss), np.asarray(lls_info),
+                               rtol=1e-6)
+    assert float(np.max(np.asarray(deltas))) < 1e-3
+
+
+def test_sharded_em_padding_no_mask_matches(panel):
+    """Unmasked panel with padding (N=48 on 5 shards): the row gate — not a
+    materialized mask — must keep the padded run identical to single-device."""
+    Yz, p0 = panel
+    ps, lls_s, _, _ = sharded_em_fit(Yz, p0, mesh=make_mesh(5), max_iters=5,
+                                     dtype=jnp.float64)
+    pd_, lls_d, _, _ = em_fit(jnp.asarray(Yz), JP.from_numpy(p0, jnp.float64),
+                              max_iters=5, cfg=EMConfig(filter="info"))
+    np.testing.assert_allclose(lls_s, np.asarray(lls_d), rtol=1e-9)
+    np.testing.assert_allclose(ps.Lam, np.asarray(pd_.Lam), atol=1e-7)
+    np.testing.assert_allclose(ps.R, np.asarray(pd_.R), atol=1e-7)
+
+
+def test_sharded_ss_active_with_padding(panel):
+    """filter='ss' must NOT silently degrade to info when padding exists
+    (code-review r4 finding): deltas nonzero proves the ss engine ran."""
+    from dfm_tpu.estim.em import em_fit_scan
+    from dfm_tpu.parallel.sharded import ShardedEM
+    Yz, p0 = panel
+    # tau=4 is deliberately too short for full covariance convergence, so a
+    # genuinely-running ss engine MUST report a nonzero freeze diagnostic
+    # (with this panel's strong observability the recursion hits a bitwise
+    # f64 fixed point by tau~6, and delta == 0 on both paths would not
+    # distinguish ss from the fallback).
+    cfg = EMConfig(filter="ss", tau=4)
+    drv = ShardedEM(Yz, p0, mesh=make_mesh(5), dtype=jnp.float64, cfg=cfg)
+    _, lls_s, deltas = drv.run_scan(drv.p, 4)
+    assert float(np.max(np.asarray(deltas))) > 0.0
+    _, lls_d, deltas_d = em_fit_scan(jnp.asarray(Yz),
+                                     JP.from_numpy(p0, jnp.float64),
+                                     4, cfg=cfg)
+    np.testing.assert_allclose(np.asarray(deltas), np.asarray(deltas_d),
+                               rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(lls_s), np.asarray(lls_d),
+                               rtol=1e-9)
+
+
+def test_sharded_ss_fit_api(panel):
+    Yz, _ = panel
+    model = DynamicFactorModel(n_factors=3)
+    r_info = fit(model, Yz, max_iters=6,
+                 backend=ShardedBackend(dtype=jnp.float64, filter="info"))
+    r_ss = fit(model, Yz, max_iters=6,
+               backend=ShardedBackend(dtype=jnp.float64, filter="ss"))
+    # T=70 < 2*96+4 -> ss falls back to the exact path here; equality is
+    # exact.  The true ss path is covered by the tau=24 scan test above.
+    np.testing.assert_allclose(r_ss.logliks, r_info.logliks, rtol=1e-9)
