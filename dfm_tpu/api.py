@@ -143,13 +143,17 @@ class TPUBackend(Backend):
     name = "tpu"
 
     def __init__(self, dtype=None, filter: str = "auto",
-                 matmul_precision: str = "highest", fused_chunk: int = 8):
+                 matmul_precision: str = "highest", fused_chunk: int = 8,
+                 debug: bool = False):
         self.dtype = dtype
         if filter not in ("auto", "dense", "info", "ss", "pit"):
             raise ValueError(f"unknown filter {filter!r}")
         self.filter = filter
         self.matmul_precision = matmul_precision
         self.fused_chunk = max(1, int(fused_chunk))
+        # checkify NaN/inf guard around the filter scans (EMConfig.debug):
+        # poisoned data/params raise located errors instead of silent NaNs.
+        self.debug = debug
 
     def _filter_for(self, N: int) -> str:
         if self.filter == "auto":
@@ -180,7 +184,8 @@ class TPUBackend(Backend):
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
                        estimate_init=model.estimate_init,
-                       filter=self._filter_for(Y.shape[1]))
+                       filter=self._filter_for(Y.shape[1]),
+                       debug=self.debug)
         with self._precision_ctx():
             if self.fused_chunk <= 1:
                 p, lls, converged, p_iters = em_fit(
@@ -355,6 +360,13 @@ class ShardedBackend(TPUBackend):
     def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
         from .estim.em import EMConfig
         from .parallel.sharded import ShardedEM, sharded_em_fit
+        if self.debug:
+            import warnings
+            warnings.warn(
+                "debug (checkify) mode is not supported under sharding; "
+                "running unchecked — debug single-device with "
+                "TPUBackend(debug=True) instead", RuntimeWarning,
+                stacklevel=2)
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
                        estimate_init=model.estimate_init, filter=self.filter)
@@ -445,7 +457,8 @@ def fit(model: DynamicFactorModel,
         init: Optional[cpu_ref.SSMParams] = None,
         callback: Optional[Callable] = None,
         checkpoint_path: Optional[str] = None,
-        checkpoint_every: int = 10) -> FitResult:
+        checkpoint_every: int = 10,
+        debug: bool = False) -> FitResult:
     """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
 
     Y    : (T, N) panel; NaNs mark missing observations.
@@ -454,6 +467,14 @@ def fit(model: DynamicFactorModel,
     checkpoint_path : if set, EM params are saved there every
         ``checkpoint_every`` iterations (atomic npz) and a compatible
         existing checkpoint is used as the warm start (resume).
+    debug : NaN/inf guard mode (SURVEY.md section 5, sanitizers row): on
+        JAX backends the EM step is instrumented with
+        ``jax.experimental.checkify`` float checks, so poisoned inputs or
+        parameters raise a LOCATED error at the first bad op instead of
+        silently producing NaN logliks.  Much slower; diagnostic use only.
+        (NaNs in Y itself are treated as missing data, not poison — poison
+        means non-finite values the mask logic cannot see, e.g. a bad
+        ``init`` or a data bug reintroducing inf after masking.)
     """
     Y = np.asarray(Y, dtype=np.float64)
     if Y.ndim != 2:
@@ -492,6 +513,19 @@ def fit(model: DynamicFactorModel,
         init = cpu_ref.pca_init(Yz, model.n_factors,
                                 static=(model.dynamics == "static"), mask=Wm)
     b = get_backend(backend)
+    # debug only toggles THIS fit: user-supplied backend instances are
+    # restored on exit (checkify mode is orders of magnitude slower — it
+    # must not silently stick to the instance for later fits).
+    restore_debug = None
+    if debug:
+        if hasattr(b, "debug"):
+            restore_debug = b.debug
+            b.debug = True
+        else:
+            import warnings
+            warnings.warn(
+                f"backend {b.name!r} has no debug (checkify) mode; "
+                "running unchecked", RuntimeWarning, stacklevel=2)
 
     history: list = []
     t_prev = time.perf_counter()
@@ -517,23 +551,29 @@ def fit(model: DynamicFactorModel,
 
     _cb.wants_params_iter = True
 
-    if ck is not None and done_iters >= max_iters:
-        # The checkpoint already exhausted this budget: return its state
-        # instead of creeping past max_iters one iteration per rerun.
-        params, lls, converged = init, np.asarray(ck[2]), ck[3]
-    else:
-        out = b.run_em(Yz, Wm, init, model, max_iters - done_iters, tol, _cb)
-        params, lls, converged = out[:3]
-        # Built-in backends report how many EM updates the returned params
-        # embody (!= len(lls) after a divergence or mid-chunk stop);
-        # third-party 3-tuple backends default to len(lls).
-        p_iters = out[3] if len(out) > 3 else len(lls)
-        if checkpoint_path is not None:
-            from .utils.checkpoint import save_checkpoint
-            save_checkpoint(checkpoint_path, params, done_iters + p_iters,
-                            [h["loglik"] for h in history],
-                            fingerprint=fingerprint, converged=converged)
-    x_sm, P_sm = b.smooth(Yz, Wm, params)
+    try:
+        if ck is not None and done_iters >= max_iters:
+            # The checkpoint already exhausted this budget: return its state
+            # instead of creeping past max_iters one iteration per rerun.
+            params, lls, converged = init, np.asarray(ck[2]), ck[3]
+        else:
+            out = b.run_em(Yz, Wm, init, model, max_iters - done_iters, tol,
+                           _cb)
+            params, lls, converged = out[:3]
+            # Built-in backends report how many EM updates the returned
+            # params embody (!= len(lls) after a divergence or mid-chunk
+            # stop); third-party 3-tuple backends default to len(lls).
+            p_iters = out[3] if len(out) > 3 else len(lls)
+            if checkpoint_path is not None:
+                from .utils.checkpoint import save_checkpoint
+                save_checkpoint(checkpoint_path, params,
+                                done_iters + p_iters,
+                                [h["loglik"] for h in history],
+                                fingerprint=fingerprint, converged=converged)
+        x_sm, P_sm = b.smooth(Yz, Wm, params)
+    finally:
+        if restore_debug is not None:
+            b.debug = restore_debug
     return FitResult(params=params, logliks=np.asarray(lls),
                      factors=x_sm, factor_cov=P_sm,
                      converged=bool(converged), n_iters=len(lls),

@@ -38,6 +38,13 @@ class EMConfig:
             ``ssm.parallel_filter``), or "ss" (steady-state accelerated —
             ~3*tau sequential covariance steps + blocked affine mean scans,
             see ``ssm.steady``; falls back to exact when masked/short).
+
+    debug: instrument the jitted EM step with ``jax.experimental.checkify``
+           float checks (NaN/inf/div-by-zero on every primitive, threaded
+           through the scans), so a poisoned panel or non-PSD parameter
+           raises a LOCATED error at the producing op instead of silently
+           propagating NaNs (SURVEY.md section 5, sanitizers row).  Orders
+           of magnitude slower — a diagnostic mode, never the hot path.
     """
     estimate_A: bool = True
     estimate_Q: bool = True
@@ -46,6 +53,7 @@ class EMConfig:
     filter: str = "dense"
     tau: int = 96        # steady-state horizon (filter="ss" only); raise for
                          # very persistent factor dynamics (see ssm.steady)
+    debug: bool = False
 
     def filter_fn(self):
         return {"dense": kalman_filter, "info": info_filter,
@@ -152,12 +160,32 @@ def _em_step_impl(Y, mask, p: SSMParams, cfg: EMConfig, has_mask: bool):
     return p_new, kf.loglik, delta
 
 
+@partial(jax.jit, static_argnames=("cfg", "has_mask"))
+def _em_step_checked_impl(Y, mask, p: SSMParams, cfg: EMConfig,
+                          has_mask: bool):
+    """Debug-mode EM step: every float op checkified (see EMConfig.debug)."""
+    from jax.experimental import checkify
+
+    def f(Y, mask, p):
+        m = mask if has_mask else None
+        kf, sm, delta = cfg.e_step(Y, m, p)
+        return _m_step(Y, m, sm, p, cfg), kf.loglik, delta
+
+    return checkify.checkify(f, errors=checkify.float_checks)(Y, mask, p)
+
+
 def em_step(Y, p: SSMParams, mask=None, cfg: EMConfig = EMConfig()):
     """One EM iteration.
 
     Returns (new_params, loglik at entry params, ss_delta) — ss_delta is the
     steady-state freeze diagnostic (0 for exact filters; see EMConfig.e_step).
+    With ``cfg.debug`` the step raises a located error on the first NaN/inf
+    any primitive produces (instead of returning NaN silently).
     """
+    if cfg.debug:
+        err, out = _em_step_checked_impl(Y, mask, p, cfg, mask is not None)
+        err.throw()
+        return out
     return _em_step_impl(Y, mask, p, cfg, mask is not None)
 
 
@@ -273,9 +301,34 @@ def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
     return p, lls, deltas
 
 
+@partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
+def _em_fit_scan_checked_impl(Y, mask, p0, cfg, has_mask, n_iters):
+    """Debug-mode fused scan: checkify threads the error state through the
+    iteration scan, so the raised error locates the first bad op across ALL
+    fused iterations."""
+    from jax.experimental import checkify
+
+    def g(Y, mask, p0):
+        m = mask if has_mask else None
+
+        def body(p, _):
+            kf, sm, delta = cfg.e_step(Y, m, p)
+            return _m_step(Y, m, sm, p, cfg), (kf.loglik, delta)
+
+        p, (lls, deltas) = jax.lax.scan(body, p0, None, length=n_iters)
+        return p, lls, deltas
+
+    return checkify.checkify(g, errors=checkify.float_checks)(Y, mask, p0)
+
+
 def em_fit_scan(Y, p0: SSMParams, n_iters: int, mask=None,
                 cfg: EMConfig = EMConfig()):
     """Fixed-iteration EM fused into one XLA program (benchmark path:
     BASELINE.json:2 'EM iters/sec' measured without host round-trips).
     Returns (params, logliks (n,), ss_deltas (n,))."""
+    if cfg.debug:
+        err, out = _em_fit_scan_checked_impl(Y, mask, p0, cfg,
+                                             mask is not None, n_iters)
+        err.throw()
+        return out
     return _em_fit_scan_impl(Y, mask, p0, cfg, mask is not None, n_iters)

@@ -1,0 +1,84 @@
+"""Debug (checkify) NaN-guard mode: poisoned inputs raise LOCATED errors
+instead of silently propagating NaNs (SURVEY.md section 5, sanitizers row;
+VERDICT r2 item 8/"What's missing" 5).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dfm_tpu.api import DynamicFactorModel, TPUBackend, fit
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.estim.em import EMConfig, em_step, em_fit_scan
+from dfm_tpu.ssm.params import SSMParams as JP
+from dfm_tpu.utils import dgp
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(5)
+    p = dgp.dfm_params(24, 2, rng)
+    Y, _ = dgp.simulate(p, 40, rng)
+    Yz = (Y - Y.mean(0)) / Y.std(0)
+    return Yz, cpu_ref.pca_init(Yz, 2)
+
+
+def test_debug_em_step_raises_on_poisoned_panel(panel):
+    Yz, p0 = panel
+    Yp = Yz.copy()
+    Yp[7, 3] = np.nan            # poison reaching the filter unmasked
+    pj = JP.from_numpy(p0, jnp.float64)
+    # without debug: the NaN sails through to the loglik silently
+    _, ll, _ = em_step(jnp.asarray(Yp), pj, cfg=EMConfig(filter="info"))
+    assert not np.isfinite(float(ll))
+    # with debug: a located error
+    with pytest.raises(Exception, match="(?i)nan"):
+        em_step(jnp.asarray(Yp), pj,
+                cfg=EMConfig(filter="info", debug=True))
+
+
+def test_debug_fused_scan_raises_on_poisoned_params(panel):
+    Yz, p0 = panel
+    bad = p0.copy()
+    bad.R = -np.abs(bad.R)       # log R = NaN inside the loglik pieces
+    pj = JP.from_numpy(bad, jnp.float64)
+    with pytest.raises(Exception, match="(?i)nan"):
+        em_fit_scan(jnp.asarray(Yz), pj, 3,
+                    cfg=EMConfig(filter="info", debug=True))
+    # clean inputs pass through the checked path unharmed
+    _, lls, _ = em_fit_scan(jnp.asarray(Yz), JP.from_numpy(p0, jnp.float64),
+                            3, cfg=EMConfig(filter="info", debug=True))
+    assert np.all(np.isfinite(np.asarray(lls)))
+
+
+def test_fit_debug_flag(panel):
+    Yz, p0 = panel
+    model = DynamicFactorModel(n_factors=2)
+    bad = p0.copy()
+    bad.R = -np.abs(bad.R)
+    # non-debug: fit completes, returning a garbage (non-finite) loglik
+    r = fit(model, Yz, backend=TPUBackend(dtype=jnp.float64), init=bad,
+            max_iters=3, tol=0.0)
+    assert not np.isfinite(r.loglik)
+    # debug: the same poisoned fit raises
+    with pytest.raises(Exception, match="(?i)nan"):
+        fit(model, Yz, backend=TPUBackend(dtype=jnp.float64), init=bad,
+            max_iters=3, tol=0.0, debug=True)
+
+
+def test_fit_debug_flag_warns_on_cpu_backend(panel):
+    Yz, _ = panel
+    model = DynamicFactorModel(n_factors=2)
+    with pytest.warns(RuntimeWarning, match="no debug"):
+        fit(model, Yz, backend="cpu", max_iters=2, debug=True)
+
+
+def test_fit_debug_does_not_stick_to_user_backend(panel):
+    """fit(debug=True) must not leave checkify mode on the caller's
+    backend instance (code-review r4)."""
+    Yz, _ = panel
+    model = DynamicFactorModel(n_factors=2)
+    b = TPUBackend(dtype=jnp.float64)
+    assert b.debug is False
+    fit(model, Yz, backend=b, max_iters=2, debug=True)
+    assert b.debug is False
