@@ -166,8 +166,10 @@ def main():
     # (1 + 0*prev_loglik) — bitwise identity, but a loop-carried data
     # dependency XLA cannot simplify away (x*0 is unsafe for floats), so
     # neither CSE nor loop-invariant code motion can hoist the filter.
-    filter_fn = (partial(ss_filter, tau=tau) if filt == "ss"
-                 else info_filter)
+    from dfm_tpu.ssm.parallel_filter import pit_filter
+    filter_fn = {"ss": partial(ss_filter, tau=tau),
+                 "pit": pit_filter}.get(filt, info_filter)
+    log(f"loglik-eval filter: {getattr(filter_fn, 'func', filter_fn).__name__}")
 
     @partial(jax.jit, static_argnames=("n_evals",))
     def loglik_scan(Yj, pj, n_evals):

@@ -1,0 +1,61 @@
+"""Run EVERY BASELINE.json config on the current device and record the
+results: ``python -m bench.all [--out BENCH_ALL.json]``.
+
+One artifact with on-device numbers for S1-S5 at spec shape plus the
+headline 10k x 500 metric (VERDICT r2 item 4) — iters/sec for the EM
+configs, rounds/sec for TVL, filter-pass/sec for SV.  Each config runs in
+this process sequentially; the device stays warm between configs but every
+config's own warm pass is what its metric comes from (see bench.run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="BENCH_ALL.json")
+    ap.add_argument("--configs", default="s1,s2,s3,s4,s5,headline")
+    args = ap.parse_args(argv)
+
+    import jax
+    from . import run as bench_run
+
+    dev = jax.devices()[0]
+    results = {}
+    t_start = time.time()
+    for name in args.configs.split(","):
+        name = name.strip()
+        print(f"=== {name} ===", file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        try:
+            results[name] = bench_run.main(["--config", name, "--quiet"])
+        # SystemExit included: configs raise it for unknown names/kinds, and
+        # one bad config must not discard the sweep's earlier device time.
+        except (Exception, SystemExit) as e:
+            results[name] = {"config": name,
+                             "error": f"{type(e).__name__}: {e}"}
+            print(f"{name} FAILED: {e}", file=sys.stderr, flush=True)
+        results[name]["total_secs"] = time.perf_counter() - t0
+
+    out = {
+        "device": f"{dev.platform} ({dev.device_kind})",
+        "recorded_unix": t_start,
+        "results": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: {kk: vv for kk, vv in v.items()
+                          if kk in ("em_iters_per_sec",
+                                    "sv_filter_passes_per_sec", "loglik",
+                                    "error")}
+                      for k, v in results.items()}))
+    print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

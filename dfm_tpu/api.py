@@ -216,7 +216,7 @@ class TPUBackend(Backend):
         fused_chunk-1 iterations.
         """
         from .estim.em import em_progress, noise_floor_for, warn_ss_delta
-        floor = noise_floor_for(Yj.dtype)
+        floor = noise_floor_for(Yj.dtype, Yj.size)
         pass_piter = getattr(callback, "wants_params_iter", False)
         lls: list = []
         converged = False
@@ -280,8 +280,8 @@ class TPUBackend(Backend):
 
     def smooth(self, Y, mask, params):
         import jax.numpy as jnp
-        from .ssm.kalman import kalman_filter, rts_smoother
-        from .ssm.info_filter import info_filter
+        from .ssm.kalman import kalman_filter
+        from .ssm.info_filter import info_filter, smooth_jit
         from .ssm.params import SSMParams as JaxParams
         dt = self._dtype()
         Yj = jnp.asarray(Y, dt)
@@ -293,9 +293,10 @@ class TPUBackend(Backend):
                   self._filter_for(Y.shape[1])]
         pj = JaxParams.from_numpy(params, dtype=dt)
         with self._precision_ctx():
-            kf = ff(Yj, pj, mask=mj)
-            sm = rts_smoother(kf, pj)
-        return np.asarray(sm.x_sm, np.float64), np.asarray(sm.P_sm, np.float64)
+            if mj is None:
+                mj = Yj  # dead placeholder (body ignores it) — no extra op
+            x_sm, P_sm = smooth_jit(Yj, mj, pj, ff, mask is not None)
+        return np.asarray(x_sm, np.float64), np.asarray(P_sm, np.float64)
 
 
 class ShardedBackend(TPUBackend):

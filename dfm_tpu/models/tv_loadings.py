@@ -341,7 +341,7 @@ def tvl_fit(Y: np.ndarray, spec: TVLSpec,
     from ..estim.em import noise_floor_for
     lls, converged, em_state = run_em_loop(
         step, spec.n_rounds, spec.tol, callback,
-        noise_floor=noise_floor_for(dtype))
+        noise_floor=noise_floor_for(dtype, Yj.size))
     if em_state == "diverged":
         # Drop at round j <- bad update in j-1: the state ENTERING round j-1
         # is the last pre-drop one (fall back to its successor if that is

@@ -150,7 +150,7 @@ def sharded_mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
 
     from ..estim.em import noise_floor_for
     lls, converged, em_state = run_em_loop(
-        step, max_iters, tol, callback, noise_floor=noise_floor_for(dtype))
+        step, max_iters, tol, callback, noise_floor=noise_floor_for(dtype, Y.size))
     if em_state == "diverged":
         # Drop at iteration j <- bad update in j-1: restore the state
         # entering j-1 (the last pre-drop loglik's params).

@@ -116,7 +116,7 @@ def sharded_tvl_fit(Y: np.ndarray, spec: TVLSpec,
 
     lls, converged, em_state = run_em_loop(
         step, spec.n_rounds, spec.tol, callback,
-        noise_floor=noise_floor_for(dtype))
+        noise_floor=noise_floor_for(dtype, state["Y"].size))
     if em_state == "diverged":
         # Drop at round j <- bad update in j-1: the state entering j-1 is
         # the last pre-drop one (its successor if that one predates F).

@@ -236,3 +236,17 @@ def loglik_eval(Y, p, mask=None, precise: bool = True) -> float:
 @partial(jax.jit, static_argnames=("has_mask",))
 def _loglik_eval_impl(Y, p, mask, has_mask):
     return info_filter(Y, p, mask=mask if has_mask else None).loglik
+
+
+@partial(jax.jit, static_argnames=("filter_fn", "has_mask"))
+def smooth_jit(Y, mask, p, filter_fn, has_mask: bool):
+    """One fused filter+smoother program returning (x_sm, P_sm).
+
+    Eager composition costs one ~60-100 ms tunneled dispatch PER OP on this
+    device class (~2 s for a single smooth, measured) — this is the jitted
+    path ``TPUBackend.smooth`` uses.  ``filter_fn`` must be a module-level
+    function (hashable jit static).
+    """
+    kf = filter_fn(Y, p, mask=mask if has_mask else None)
+    sm = rts_smoother(kf, p)
+    return sm.x_sm, sm.P_sm

@@ -43,3 +43,22 @@ def test_sharded_mf_padding_path(mf_panel):
     np.testing.assert_allclose(r5.logliks, r1.logliks, rtol=1e-8)
     np.testing.assert_allclose(np.asarray(r5.params.Lam_q),
                                np.asarray(r1.params.Lam_q), atol=1e-6)
+
+
+def test_sharded_mf_f32_tolerance(mf_panel):
+    """TPU-dtype (f32) sharded run vs the f64 oracle: the round-trip must
+    stay inside the f32 loglik noise floor (VERDICT r2 item 9 — the sharded
+    MF path previously had only x64 equivalence evidence)."""
+    Y, mask = mf_panel
+    spec = MixedFreqSpec(n_monthly=30, n_quarterly=8, n_factors=2)
+    r64 = mf_fit(Y, spec, mask=mask, max_iters=6, tol=0.0)
+    r32 = sharded_mf_fit(Y, spec, mask=mask, mesh=make_mesh(8),
+                         max_iters=6, tol=0.0, dtype=jnp.float32)
+    # loglik: absolute tolerance at the f32 noise floor scale (~eps * n_obs)
+    n_obs = float(np.asarray(mask).sum())
+    floor = 200 * np.finfo(np.float32).eps * n_obs
+    np.testing.assert_allclose(r32.logliks, r64.logliks, atol=floor,
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(r32.params.Lam_m),
+                               np.asarray(r64.params.Lam_m), atol=5e-3)
+    np.testing.assert_allclose(r32.factors, r64.factors, atol=5e-3)

@@ -34,3 +34,19 @@ def test_sharded_tvl_padding_and_mask():
                          dtype=jnp.float64)
     np.testing.assert_allclose(r7.logliks, r1.logliks, rtol=1e-8)
     np.testing.assert_allclose(r7.common, r1.common, atol=1e-6)
+
+
+def test_sharded_tvl_f32_tolerance():
+    """TPU-dtype (f32) sharded run vs the f64 oracle, uneven 7-shard mesh
+    (VERDICT r2 item 9 — previously x64-only equivalence evidence)."""
+    rng = np.random.default_rng(97)
+    Y, F, Lams, _, _ = dgp.simulate_tv_loadings(32, 120, 2, rng,
+                                                walk_scale=0.05)
+    spec = TVLSpec(n_factors=2, n_rounds=4, tol=0.0)
+    r64 = tvl_fit(Y, spec)
+    r32 = sharded_tvl_fit(Y, spec, mesh=make_mesh(7), dtype=jnp.float32)
+    n_obs = float(Y.size)
+    floor = 200 * np.finfo(np.float32).eps * n_obs
+    np.testing.assert_allclose(r32.logliks, r64.logliks, atol=floor,
+                               rtol=1e-4)
+    np.testing.assert_allclose(r32.common, r64.common, atol=5e-3)
