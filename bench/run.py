@@ -25,6 +25,14 @@ import json
 import sys
 import time
 
+import jax
+
+# Same dtype regime as bench.py and the test suite: data/params stay
+# explicitly float32 on accelerators, but the small high-sensitivity pieces
+# (loglik assembly, the MF augmented-state scans) upgrade to f64 — see
+# info_filter.loglik_from_terms and mixed_freq.mf_em_core.
+jax.config.update("jax_enable_x64", True)
+
 import numpy as np
 
 from dfm_tpu.api import DynamicFactorModel, fit
@@ -81,8 +89,8 @@ def _run_sv(cfg, Y, iters, backend, cb):
     # ddof-1 — utils.data.standardize), not an ad-hoc reimplementation.
     from dfm_tpu.utils.data import standardize as _std
     std, _ = _std(np.asarray(Y, np.float64))
-    dtype = (jnp.float64 if jax.config.jax_enable_x64
-             and jax.default_backend() == "cpu" else jnp.float32)
+    from dfm_tpu.ops.precision import default_compute_dtype
+    dtype = default_compute_dtype()
     Yj = jnp.asarray(std, dtype)
     pj = JP.from_numpy(svr.params, dtype=dtype)
     key = jax.random.PRNGKey(1)

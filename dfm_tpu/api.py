@@ -169,9 +169,8 @@ class TPUBackend(Backend):
         import jax.numpy as jnp
         if self.dtype is not None:
             return jnp.dtype(self.dtype)
-        if jax.config.jax_enable_x64 and jax.default_backend() == "cpu":
-            return jnp.dtype("float64")
-        return jnp.dtype("float32")
+        from .ops.precision import default_compute_dtype
+        return default_compute_dtype()
 
     def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
         import jax.numpy as jnp
@@ -200,83 +199,20 @@ class TPUBackend(Backend):
                         em_fit_scan):
         """Fused-chunk driver: one XLA program per ``fused_chunk`` iters.
 
-        Convergence/divergence can only be detected once a chunk's logliks
-        reach the host, by which point the device params embody the WHOLE
-        chunk.  To keep fused fits exactly equivalent to per-iteration fits,
-        a mid-chunk stop replays the chunk's prefix from the stored
-        chunk-entry params (one shorter fused program, compiled once per
-        distinct tail length) so the returned params embody precisely the
-        update count the stopping rule selected — including the divergence
-        rule's "params entering the pre-drop iteration".
-
-        Callbacks receive chunk-entry params; a callback carrying
-        ``wants_params_iter = True`` (api.fit's checkpoint hook) is
-        additionally passed ``params_iter`` — the iteration those params
-        actually embody — so checkpoints are never mislabeled by up to
-        fused_chunk-1 iterations.
+        Thin adapter over the shared ``estim.em.run_em_chunked`` (the exact
+        stop/replay semantics — chunk-prefix replay on mid-chunk stops,
+        chunk-entry params to callbacks — are documented there).
         """
-        from .estim.em import em_progress, noise_floor_for, warn_ss_delta
-        floor = noise_floor_for(Yj.dtype, Yj.size)
-        pass_piter = getattr(callback, "wants_params_iter", False)
-        lls: list = []
-        converged = False
-        stop = False
-        target = 0      # update count the stopping rule selects (from start)
-        max_delta = 0.0
-        p = pj
-        it = 0
-        p_entry = p_entry_prev = pj
-        entry_it = entry_it_prev = 0
-        while it < max_iters and not stop:
-            n = min(self.fused_chunk, max_iters - it)
-            p_entry_prev, entry_it_prev = p_entry, entry_it
-            p_entry, entry_it = p, it
-            p, chunk, deltas = em_fit_scan(Yj, p, n, mask=mj, cfg=cfg)
-            chunk = np.asarray(chunk, np.float64)
-            consumed = n
-            for j, ll in enumerate(chunk):
-                lls.append(float(ll))
-                if callback is not None:
-                    if pass_piter:
-                        callback(it + j, float(ll), p_entry,
-                                 params_iter=entry_it)
-                    else:
-                        callback(it + j, float(ll), p_entry)
-                state = em_progress(lls, tol, floor)
-                if state != "continue":
-                    converged = state == "converged"
-                    # Same update counts run_em_loop-based drivers return:
-                    # converged -> every iteration that ran; diverged ->
-                    # the params entering the pre-drop iteration.
-                    target = (len(lls) if converged
-                              else max(len(lls) - 2, 0))
-                    stop = True
-                    consumed = j + 1
-                    break
-            if cfg.filter == "ss":
-                # Only iterations up to the stop count toward the freeze
-                # warning — post-stop iterations of the chunk ran on the
-                # device but are discarded (and after a divergence their
-                # deltas reflect garbage params).
-                max_delta = max(max_delta,
-                                float(np.max(np.asarray(deltas)[:consumed])))
-            it += n
-        if cfg.filter == "ss":
-            warn_ss_delta(max_delta, cfg.tau)
-        p_iters = it
-        if stop and target != it:
-            # A diverged target can precede the current chunk's entry (drop
-            # at the chunk's first loglik blames the previous chunk's last
-            # update) — replay from whichever stored entry covers it.
-            base, base_it = ((p_entry, entry_it) if target >= entry_it
-                             else (p_entry_prev, entry_it_prev))
-            n_replay = target - base_it
-            p = (base if n_replay == 0
-                 else em_fit_scan(Yj, base, n_replay, mask=mj, cfg=cfg)[0])
-            p_iters = target
-        # (a stop with target == it needs nothing: the chunk end already
-        # embodies exactly `target` updates and p_iters == it == target)
-        return p, np.asarray(lls), converged, p_iters
+        from .estim.em import noise_floor_for, run_em_chunked
+
+        def scan_fn(p, n):
+            p_new, lls, deltas = em_fit_scan(Yj, p, n, mask=mj, cfg=cfg)
+            return p_new, lls, (deltas if cfg.filter == "ss" else None)
+
+        return run_em_chunked(
+            scan_fn, pj, max_iters, tol,
+            noise_floor_for(Yj.dtype, Yj.size), callback, self.fused_chunk,
+            ss_tau=cfg.tau if cfg.filter == "ss" else None)
 
     def smooth(self, Y, mask, params):
         import jax.numpy as jnp

@@ -23,8 +23,8 @@ from ..ssm.parallel_filter import pit_filter, pit_smoother
 from ..ssm.params import SSMParams, SmootherResult
 
 __all__ = ["EMConfig", "em_step", "em_fit", "em_fit_scan", "run_em_loop",
-           "em_progress", "noise_floor_for", "warn_ss_delta",
-           "moments", "mstep_rows", "mstep_dynamics"]
+           "run_em_chunked", "em_progress", "noise_floor_for",
+           "warn_ss_delta", "moments", "mstep_rows", "mstep_dynamics"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -256,6 +256,89 @@ def run_em_loop(step, max_iters: int, tol: float, callback=None,
             state = progress
             break
     return lls, state == "converged", state
+
+
+def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
+                   noise_floor: float, callback=None, fused_chunk: int = 8,
+                   ss_tau=None):
+    """Shared fused-chunk EM driver (single-device, sharded, and MF fits).
+
+    ``scan_fn(p, n) -> (p_new, logliks (n,), ss_deltas (n,) | None)`` runs n
+    fused EM iterations in one XLA program.  Convergence/divergence can only
+    be detected once a chunk's logliks reach the host, by which point the
+    device params embody the WHOLE chunk; a mid-chunk stop therefore replays
+    the chunk's prefix from the stored chunk-entry params (one shorter fused
+    program, compiled once per distinct tail length) so the returned params
+    embody precisely the update count the stopping rule selected — including
+    the divergence rule's "params entering the pre-drop iteration".
+
+    Callbacks receive chunk-entry params; a callback carrying
+    ``wants_params_iter = True`` is additionally passed ``params_iter`` (the
+    iteration those params embody) so checkpoints are never mislabeled.
+
+    ``ss_tau``: when set, ss freeze deltas (up to the stop) feed
+    ``warn_ss_delta`` with this tau.  Returns (p, lls, converged, p_iters).
+    """
+    import numpy as np
+    fused_chunk = max(1, int(fused_chunk))   # 0/negative would never advance
+    pass_piter = getattr(callback, "wants_params_iter", False)
+    lls: list = []
+    converged = False
+    stop = False
+    target = 0      # update count the stopping rule selects (from start)
+    max_delta = 0.0
+    p = p0
+    it = 0
+    p_entry = p_entry_prev = p0
+    entry_it = entry_it_prev = 0
+    while it < max_iters and not stop:
+        n = min(fused_chunk, max_iters - it)
+        p_entry_prev, entry_it_prev = p_entry, entry_it
+        p_entry, entry_it = p, it
+        p, chunk, deltas = scan_fn(p, n)
+        chunk = np.asarray(chunk, np.float64)
+        consumed = n
+        for j, ll in enumerate(chunk):
+            lls.append(float(ll))
+            if callback is not None:
+                if pass_piter:
+                    callback(it + j, float(ll), p_entry,
+                             params_iter=entry_it)
+                else:
+                    callback(it + j, float(ll), p_entry)
+            state = em_progress(lls, tol, noise_floor)
+            if state != "continue":
+                converged = state == "converged"
+                # Same update counts the run_em_loop drivers return:
+                # converged -> every iteration that ran; diverged -> the
+                # params entering the pre-drop iteration.
+                target = len(lls) if converged else max(len(lls) - 2, 0)
+                stop = True
+                consumed = j + 1
+                break
+        if deltas is not None:
+            # Only iterations up to the stop count toward the freeze
+            # warning — post-stop iterations of the chunk ran on the device
+            # but are discarded (after a divergence their deltas reflect
+            # garbage params).
+            max_delta = max(max_delta,
+                            float(np.max(np.asarray(deltas)[:consumed])))
+        it += n
+    if ss_tau is not None:
+        warn_ss_delta(max_delta, ss_tau)
+    p_iters = it
+    if stop and target != it:
+        # A diverged target can precede the current chunk's entry (drop at
+        # the chunk's first loglik blames the previous chunk's last update)
+        # — replay from whichever stored entry covers it.
+        base, base_it = ((p_entry, entry_it) if target >= entry_it
+                         else (p_entry_prev, entry_it_prev))
+        n_replay = target - base_it
+        p = base if n_replay == 0 else scan_fn(base, n_replay)[0]
+        p_iters = target
+    # (a stop with target == it needs nothing: the chunk end already
+    # embodies exactly `target` updates and p_iters == it == target)
+    return p, np.asarray(lls), converged, p_iters
 
 
 def warn_ss_delta(max_delta: float, tau: int, threshold: float = 1e-4):

@@ -35,7 +35,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.linalg import sym, solve_psd
-from ..ssm.info_filter import info_filter
 from ..ssm.kalman import rts_smoother
 from ..ssm.params import SSMParams
 from ..estim.em import run_em_loop
@@ -123,8 +122,8 @@ def mf_em_core(Y, mask, p: MFParams, spec: MixedFreqSpec,
     replicated; loading/noise rows are local — same device boundary as the
     plain sharded EM (SURVEY.md section 3.1).
     """
-    from ..ssm.info_filter import (obs_stats, info_scan, loglik_terms_local,
-                                   loglik_from_terms)
+    from ..ssm.info_filter import (ObsStats, obs_stats, loglik_terms_local,
+                                   loglik_from_terms, info_scan)
     from ..ssm.params import FilterResult
     k, L = spec.n_factors, spec.n_lags
     Nm = spec.n_monthly
@@ -134,14 +133,30 @@ def mf_em_core(Y, mask, p: MFParams, spec: MixedFreqSpec,
 
     aug = augment(p, spec)
     stats = reduce_tree(obs_stats(Y, aug.Lam, aug.R, mask=mask))
-    xp, Pp, xf, Pf, logdetG = info_scan(stats, aug.A, aug.Q, aug.mu0, aug.P0)
+    # The m = L*k augmented time recursions concentrate the whole cross-
+    # section's data precision on a ~25-dim state, so they are the panel's
+    # most error-sensitive piece.  Two measures (measured at the S3 shape):
+    # matmul_precision="highest" is MANDATORY (bf16-rounded stats wobble
+    # the EM trajectory by ~1e2 loglik units and fake divergences — the
+    # fit drivers set it); and on CPU-with-x64 (native f64, tests/goldens)
+    # the small scans/smoother additionally run in f64 (x_pred error
+    # 5e-4 -> 6e-7).  On TPUs f64 is emulated and a sequential-scan
+    # emulation costs ~10x, while highest-precision f32 is already
+    # monotone to <0.1 loglik units — so the compute dtype is kept there.
+    from ..ops.precision import accum_dtype
+    acc = accum_dtype(dtype, native_only=True)
+    aug_acc = aug.astype(acc)
+    stats_acc = ObsStats(*(jnp.asarray(s, acc) for s in stats))
+    xp, Pp, xf, Pf, logdetG = info_scan(stats_acc, aug_acc.A, aug_acc.Q,
+                                        aug_acc.mu0, aug_acc.P0)
     quad_R, U = reduce_tree(
-        loglik_terms_local(Y, aug.Lam, aug.R, xp, mask))
+        loglik_terms_local(Y, aug.Lam, aug.R, xp.astype(dtype), mask))
     kf = FilterResult(xp, Pp, xf, Pf,
-                      loglik_from_terms(stats, logdetG, Pf, quad_R, U))
-    sm = rts_smoother(kf, aug)
+                      loglik_from_terms(stats_acc, logdetG, Pf,
+                                        quad_R, U.astype(acc)))
+    sm = rts_smoother(kf, aug_acc)
 
-    x, P = sm.x_sm, sm.P_sm                       # (T, m), (T, m, m)
+    x, P = sm.x_sm.astype(dtype), sm.P_sm.astype(dtype)  # (T, m), (T, m, m)
     EffT = P + jnp.einsum("ti,tj->tij", x, x)
     E5 = _blocked(EffT, L, k)                     # (T, L, k, L, k)
     Ef = x.reshape(T, L, k)
@@ -202,6 +217,27 @@ def mf_em_step(Y, mask, p: MFParams, spec: MixedFreqSpec):
     """One constrained EM iteration.  Returns (new_params, entry loglik)."""
     p_new, ll, _ = mf_em_core(Y, mask, p, spec)
     return p_new, ll
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _mf_smooth_impl(Y, mask, p: MFParams, spec: MixedFreqSpec):
+    """Jitted filter+smoother at fixed params (the M-step outputs of the
+    shared core are unused here, so XLA dead-code-eliminates them)."""
+    _, ll, sm = mf_em_core(Y, mask, p, spec)
+    return sm.x_sm, sm.P_sm, ll
+
+
+@partial(jax.jit, static_argnames=("spec", "n_iters"))
+def mf_em_scan(Y, mask, p: MFParams, spec: MixedFreqSpec, n_iters: int):
+    """n constrained EM iterations fused into ONE XLA program (the MF analog
+    of ``estim.em.em_fit_scan`` — at ~60-100 ms of dispatch per program on
+    tunneled devices this is the difference between ~1 and ~8 iters/sec at
+    the S3 shape).  Returns (params, logliks (n,))."""
+    def body(p_c, _):
+        p_new, ll, _ = mf_em_core(Y, mask, p_c, spec)
+        return p_new, ll
+
+    return jax.lax.scan(body, p, None, length=n_iters)
 
 
 def mf_pca_init(Y: np.ndarray, mask: np.ndarray,
@@ -303,11 +339,16 @@ def mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
            max_iters: int = 50, tol: float = 1e-6,
            dtype=None, init: Optional[MFParams] = None,
            standardize: bool = True,
-           callback=None) -> MFResult:
+           callback=None, fused_chunk: int = 8) -> MFResult:
     """Estimate the mixed-frequency DFM.  Y is (T, Nm+Nq), monthly series
     first; NaNs and/or ``mask`` mark unobserved entries.  Standardization
     (per-series, over observed entries) is applied by default; the returned
-    nowcast is mapped back to original data units."""
+    nowcast is mapped back to original data units.
+
+    fused_chunk: EM iterations fused into one XLA program between host
+    round-trips (same exact stop/replay semantics as the plain backends —
+    ``estim.em.run_em_chunked``; callbacks receive chunk-entry params).
+    Set 1 for one dispatch per iteration and exact per-iter callbacks."""
     Y = np.asarray(Y, np.float64)
     from ..utils.data import build_mask, standardize as _std
     W = build_mask(Y, mask)
@@ -315,37 +356,48 @@ def mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
     if standardize:
         Y, std = _std(Y, mask=W)
     if dtype is None:
-        dtype = (jnp.float64 if jax.config.jax_enable_x64
-                 and jax.default_backend() == "cpu" else jnp.float32)
+        from ..ops.precision import default_compute_dtype
+        dtype = default_compute_dtype()
     if init is None:
         init = mf_pca_init(Y, W, spec)
     Yj = jnp.asarray(np.nan_to_num(Y * (W > 0)), dtype)
     Wj = jnp.asarray(W, dtype)
     p = init.astype(dtype)
 
-    entering = prev_entering = p
+    from ..estim.em import noise_floor_for, run_em_chunked
+    floor = noise_floor_for(dtype, Yj.size)
+    # bf16-rounded matmul inputs (XLA's f32 default on TPU) are NOT usable
+    # for the augmented-state stats — see mf_em_core.
+    with jax.default_matmul_precision("highest"):
+        if fused_chunk > 1:
+            def scan_fn(p_c, n):
+                p_new, lls = mf_em_scan(Yj, Wj, p_c, spec, n)
+                return p_new, lls, None
 
-    def step(it):
-        nonlocal p, entering, prev_entering
-        prev_entering = entering
-        entering = p
-        p, ll = mf_em_step(Yj, Wj, entering, spec)
-        return ll, entering
+            p, lls, converged, _ = run_em_chunked(
+                scan_fn, p, max_iters, tol, floor, callback, fused_chunk)
+        else:
+            entering = prev_entering = p
 
-    from ..estim.em import noise_floor_for
-    lls, converged, em_state = run_em_loop(
-        step, max_iters, tol, callback, noise_floor=noise_floor_for(dtype, Yj.size))
-    if em_state == "diverged":
-        # Drop at iteration j <- bad update in j-1: restore params entering
-        # j-1 (the last pre-drop loglik's params).
-        p = prev_entering
+            def step(it):
+                nonlocal p, entering, prev_entering
+                prev_entering = entering
+                entering = p
+                p, ll = mf_em_step(Yj, Wj, entering, spec)
+                return ll, entering
 
-    aug = augment(p, spec)
-    kf = info_filter(Yj, aug, mask=Wj)
-    sm = rts_smoother(kf, aug)
+            lls, converged, em_state = run_em_loop(
+                step, max_iters, tol, callback, noise_floor=floor)
+            if em_state == "diverged":
+                # Drop at iteration j <- bad update in j-1: restore params
+                # entering j-1 (the last pre-drop loglik's params).
+                p = prev_entering
+
+        x_sm, P_sm, _ = _mf_smooth_impl(Yj, Wj, p, spec)
     k = spec.n_factors
-    x_sm = np.asarray(sm.x_sm, np.float64)
-    P_sm = np.asarray(sm.P_sm, np.float64)
+    x_sm = np.asarray(x_sm, np.float64)
+    P_sm = np.asarray(P_sm, np.float64)
+    aug = augment(p, spec)
     common = x_sm @ np.asarray(aug.Lam, np.float64).T
     if std is not None:
         common = std.inverse(common)

@@ -260,10 +260,16 @@ def sv_filter(Y, p: SSMParams, spec: SVSpec,
     sig = _as_sigma_vec(spec.sigma_h if sigma_h is None else sigma_h,
                         spec.n_factors, dtype)
     h0s = jnp.asarray(spec.h0_scale, dtype)
-    ll_rel, f_mean, h_mean, ess, n_rs, h_hist, logw_hist = _sv_filter_impl(
-        Y, p, jnp.asarray(h_center, dtype), sig, h0s, key,
-        k=spec.n_factors, M=spec.n_particles, ess_frac=spec.ess_frac,
-        residual=spec.quad_form == "residual", store_paths=store_paths)
+    # True-f32 matmul products: bf16-rounded residual matmuls (the XLA f32
+    # default on TPU) distort the particle weights at large N.
+    with jax.default_matmul_precision("highest"):
+        ll_rel, f_mean, h_mean, ess, n_rs, h_hist, logw_hist = \
+            _sv_filter_impl(
+                Y, p, jnp.asarray(h_center, dtype), sig, h0s, key,
+                k=spec.n_factors, M=spec.n_particles,
+                ess_frac=spec.ess_frac,
+                residual=spec.quad_form == "residual",
+                store_paths=store_paths)
     lls = _host_lls(ll_rel, Y, np.asarray(p.R, np.float64),
                     residual=spec.quad_form == "residual")
     return SVResult(loglik=np.sum(lls), f_mean=f_mean, h_mean=h_mean,
@@ -355,8 +361,8 @@ def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
     Yz = np.asarray(Y, np.float64)
     if pre.standardizer is not None:
         Yz = pre.standardizer.transform(Yz)
-    dtype = (jnp.float64 if jax.config.jax_enable_x64
-             and jax.default_backend() == "cpu" else jnp.float32)
+    from ..ops.precision import default_compute_dtype
+    dtype = default_compute_dtype()
     pj = JP.from_numpy(pre.params, dtype=dtype)
     Yj = jnp.asarray(Yz, dtype)
     if key is None:

@@ -308,8 +308,8 @@ def tvl_fit(Y: np.ndarray, spec: TVLSpec,
         W = W * np.asarray(mask, np.float64)
     any_missing = bool((W == 0).any())
     if dtype is None:
-        dtype = (jnp.float64 if jax.config.jax_enable_x64
-                 and jax.default_backend() == "cpu" else jnp.float32)
+        from ..ops.precision import default_compute_dtype
+        dtype = default_compute_dtype()
 
     Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
     if init is None:
@@ -339,9 +339,13 @@ def tvl_fit(Y: np.ndarray, spec: TVLSpec,
         return ll, entering
 
     from ..estim.em import noise_floor_for
-    lls, converged, em_state = run_em_loop(
-        step, spec.n_rounds, spec.tol, callback,
-        noise_floor=noise_floor_for(dtype, Yj.size))
+    # bf16-rounded matmul inputs (XLA's f32 default on TPU) inject ~1e-3
+    # relative error into the factor-filter stats — force true-f32 products
+    # like every other fit driver.
+    with jax.default_matmul_precision("highest"):
+        lls, converged, em_state = run_em_loop(
+            step, spec.n_rounds, spec.tol, callback,
+            noise_floor=noise_floor_for(dtype, Yj.size))
     if em_state == "diverged":
         # Drop at round j <- bad update in j-1: the state ENTERING round j-1
         # is the last pre-drop one (fall back to its successor if that is

@@ -1,0 +1,37 @@
+"""Dtype policy helpers: where float64 enters a float32 pipeline.
+
+Two DISTINCT policies exist in the framework, both depending on
+``jax_enable_x64``; keeping them named here stops the call sites drifting:
+
+- ``accum_dtype(dt)``: upgrade small ASSEMBLY work (loglik pieces, (T,)-
+  sized reductions) to f64 whenever x64 is on — even on TPUs, where f64 is
+  emulated, because the upgraded tensors are tiny and the alternative is
+  a ~100x cancellation amplification (see info_filter.loglik_from_terms).
+- ``accum_dtype(dt, native_only=True)``: upgrade only on backends with
+  NATIVE f64 (CPU).  Use for SEQUENTIAL work — e.g. the mixed-frequency
+  augmented-state scans — where emulated f64 multiplies the scan's
+  wall-clock ~10x but highest-precision f32 is already sufficient.
+- ``default_compute_dtype()``: the framework's compute-dtype default —
+  f32 on accelerators (the MXU path), f64 on CPU when x64 is enabled
+  (the golden/test regime).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["accum_dtype", "default_compute_dtype"]
+
+
+def accum_dtype(compute_dtype, native_only: bool = False):
+    if jax.config.jax_enable_x64 and (
+            not native_only or jax.default_backend() == "cpu"):
+        return jnp.float64
+    return jnp.dtype(compute_dtype)
+
+
+def default_compute_dtype():
+    if jax.config.jax_enable_x64 and jax.default_backend() == "cpu":
+        return jnp.dtype("float64")
+    return jnp.dtype("float32")

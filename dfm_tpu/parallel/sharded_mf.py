@@ -149,8 +149,12 @@ def sharded_mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
         return ll, entering
 
     from ..estim.em import noise_floor_for
-    lls, converged, em_state = run_em_loop(
-        step, max_iters, tol, callback, noise_floor=noise_floor_for(dtype, Y.size))
+    # True-f32 matmul products, as in mf_fit (bf16 default is unusable for
+    # the augmented-state stats — see mixed_freq.mf_em_core).
+    with jax.default_matmul_precision("highest"):
+        lls, converged, em_state = run_em_loop(
+            step, max_iters, tol, callback,
+            noise_floor=noise_floor_for(dtype, Y.size))
     if em_state == "diverged":
         # Drop at iteration j <- bad update in j-1: restore the state
         # entering j-1 (the last pre-drop loglik's params).
@@ -158,9 +162,10 @@ def sharded_mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
 
     # The last step's smoother is at the pre-update params; run one more
     # E-pass at the final params for the reported factors/nowcast.
-    out = _sharded_mf_step_impl(
-        *state["arrs"][:4], *state["arrs"][4:], *state["rep"],
-        mesh, spec_local)
+    with jax.default_matmul_precision("highest"):
+        out = _sharded_mf_step_impl(
+            *state["arrs"][:4], *state["arrs"][4:], *state["rep"],
+            mesh, spec_local)
     x_sm = np.asarray(out[9], np.float64)
     P_sm = np.asarray(out[10], np.float64)
     k = spec.n_factors

@@ -102,12 +102,18 @@ def sharded_sv_filter(Y, p: SSMParams, spec: SVSpec,
     Yp, _, Lp, Rp, _ = pad_panel(np.asarray(Y, np.float64), None,
                                  np.asarray(p.Lam, np.float64), R_unpadded,
                                  int(mesh.devices.size))
-    ll_rel, f_mean, h_mean, ess, n_rs, h_hist, logw_hist = _sharded_sv_impl(
-        jnp.asarray(Yp, dtype), jnp.asarray(Lp, dtype),
-        jnp.asarray(Rp, dtype), p.A, p.mu0, p.P0,
-        jnp.asarray(h_center, dtype), sig, h0s, key, mesh,
-        k=spec.n_factors, M=spec.n_particles, ess_frac=spec.ess_frac,
-        residual=spec.quad_form == "residual", store_paths=store_paths)
+    # True-f32 matmul products, matching sv_filter (bf16 default distorts
+    # the particle weights at large N).
+    with jax.default_matmul_precision("highest"):
+        ll_rel, f_mean, h_mean, ess, n_rs, h_hist, logw_hist = \
+            _sharded_sv_impl(
+                jnp.asarray(Yp, dtype), jnp.asarray(Lp, dtype),
+                jnp.asarray(Rp, dtype), p.A, p.mu0, p.P0,
+                jnp.asarray(h_center, dtype), sig, h0s, key, mesh,
+                k=spec.n_factors, M=spec.n_particles,
+                ess_frac=spec.ess_frac,
+                residual=spec.quad_form == "residual",
+                store_paths=store_paths)
     # Shared host float64 assembly, from the UNPADDED panel/R (padded series
     # contribute nothing in-scan by design).
     lls = _host_lls(ll_rel, Y, R_unpadded,

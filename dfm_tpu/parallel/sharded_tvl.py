@@ -114,9 +114,11 @@ def sharded_tvl_fit(Y: np.ndarray, spec: TVLSpec,
          state["A"], state["Q"], ll, state["F"]) = out
         return ll, None
 
-    lls, converged, em_state = run_em_loop(
-        step, spec.n_rounds, spec.tol, callback,
-        noise_floor=noise_floor_for(dtype, state["Y"].size))
+    # True-f32 matmul products, as in tvl_fit (bf16 default is unusable).
+    with jax.default_matmul_precision("highest"):
+        lls, converged, em_state = run_em_loop(
+            step, spec.n_rounds, spec.tol, callback,
+            noise_floor=noise_floor_for(dtype, state["Y"].size))
     if em_state == "diverged":
         # Drop at round j <- bad update in j-1: the state entering j-1 is
         # the last pre-drop one (its successor if that one predates F).

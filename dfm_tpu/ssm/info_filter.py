@@ -86,7 +86,8 @@ def obs_stats(Y: jax.Array, Lam: jax.Array, R: jax.Array,
         # across the whole loglik: accumulate the one sum in f64 when
         # available (an N-sized sum once per E-step — free).  The masked
         # branch's W @ logR is a (T,N) matmul and stays in compute dtype.
-        acc = (jnp.float64 if jax.config.jax_enable_x64 else dtype)
+        from ..ops.precision import accum_dtype
+        acc = accum_dtype(dtype)
         ldR = jnp.full((T,), jnp.sum(logR.astype(acc))).astype(acc)
     else:
         W = mask.astype(dtype)
@@ -153,8 +154,8 @@ def loglik_terms_local(Y: jax.Array, Lam: jax.Array, R: jax.Array,
     if mask is not None:
         V = mask.astype(Y.dtype) * jnp.nan_to_num(V)
     VR = V / R[None, :]
-    acc = (jnp.float64 if jax.config.jax_enable_x64
-           else jnp.dtype(Y.dtype))
+    from ..ops.precision import accum_dtype
+    acc = accum_dtype(Y.dtype)
     quad_R = jnp.sum((V * VR).astype(acc), axis=1)
     U = VR @ Lam
     return quad_R, U
@@ -171,8 +172,8 @@ def loglik_from_terms(stats: ObsStats, logdetG, P_filt, quad_R, U):
     emulate f64, and the headline-shape loglik error drops ~4x (measured).
     The big (T,N) reductions feeding quad_R/U stay in the compute dtype.
     """
-    acc = (jnp.float64 if jax.config.jax_enable_x64
-           else jnp.dtype(stats.b.dtype))
+    from ..ops.precision import accum_dtype
+    acc = accum_dtype(stats.b.dtype)
     quad = quad_R.astype(acc) - jnp.einsum(
         "tk,tkl,tl->t", U.astype(acc), P_filt.astype(acc), U.astype(acc))
     lls = -0.5 * (stats.n.astype(acc) * _LOG2PI + stats.ldR.astype(acc)

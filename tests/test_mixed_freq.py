@@ -119,3 +119,15 @@ def test_mf_nowcast_fills_missing_quarterly(mf_panel):
     now_q = res.nowcast[:, 30:]
     corr = np.corrcoef(now_q[miss], latent_q[miss])[0, 1]
     assert corr > 0.9, corr
+
+
+def test_mf_fused_chunk_matches_per_iteration():
+    rng = np.random.default_rng(31)
+    Y, mask, _, _ = dgp.simulate_mixed_freq(20, 6, 80, 2, rng)
+    spec = MixedFreqSpec(n_monthly=20, n_quarterly=6, n_factors=2)
+    r1 = mf_fit(Y, spec, mask=mask, max_iters=6, tol=0.0, fused_chunk=1)
+    r3 = mf_fit(Y, spec, mask=mask, max_iters=6, tol=0.0, fused_chunk=3)
+    np.testing.assert_allclose(r3.logliks, r1.logliks, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(r3.params.Lam_m),
+                               np.asarray(r1.params.Lam_m), atol=1e-10)
+    np.testing.assert_allclose(r3.nowcast, r1.nowcast, atol=1e-9)
