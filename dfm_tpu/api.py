@@ -267,6 +267,7 @@ class ShardedBackend(TPUBackend):
         self.n_devices = n_devices
         self._drv = None          # ShardedEM from the last run_em
         self._drv_params = None   # the numpy params it ended at
+        self._drv_panel = (None, None)   # the (Y, mask) objects it fitted
 
     def _mesh(self):
         from .parallel.mesh import make_mesh
@@ -314,6 +315,7 @@ class ShardedBackend(TPUBackend):
                     max_iters=max_iters, tol=tol, dtype=self._dtype(),
                     callback=callback)
                 self._drv, self._drv_params = drv, p
+                self._drv_panel = (Y, mask)
                 return p, lls, converged, drv.p_iters
             drv = ShardedEM(Y, p0, mask=mask, mesh=self._mesh(),
                             dtype=self._dtype(), cfg=cfg)
@@ -327,7 +329,21 @@ class ShardedBackend(TPUBackend):
             drv.p, drv.p_iters = p, p_iters
             pn = drv.params_numpy()
         self._drv, self._drv_params = drv, pn
+        self._drv_panel = (Y, mask)
         return pn, lls, converged, p_iters
+
+    @staticmethod
+    def _params_equal(a, b) -> bool:
+        if a is b:
+            return True
+        if a is None or b is None:
+            return False
+        try:
+            return all(np.array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)))
+                       for f in ("Lam", "A", "Q", "R", "mu0", "P0"))
+        except AttributeError:
+            return False
 
     def smooth(self, Y, mask, params):
         import jax.numpy as jnp
@@ -337,7 +353,14 @@ class ShardedBackend(TPUBackend):
         # fit() calls smooth right after run_em with the params it returned;
         # in that case the driver already holds the padded panel and params
         # on device — reuse them instead of re-padding and re-transferring.
-        if self._drv is not None and params is self._drv_params:
+        # Params compare by VALUE (an equal copy — e.g. checkpoint round-
+        # trip — must hit the fast path; a few-MB host compare is orders
+        # cheaper than the re-transfer), but the PANEL must be the same
+        # objects fit() handed run_em: a value-equal params set smoothing a
+        # DIFFERENT panel must not return the cached panel's factors.
+        panel = getattr(self, "_drv_panel", (None, None))
+        if (self._drv is not None and Y is panel[0] and mask is panel[1]
+                and self._params_equal(params, self._drv_params)):
             with self._precision_ctx():
                 x_sm, P_sm, _ = self._drv.smooth()
             return np.asarray(x_sm, np.float64), np.asarray(P_sm, np.float64)

@@ -37,7 +37,6 @@ import numpy as np
 from ..ops.linalg import sym, solve_psd
 from ..ssm.kalman import rts_smoother
 from ..ssm.params import SSMParams
-from ..estim.em import run_em_loop
 
 __all__ = ["MixedFreqSpec", "MFParams", "augment", "mf_em_step", "mf_fit",
            "mf_forecast", "MFResult"]
@@ -369,29 +368,16 @@ def mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
     # bf16-rounded matmul inputs (XLA's f32 default on TPU) are NOT usable
     # for the augmented-state stats — see mf_em_core.
     with jax.default_matmul_precision("highest"):
-        if fused_chunk > 1:
-            def scan_fn(p_c, n):
-                p_new, lls = mf_em_scan(Yj, Wj, p_c, spec, n)
-                return p_new, lls, None
+        # run_em_chunked with fused_chunk=1 IS the per-iteration driver
+        # (chunk-entry params == exact entering params; the divergence
+        # replay resolves to the stored previous entry with no recompute),
+        # so one driver serves both modes.
+        def scan_fn(p_c, n):
+            p_new, lls = mf_em_scan(Yj, Wj, p_c, spec, n)
+            return p_new, lls, None
 
-            p, lls, converged, _ = run_em_chunked(
-                scan_fn, p, max_iters, tol, floor, callback, fused_chunk)
-        else:
-            entering = prev_entering = p
-
-            def step(it):
-                nonlocal p, entering, prev_entering
-                prev_entering = entering
-                entering = p
-                p, ll = mf_em_step(Yj, Wj, entering, spec)
-                return ll, entering
-
-            lls, converged, em_state = run_em_loop(
-                step, max_iters, tol, callback, noise_floor=floor)
-            if em_state == "diverged":
-                # Drop at iteration j <- bad update in j-1: restore params
-                # entering j-1 (the last pre-drop loglik's params).
-                p = prev_entering
+        p, lls, converged, _ = run_em_chunked(
+            scan_fn, p, max_iters, tol, floor, callback, fused_chunk)
 
         x_sm, P_sm, _ = _mf_smooth_impl(Yj, Wj, p, spec)
     k = spec.n_factors

@@ -334,7 +334,8 @@ class SVFit:
 def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
            key: Optional[jax.Array] = None, backend: str = "tpu",
            standardize: bool = True, sv_iters: int = 10,
-           sv_accel: float = 3.0, estimate_sv: bool = True) -> SVFit:
+           sv_accel: float = 3.0, estimate_sv: bool = True,
+           mesh=None) -> SVFit:
     """SV-DFM estimation (BASELINE.json:11; SURVEY.md section 3.5):
 
     1. EM pre-fit of the homoskedastic DFM (Lam, A, Q, R) — info-form path.
@@ -352,6 +353,11 @@ def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
 
     The marginal loglik is a particle estimate, so it is monotone only up to
     Monte-Carlo noise; convergence is left to the fixed ``sv_iters`` budget.
+
+    ``mesh``: a 1-D ``jax.sharding.Mesh`` routes every RBPF E-step through
+    the series-sharded filter (``parallel.sharded_sv``) — S5's full particle
+    EM on a multi-chip topology; the EM pre-fit shards via
+    ``backend="sharded"``.
     """
     from ..api import DynamicFactorModel, fit as _fit
     from ..ssm.params import SSMParams as JP
@@ -379,8 +385,17 @@ def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
 
     def e_step(key, sigma, h_center, smooth):
         kf_, ks_ = jax.random.split(key)
-        res = sv_filter(Yj, pj, spec, key=kf_, h_center=h_center,
-                        sigma_h=sigma, store_paths=smooth)
+        if mesh is not None:
+            # Series-sharded RBPF (parallel.sharded_sv): the particle cloud
+            # and its stored history come back replicated, so the FFBS pass
+            # below is unchanged — the entire particle EM runs multi-chip.
+            from ..parallel.sharded_sv import sharded_sv_filter
+            res = sharded_sv_filter(Yj, pj, spec, key=kf_,
+                                    h_center=h_center, sigma_h=sigma,
+                                    store_paths=smooth, mesh=mesh)
+        else:
+            res = sv_filter(Yj, pj, spec, key=kf_, h_center=h_center,
+                            sigma_h=sigma, store_paths=smooth)
         H = (sv_smooth_h(res, sigma, ks_, spec.n_smooth_draws)
              if smooth else None)
         return res, H
