@@ -424,6 +424,16 @@ def pca_init(Y: np.ndarray, k: int, static: bool = False,
     F = Y @ Lam / N                               # (T, k)
     resid = Y - F @ Lam.T
     R = np.maximum(resid.var(axis=0), 1e-6)
+    A, Q, mu0, P0 = var_tail(F, k, static)
+    return SSMParams(Lam, A, Q, R, mu0, P0)
+
+
+def var_tail(F: np.ndarray, k: int, static: bool = False):
+    """The k-sized dynamics tail of the PCA init: OLS VAR(1) on the factor
+    path + stationary P0.  Shared with the device-side initializer
+    (``estim.init.pca_init_device``) — the factor path is tiny, so this
+    always runs on host."""
+    F = np.asarray(F, np.float64)
     if static:
         A = np.zeros((k, k))
         Q = np.eye(k)
@@ -434,7 +444,7 @@ def pca_init(Y: np.ndarray, k: int, static: bool = False,
         Q = _sym(eta.T @ eta / max(len(eta) - 1, 1)) + 1e-8 * np.eye(k)
     mu0 = np.zeros(k)
     P0 = _solve_discrete_lyapunov_or_eye(A, Q)
-    return SSMParams(Lam, A, Q, R, mu0, P0)
+    return A, Q, mu0, P0
 
 
 def _solve_discrete_lyapunov_or_eye(A: np.ndarray, Q: np.ndarray) -> np.ndarray:
