@@ -52,23 +52,6 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def measure_riccati_mixing(p, tol=1e-12, max_steps=512) -> int:
-    """Steps until the predicted-covariance recursion stops moving (host)."""
-    Lam = np.asarray(p.Lam, np.float64)
-    A = np.asarray(p.A, np.float64)
-    Q = np.asarray(p.Q, np.float64)
-    C = (Lam / np.asarray(p.R, np.float64)[:, None]).T @ Lam
-    k = A.shape[0]
-    P = np.asarray(p.P0, np.float64)
-    for t in range(1, max_steps + 1):
-        Pf = np.linalg.solve(np.eye(k) + P @ C, P)
-        Pn = A @ (0.5 * (Pf + Pf.T)) @ A.T + Q
-        if np.max(np.abs(Pn - P)) <= tol * max(np.max(np.abs(Pn)), 1e-30):
-            return t
-        P = Pn
-    return max_steps
-
-
 def main():
     N = int(os.environ.get("DFM_BENCH_N", 10_000))
     T = int(os.environ.get("DFM_BENCH_T", 500))
@@ -152,11 +135,11 @@ def main():
     # Steady-state accelerated E-step (exact-to-tolerance; see ssm/steady.py),
     # overridable for A/B runs via DFM_BENCH_FILTER=info|pit|ss.  tau comes
     # from measuring the actual covariance-recursion convergence at the init
-    # params on host (k x k per step — microseconds), with a 2x margin for
-    # parameter drift across EM iterations.
-    tau = 2 * measure_riccati_mixing(p0)
-    tau = int(np.clip(tau, 16, 192))
-    tau = int(os.environ.get("DFM_BENCH_TAU", tau))
+    # params on host (``ssm.steady.auto_tau``: k x k per step — microseconds
+    # — with a 2x margin for parameter drift across EM iterations); the
+    # precise-loglik contract checks below validate the choice end to end.
+    from dfm_tpu.ssm.steady import auto_tau
+    tau = int(os.environ.get("DFM_BENCH_TAU", auto_tau(p0)))
     log(f"steady-state tau={tau}")
     filt = os.environ.get("DFM_BENCH_FILTER", "ss")
     cfg = EMConfig(filter=filt, tau=tau)

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Decompose the headline EM iteration cost on the real chip.
+
+Times fused scans (150 reps, data-dependency-chained so XLA cannot hoist)
+of each piece of the steady-state EM iteration separately:
+
+  panel   the three (T,N) MXU passes (b = Y G, the residual quad pass,
+          S_yf = Y' Ef) plus the k-sized M-step algebra
+  cov     the tau-step sequential covariance path (``steady._cov_path``)
+  means   the blocked affine scans (filtered + smoothed means)
+  smcov   the smoother covariance fixed point + front boundary
+  full    the whole ``em_fit_scan`` iteration
+
+and prints per-iteration milliseconds for each, at several tau values.
+This is the measurement behind docs/PERF.md's roofline table.  Run on the
+real chip: ``python -m bench.profile_em``.  Shapes via DFM_BENCH_N/T/K.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    N = int(os.environ.get("DFM_BENCH_N", 10_000))
+    T = int(os.environ.get("DFM_BENCH_T", 500))
+    k = int(os.environ.get("DFM_BENCH_K", 10))
+    n_iters = int(os.environ.get("DFM_BENCH_ITERS", 150))
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dfm_tpu.backends import cpu_ref
+    from dfm_tpu.utils import dgp
+    from dfm_tpu.estim.em import EMConfig, em_fit_scan
+    from dfm_tpu.ssm.params import SSMParams as JP
+    from dfm_tpu.ssm import steady
+    from dfm_tpu.ssm.info_filter import obs_stats, loglik_terms_local
+    from dfm_tpu.ops.scan import blocked_scan
+    from dfm_tpu.ssm.steady import riccati_mixing_steps
+
+    rng = np.random.default_rng(0)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y, _ = dgp.simulate(p_true, T, rng)
+    Y = (Y - Y.mean(0)) / Y.std(0)
+    p0 = cpu_ref.pca_init(Y, k)
+    mix = riccati_mixing_steps(p0)
+    log(f"shape {N}x{T} k={k}; riccati mixing {mix} steps")
+
+    dtype = jnp.float32
+    Yj = jax.device_put(jnp.asarray(Y, dtype))
+    pj = JP.from_numpy(p0, dtype=dtype)
+
+    def timed(fn, *args):
+        # warm-up (compile) + best-of-3; transfer is the only barrier on axon
+        np.asarray(jax.tree.leaves(fn(*args))[0])
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(jax.tree.leaves(fn(*args))[0])
+            reps.append(time.perf_counter() - t0)
+        return min(reps)
+
+    # Chain trick: eps = 0 * (scalar from prev iter) keeps a loop-carried
+    # data dependency so neither CSE nor LICM can collapse the scan body.
+    def chain(x, scalar):
+        return x * (1.0 + jnp.zeros((), x.dtype) * scalar.astype(x.dtype))
+
+    @partial(jax.jit, static_argnames=("n",))
+    def panel_scan(Yj, p, n):
+        def body(carry, _):
+            Lam, R = chain(p.Lam, carry), p.R
+            stats = obs_stats(Yj, Lam, R)
+            x_fake = stats.b @ jnp.linalg.inv(stats.C)        # (T, k)
+            quad_R, U = loglik_terms_local(Yj, Lam, R, x_fake, None)
+            S_yf = Yj.T @ x_fake
+            Ysq = jnp.einsum("ti,ti->i", Yj, Yj)
+            out = (jnp.sum(quad_R) + jnp.sum(U) + jnp.sum(S_yf)
+                   + jnp.sum(Ysq) + jnp.sum(stats.b)).astype(Yj.dtype)
+            return out, out
+        return lax.scan(body, jnp.zeros((), Yj.dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n", "tau"))
+    def cov_scan(p, C, n, tau):
+        def body(carry, _):
+            Cc = chain(C, carry)
+            Pp, Pf, M, ldG, delta = steady._cov_path(
+                Cc, p.A, p.Q, p.P0, tau, dtype)
+            out = (jnp.sum(Pp[-1]) + jnp.sum(Pf[-1]) + jnp.sum(M[-1])
+                   + jnp.sum(ldG) + delta)
+            return out, out
+        return lax.scan(body, jnp.zeros((), dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n",))
+    def means_scan(b, M_path, Pfilt, n):
+        def body(carry, _):
+            bb = chain(b, carry)
+            d = jnp.einsum("tkl,tl->tk", Pfilt[1:], bb[1:])
+            Mp, dp = blocked_scan(steady._affine_combine, (M_path[1:], d))
+            x_tail = jnp.einsum("tkl,l->tk", Mp, bb[0]) + dp
+            # reverse smoothed-mean-style scan
+            Jr, cr = blocked_scan(
+                lambda late, early: steady._affine_combine(late, early),
+                (M_path[1:], d), reverse=True)
+            out = jnp.sum(x_tail) + jnp.sum(Jr[0]) + jnp.sum(cr)
+            return out, out
+        return lax.scan(body, jnp.zeros((), b.dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n", "tau"))
+    def smcov_scan(p, C, n, tau):
+        # smoother covariance fixed point + front boundary, at fixed inputs
+        from dfm_tpu.ops.linalg import sym, psd_cholesky, chol_solve
+        Pp_ex, Pf_ex, M_ex, ldG_ex, _ = steady._cov_path(
+            C, p.A, p.Q, p.P0, tau, dtype)
+        Lp_ss = psd_cholesky(Pp_ex[-1])
+        J_ss = chol_solve(Lp_ss, p.A @ Pf_ex[-1]).T
+        Pp_ss, Pf_ss = Pp_ex[-1], Pf_ex[-1]
+
+        def body(carry, _):
+            Pf_c = chain(Pf_ss, carry)
+
+            def bstep_ss(Ps, _):
+                Ps_new = sym(Pf_c + J_ss @ (Ps - Pp_ss) @ J_ss.T)
+                return Ps_new, Ps_new
+
+            Ps_mid, rev = lax.scan(bstep_ss, Pf_c, None, length=tau)
+
+            def bstep_ex(Ps, inp):
+                P_f_t, P_p_next, J_t = inp
+                Ps_new = sym(P_f_t + J_t @ (Ps - P_p_next) @ J_t.T)
+                return Ps_new, Ps_new
+
+            Pp_next_ex = jnp.concatenate([Pp_ex[1:], Pp_ex[-1:]], axis=0)
+            Lp_ex = psd_cholesky(Pp_ex[1:])
+            APf_ex = jnp.einsum("ij,tjk->tik", p.A, Pf_ex[:-1])
+            J_ex = jnp.swapaxes(jax.vmap(chol_solve)(Lp_ex, APf_ex), -1, -2)
+            J_front = jnp.concatenate([J_ex, J_ss[None]], axis=0)
+            _, front = lax.scan(bstep_ex, Ps_mid,
+                                (Pf_ex, Pp_next_ex, J_front), reverse=True)
+            out = jnp.sum(rev[-1]) + jnp.sum(front[0])
+            return out, out
+        return lax.scan(body, jnp.zeros((), dtype), None, length=n)[1]
+
+    with jax.default_matmul_precision("highest"):
+        C0 = np.asarray((p0.Lam / p0.R[:, None]).T @ p0.Lam, np.float32)
+        Cj = jnp.asarray(C0)
+        b0 = jnp.asarray(rng.standard_normal((T, k)), dtype)
+        M0 = jnp.asarray(
+            np.broadcast_to(np.asarray(p0.A, np.float32) * 0.5, (T, k, k)))
+        Pf0 = jnp.asarray(np.broadcast_to(np.eye(k, dtype=np.float32) * 0.3,
+                                          (T, k, k)))
+
+        rows = []
+        t = timed(panel_scan, Yj, pj, n_iters)
+        rows.append(("panel (3 MXU passes + k-alg)", "-", t))
+        t = timed(means_scan, b0, M0, Pf0, n_iters)
+        rows.append(("means (2 blocked affine scans)", "-", t))
+        for tau in (16, 32, 64, 96):
+            t = timed(cov_scan, pj, Cj, n_iters, tau)
+            rows.append(("cov path", tau, t))
+            t = timed(smcov_scan, pj, Cj, n_iters, tau)
+            rows.append(("smoother cov (fp + front)", tau, t))
+            cfg = EMConfig(filter="ss", tau=tau)
+            out = em_fit_scan(Yj, pj, n_iters, cfg=cfg)
+            np.asarray(out[1])
+            reps = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(em_fit_scan(Yj, pj, n_iters, cfg=cfg)[1])
+                reps.append(time.perf_counter() - t0)
+            rows.append(("FULL em_fit_scan", tau, min(reps)))
+
+    print(f"\n{'component':36s} {'tau':>4s} {'ms/iter':>9s}")
+    for name, tau, secs in rows:
+        print(f"{name:36s} {str(tau):>4s} {secs / n_iters * 1e3:9.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Fixed-vs-marginal cost of the fused EM scan: time at several n_iters and
+fit a line.  The slope is the TRUE per-iteration device cost; the intercept
+is the per-dispatch overhead (tunnel + program launch) that ``bench.py``
+amortizes over its 150 fused iterations.  Also slopes for the isolated
+components of ``bench.profile_em``.  Run: ``python -m bench.profile_em2``."""
+
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    N = int(os.environ.get("DFM_BENCH_N", 10_000))
+    T = int(os.environ.get("DFM_BENCH_T", 500))
+    k = int(os.environ.get("DFM_BENCH_K", 10))
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dfm_tpu.backends import cpu_ref
+    from dfm_tpu.utils import dgp
+    from dfm_tpu.estim.em import EMConfig, em_fit_scan
+    from dfm_tpu.ssm.params import SSMParams as JP
+    from dfm_tpu.ssm import steady
+    from dfm_tpu.ssm.info_filter import obs_stats, loglik_terms_local
+    from dfm_tpu.ops.scan import blocked_scan
+
+    rng = np.random.default_rng(0)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y, _ = dgp.simulate(p_true, T, rng)
+    Y = (Y - Y.mean(0)) / Y.std(0)
+    p0 = cpu_ref.pca_init(Y, k)
+    dtype = jnp.float32
+    Yj = jax.device_put(jnp.asarray(Y, dtype))
+    pj = JP.from_numpy(p0, dtype=dtype)
+
+    def timed(fn, *args):
+        np.asarray(jax.tree.leaves(fn(*args))[0])
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(jax.tree.leaves(fn(*args))[0])
+            reps.append(time.perf_counter() - t0)
+        return min(reps)
+
+    def chain(x, scalar):
+        return x * (1.0 + jnp.zeros((), x.dtype) * scalar.astype(x.dtype))
+
+    @partial(jax.jit, static_argnames=("n",))
+    def trivial_scan(p, n):
+        def body(carry, _):
+            out = jnp.sum(p.A @ (p.A * (1.0 + 0.0 * carry)))
+            return out, out
+        return lax.scan(body, jnp.zeros((), p.A.dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n",))
+    def panel_scan(Yj, p, n):
+        def body(carry, _):
+            Lam, R = chain(p.Lam, carry), p.R
+            stats = obs_stats(Yj, Lam, R)
+            x_fake = stats.b @ jnp.linalg.inv(stats.C)
+            quad_R, U = loglik_terms_local(Yj, Lam, R, x_fake, None)
+            S_yf = Yj.T @ x_fake
+            Ysq = jnp.einsum("ti,ti->i", Yj, Yj)
+            out = (jnp.sum(quad_R) + jnp.sum(U) + jnp.sum(S_yf)
+                   + jnp.sum(Ysq) + jnp.sum(stats.b)).astype(Yj.dtype)
+            return out, out
+        return lax.scan(body, jnp.zeros((), Yj.dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n", "tau"))
+    def cov_scan(p, C, n, tau):
+        def body(carry, _):
+            Cc = chain(C, carry)
+            Pp, Pf, M, ldG, delta = steady._cov_path(
+                Cc, p.A, p.Q, p.P0, tau, dtype)
+            out = (jnp.sum(Pp[-1]) + jnp.sum(Pf[-1]) + jnp.sum(M[-1])
+                   + jnp.sum(ldG) + delta)
+            return out, out
+        return lax.scan(body, jnp.zeros((), dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n",))
+    def means_scan(b, M_path, Pfilt, n):
+        def body(carry, _):
+            bb = chain(b, carry)
+            d = jnp.einsum("tkl,tl->tk", Pfilt[1:], bb[1:])
+            Mp, dp = blocked_scan(steady._affine_combine, (M_path[1:], d))
+            x_tail = jnp.einsum("tkl,l->tk", Mp, bb[0]) + dp
+            Jr, cr = blocked_scan(
+                lambda late, early: steady._affine_combine(late, early),
+                (M_path[1:], d), reverse=True)
+            out = jnp.sum(x_tail) + jnp.sum(Jr[0]) + jnp.sum(cr)
+            return out, out
+        return lax.scan(body, jnp.zeros((), b.dtype), None, length=n)[1]
+
+    C0 = np.asarray((p0.Lam / p0.R[:, None]).T @ p0.Lam, np.float32)
+    Cj = jnp.asarray(C0)
+    b0 = jnp.asarray(rng.standard_normal((T, k)), dtype)
+    M0 = jnp.asarray(
+        np.broadcast_to(np.asarray(p0.A, np.float32) * 0.5, (T, k, k)))
+    Pf0 = jnp.asarray(np.broadcast_to(np.eye(k, dtype=np.float32) * 0.3,
+                                      (T, k, k)))
+
+    ns = (50, 150, 300, 600)
+    with jax.default_matmul_precision("highest"):
+        def slope(name, f):
+            ts = [timed(f, n) for n in ns]
+            A = np.vstack([np.ones(len(ns)), np.asarray(ns)]).T
+            (fixed, marg), *_ = np.linalg.lstsq(A, np.asarray(ts),
+                                                rcond=None)
+            print(f"{name:34s} fixed {fixed * 1e3:7.1f} ms   "
+                  f"marginal {marg * 1e3:7.3f} ms/iter   "
+                  f"({[f'{t:.3f}' for t in ts]})")
+            return fixed, marg
+
+        slope("trivial scan", lambda n: trivial_scan(pj, n))
+        slope("panel", lambda n: panel_scan(Yj, pj, n))
+        slope("means", lambda n: means_scan(b0, M0, Pf0, n))
+        for tau in (16, 32):
+            slope(f"cov tau={tau}",
+                  lambda n, tau=tau: cov_scan(pj, Cj, n, tau))
+        for tau in (16, 32):
+            cfg = EMConfig(filter="ss", tau=tau)
+            slope(f"FULL em tau={tau}",
+                  lambda n, cfg=cfg: em_fit_scan(Yj, pj, n, cfg=cfg)[1])
+        cfg = EMConfig(filter="info")
+        slope("FULL em info",
+              lambda n, cfg=cfg: em_fit_scan(Yj, pj, n, cfg=cfg)[1])
+
+
+if __name__ == "__main__":
+    main()

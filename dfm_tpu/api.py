@@ -172,10 +172,14 @@ class TPUBackend(Backend):
             return super().default_init(Y, mask, model)
         import jax.numpy as jnp
         from .estim.init import pca_init_device
+        Y_key = Y     # the object run_em will later be called with
         if mask is not None:
             # Same zero-fill contract as the NumPy initializer (fit()
-            # pre-fills, but this is a public interface — a raw NaN panel
-            # must not reach the device eigh).
+            # pre-fills — making this a value no-op there — but this is a
+            # public interface: a raw NaN panel must not reach the device
+            # eigh).  The cache stays keyed on the CALLER'S object: keying
+            # on the re-filled copy can never match run_em's argument, so
+            # every masked panel would double-transfer (ADVICE r4 item 1).
             Y = np.where(np.asarray(mask) > 0, np.nan_to_num(Y), 0.0)
         with self._precision_ctx():
             # Transfer once: run_em reuses this device copy (the 40 MB
@@ -183,21 +187,26 @@ class TPUBackend(Backend):
             # devices — without the cache, device_init transfers twice and
             # LOSES to the host SVD end-to-end).
             Yj = jnp.asarray(Y, self._dtype())
-            self._panel_cache = (Y, Yj)
+            self._panel_cache = (Y_key, mask, Yj)
             return pca_init_device(Yj, model.n_factors,
                                    static=(model.dynamics == "static"),
                                    dtype=self._dtype())
 
-    def _device_panel(self, Y, dt):
-        """The cached on-device panel when ``Y`` is the object it came from.
+    def _device_panel(self, Y, mask, dt):
+        """The cached on-device panel when ``(Y, mask)`` are the objects it
+        came from.  The mask identity matters: the cached values are
+        zero-filled under default_init's mask, so handing them to a run_em
+        called with a DIFFERENT mask (or none) would treat those zeros as
+        observed data.
 
         One-shot: consuming the cache releases both copies, so a long-lived
         backend instance does not pin ~40 MB of host RAM + HBM per panel.
         """
         cached = getattr(self, "_panel_cache", None)
         self._panel_cache = None
-        if cached is not None and cached[0] is Y and cached[1].dtype == dt:
-            return cached[1]
+        if (cached is not None and cached[0] is Y and cached[1] is mask
+                and cached[2].dtype == dt):
+            return cached[2]
         import jax.numpy as jnp
         return jnp.asarray(Y, dt)
 
@@ -223,7 +232,7 @@ class TPUBackend(Backend):
         from .estim.em import EMConfig, em_fit, em_fit_scan
         from .ssm.params import SSMParams as JaxParams
         dt = self._dtype()
-        Yj = self._device_panel(Y, dt)
+        Yj = self._device_panel(Y, mask, dt)
         mj = jnp.asarray(mask, dt) if mask is not None else None
         pj = JaxParams.from_numpy(p0, dtype=dt)
         cfg = EMConfig(estimate_A=model.estimate_A,
@@ -257,7 +266,8 @@ class TPUBackend(Backend):
 
         return run_em_chunked(
             scan_fn, pj, max_iters, tol,
-            noise_floor_for(Yj.dtype, Yj.size), callback, self.fused_chunk,
+            noise_floor_for(Yj.dtype, Yj.size, mult=cfg.noise_floor_mult),
+            callback, self.fused_chunk,
             ss_tau=cfg.tau if cfg.filter == "ss" else None)
 
     def smooth(self, Y, mask, params):

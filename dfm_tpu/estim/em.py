@@ -54,6 +54,8 @@ class EMConfig:
     tau: int = 96        # steady-state horizon (filter="ss" only); raise for
                          # very persistent factor dynamics (see ssm.steady)
     debug: bool = False
+    noise_floor_mult: float = 100.0   # headroom for the absolute loglik
+                                      # noise floor (see noise_floor_for)
 
     def filter_fn(self):
         return {"dense": kalman_filter, "info": info_filter,
@@ -214,7 +216,7 @@ def em_progress(lls, tol: float, noise_floor: float = 0.0) -> str:
     return "continue"
 
 
-def noise_floor_for(dtype, n_obs: float = 1.0) -> float:
+def noise_floor_for(dtype, n_obs: float = 1.0, mult: float = 100.0) -> float:
     """ABSOLUTE loglik noise floor for a compute dtype.
 
     The computed loglik is assembled from pieces of magnitude O(n_obs)
@@ -224,10 +226,17 @@ def noise_floor_for(dtype, n_obs: float = 1.0) -> float:
     loglik near zero while the pieces are 1e7, making any relative-to-
     loglik floor arbitrarily wrong (measured: an f32 10k x 500 fit shows
     absolute wobble ~1 on a loglik of ~1e4).  Pass ``n_obs = number of
-    observed scalars`` (T*N for a dense panel); the 100x headroom covers
-    the tree-reduction constant.
+    observed scalars`` (T*N for a dense panel).
+
+    ``mult`` is the headroom over the eps*n_obs scale.  The default 100x
+    covers the tree-reduction constant conservatively, which at large f32
+    panels (~60 absolute units at 10k x 500) can also mask a GENUINE small
+    divergence as converged (ADVICE r4 item 2) — drivers expose it via
+    ``EMConfig.noise_floor_mult`` so studies that need a sharp divergence
+    alarm can tighten it (e.g. 10x) at the cost of false alarms near the
+    measured ~1-unit wobble.
     """
-    return 100.0 * float(jnp.finfo(jnp.dtype(dtype)).eps) * max(n_obs, 1.0)
+    return mult * float(jnp.finfo(jnp.dtype(dtype)).eps) * max(n_obs, 1.0)
 
 
 def run_em_loop(step, max_iters: int, tol: float, callback=None,
@@ -381,7 +390,8 @@ def em_fit(Y, p0: SSMParams, mask=None, cfg: EMConfig = EMConfig(),
 
     lls, converged, state = run_em_loop(
         step, max_iters, tol, callback,
-        noise_floor=noise_floor_for(Y.dtype, Y.size))
+        noise_floor=noise_floor_for(Y.dtype, Y.size,
+                                    mult=cfg.noise_floor_mult))
     if cfg.filter == "ss":
         warn_ss_delta(max_delta, cfg.tau)
     p_iters = len(lls)

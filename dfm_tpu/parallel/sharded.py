@@ -328,7 +328,8 @@ def sharded_em_fit(Y, p0, mask=None, mesh=None, cfg: EMConfig = EMConfig(),
     from ..estim.em import noise_floor_for, warn_ss_delta
     lls, converged, em_state = run_em_loop(
         step, max_iters, tol, callback,
-        noise_floor=noise_floor_for(drv.Y.dtype, drv.Y.size))
+        noise_floor=noise_floor_for(drv.Y.dtype, drv.Y.size,
+                                    mult=drv.cfg.noise_floor_mult))
     if drv.cfg.filter == "ss":
         warn_ss_delta(max_delta, drv.cfg.tau)
     drv.p_iters = len(lls)

@@ -47,9 +47,47 @@ from .kalman import rts_smoother
 from .params import SSMParams, FilterResult, SmootherResult
 
 __all__ = ["ss_filter", "ss_smoother", "ss_filter_smoother", "ss_from_stats",
-           "DEFAULT_TAU"]
+           "riccati_mixing_steps", "auto_tau", "DEFAULT_TAU"]
 
 DEFAULT_TAU = 96
+
+
+def riccati_mixing_steps(p, tol: float = 1e-12, max_steps: int = 512) -> int:
+    """Steps until the predicted-covariance recursion stops moving.
+
+    Host-side NumPy f64 (k x k per step — microseconds): the Riccati path
+    P -> A (P^{-1} + C)^{-1} A' + Q is data-independent, so its mixing time
+    can be measured once at the entry params and used to size ``tau``
+    (see ``auto_tau``).  ``p`` is any params object with Lam/A/Q/R/P0.
+    """
+    import numpy as np
+    Lam = np.asarray(p.Lam, np.float64)
+    A = np.asarray(p.A, np.float64)
+    Q = np.asarray(p.Q, np.float64)
+    C = (Lam / np.asarray(p.R, np.float64)[:, None]).T @ Lam
+    k = A.shape[0]
+    P = np.asarray(p.P0, np.float64)
+    for t in range(1, max_steps + 1):
+        Pf = np.linalg.solve(np.eye(k) + P @ C, P)
+        Pn = A @ (0.5 * (Pf + Pf.T)) @ A.T + Q
+        if np.max(np.abs(Pn - P)) <= tol * max(np.max(np.abs(Pn)), 1e-30):
+            return t
+        P = Pn
+    return max_steps
+
+
+def auto_tau(p, margin: float = 2.0, lo: int = 8, hi: int = 192) -> int:
+    """Data-driven steady-state horizon: ``margin`` x the measured mixing
+    time at the entry params (the margin covers parameter drift across EM
+    iterations), bucketed to powers-of-two-ish values so repeated fits hit
+    the jit cache instead of recompiling per panel.  The ss freeze
+    diagnostic (``warn_ss_delta``) still guards the choice at runtime."""
+    import numpy as np
+    tau = margin * riccati_mixing_steps(p)
+    for b in (8, 12, 16, 24, 32, 48, 64, 96, 128, 192):
+        if b >= lo and tau <= b:
+            return int(min(b, hi))
+    return hi
 
 
 def _affine_combine(earlier, later):

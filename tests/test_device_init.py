@@ -36,6 +36,31 @@ def test_device_init_fit_reaches_same_optimum(panel):
     assert abs(r_dev.loglik - r_host.loglik) < 1e-6 * abs(r_host.loglik)
 
 
+def test_device_init_masked_panel_cache_hits_and_is_mask_safe(panel):
+    """ADVICE r4 item 1: the cache is keyed on the CALLER'S panel object (so
+    fit()'s pre-filled masked panel hits it), and carries the mask identity
+    (so a different mask can never see the old mask's zero-fill)."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(19)
+    W = dgp.random_mask(90, 64, rng, 0.15)
+    Yz = np.where(W > 0, panel, 0.0)
+    b = TPUBackend(device_init=True, dtype=jnp.float64)
+    model = DynamicFactorModel(n_factors=3)
+    b.default_init(Yz, W, model)
+    got = b._device_panel(Yz, W, jnp.float64)
+    assert b._panel_cache is None          # one-shot
+    np.testing.assert_array_equal(np.asarray(got), Yz)
+    # Same panel object under a DIFFERENT mask must MISS (values were filled
+    # under the first mask).
+    b.default_init(Yz, W, model)
+    W2 = dgp.random_mask(90, 64, rng, 0.15)
+    assert b._device_panel(Yz, W2, jnp.float64) is not None
+    # identity check inside: fresh transfer, not the cached object
+    b.default_init(Yz, W, model)
+    cached = b._panel_cache[2]
+    assert b._device_panel(Yz, W2, jnp.float64) is not cached
+
+
 def test_device_init_panel_cache_not_reused_across_panels(panel):
     """The on-device panel cache is keyed by object identity: fitting a
     SECOND panel on the same backend must not reuse the first's data."""

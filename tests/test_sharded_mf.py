@@ -62,3 +62,19 @@ def test_sharded_mf_f32_tolerance(mf_panel):
     np.testing.assert_allclose(np.asarray(r32.params.Lam_m),
                                np.asarray(r64.params.Lam_m), atol=5e-3)
     np.testing.assert_allclose(r32.factors, r64.factors, atol=5e-3)
+
+
+def test_sharded_mf_fused_chunk_matches_unfused(mf_panel):
+    """fused_chunk>1 == fused_chunk=1 on the fake mesh (x64 exact): guards
+    the chunked scan_fn plumbing independently of the single-device
+    comparison (both defaults are fused — VERDICT r5 review)."""
+    Y, mask = mf_panel
+    spec = MixedFreqSpec(n_monthly=30, n_quarterly=8, n_factors=2)
+    r1 = sharded_mf_fit(Y, spec, mask=mask, mesh=make_mesh(8),
+                        dtype=jnp.float64, max_iters=7, tol=0.0,
+                        fused_chunk=1)
+    r3 = sharded_mf_fit(Y, spec, mask=mask, mesh=make_mesh(8),
+                        dtype=jnp.float64, max_iters=7, tol=0.0,
+                        fused_chunk=3)
+    np.testing.assert_allclose(r3.logliks, r1.logliks, rtol=1e-12)
+    np.testing.assert_allclose(r3.nowcast, r1.nowcast, atol=1e-10)

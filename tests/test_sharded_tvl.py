@@ -50,3 +50,18 @@ def test_sharded_tvl_f32_tolerance():
     np.testing.assert_allclose(r32.logliks, r64.logliks, atol=floor,
                                rtol=1e-4)
     np.testing.assert_allclose(r32.common, r64.common, atol=5e-3)
+
+
+def test_sharded_tvl_fused_chunk_matches_unfused():
+    """fused_chunk>1 == fused_chunk=1 on the fake mesh (x64 exact)."""
+    rng = np.random.default_rng(98)
+    Y, F, Lams, _, _ = dgp.simulate_tv_loadings(32, 100, 2, rng,
+                                                walk_scale=0.05)
+    spec = TVLSpec(n_factors=2, n_rounds=5, tol=0.0)
+    r1 = sharded_tvl_fit(Y, spec, mesh=make_mesh(8), dtype=jnp.float64,
+                         fused_chunk=1)
+    r3 = sharded_tvl_fit(Y, spec, mesh=make_mesh(8), dtype=jnp.float64,
+                         fused_chunk=3)
+    np.testing.assert_allclose(r3.logliks, r1.logliks, rtol=1e-12)
+    np.testing.assert_allclose(r3.loadings, r1.loadings, atol=1e-12)
+    np.testing.assert_allclose(r3.common, r1.common, atol=1e-10)

@@ -119,3 +119,24 @@ def test_ss_delta_surfaced_and_warning(recwarn):
     with warnings.catch_warnings():
         warnings.simplefilter("error")
         warn_ss_delta(1e-6, tau=8)   # below threshold: must not warn
+
+
+def test_auto_tau_buckets_and_floor(setup):
+    """auto_tau: margin x measured mixing, bucketed; lo/hi clamps hold."""
+    from dfm_tpu.ssm.steady import auto_tau, riccati_mixing_steps
+    p, _ = setup
+    mix = riccati_mixing_steps(p)
+    assert 1 <= mix < 512
+    tau = auto_tau(p)
+    assert tau >= 2 * mix and tau in (8, 12, 16, 24, 32, 48, 64, 96, 128,
+                                      192)
+    assert auto_tau(p, lo=16) >= 16
+    assert auto_tau(p, margin=1e6) == 192          # hi clamp
+    # ss at the auto tau matches the exact filter (the whole point).
+    Y = dgp.simulate(p, 400, np.random.default_rng(3))[0]
+    Yj = jnp.asarray(Y)
+    pj = JP.from_numpy(p, dtype=Yj.dtype)
+    kf_ss, _, _ = ss_filter_smoother(Yj, pj, tau=tau)
+    kf_ex = info_filter(Yj, pj)
+    np.testing.assert_allclose(float(kf_ss.loglik), float(kf_ex.loglik),
+                               rtol=1e-8)

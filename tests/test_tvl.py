@@ -101,3 +101,16 @@ def test_tvl_masked_finite():
     res = tvl_fit(Ynan, TVLSpec(n_factors=2, n_rounds=4), mask=W)
     assert np.all(np.isfinite(res.logliks))
     assert np.all(np.isfinite(res.common))
+
+
+def test_tvl_fused_chunk_matches_per_round(tvl_panel):
+    """fused_chunk>1 == fused_chunk=1 exactly (x64): the chunked driver's
+    stop/replay plumbing must not change the trajectory (CLAUDE.md fused-path
+    equivalence rule; the chunk boundary at round 4 of 6 is exercised)."""
+    Y, _, _ = tvl_panel
+    spec = TVLSpec(n_factors=2, n_rounds=6, tol=0.0)
+    r1 = tvl_fit(Y, spec, fused_chunk=1)
+    r4 = tvl_fit(Y, spec, fused_chunk=4)
+    np.testing.assert_allclose(r4.logliks, r1.logliks, rtol=1e-12)
+    np.testing.assert_allclose(r4.loadings, r1.loadings, atol=1e-12)
+    np.testing.assert_allclose(r4.factors, r1.factors, atol=1e-12)
