@@ -21,14 +21,19 @@ reference running the SAME information-form algorithm (the dense O(N^3)
 filter is infeasible at N=10k, and an O(N k^2) CPU baseline is the honest
 comparison — BASELINE.json:5 targets >=50x vs single-threaded CPU).
 
-Measurement hardening (VERDICT r2 "what's weak" 1/2):
+Measurement hardening (VERDICT r2 "what's weak" 1/2; r4 item 1):
   - the CPU baseline is the MEDIAN of several timed passes (each restarted
     from the PCA init), not one 3-iteration sample — round-to-round the old
     single sample swung +/-25%, turning the >=50x contract into a coin flip;
   - the 1e-5 loglik contract (BASELINE.json:5) is checked at iteration 3
     AND at iteration 50, where float32 drift across the fused scan peaks;
-  - the TPU program fuses DFM_BENCH_ITERS=150 EM iterations so the ~60-100ms
-    tunneled-dispatch cost (docs/PERF.md item 4) is amortized to <1ms/iter.
+  - ``value`` is the SUSTAINED device rate from a two-point measurement
+    (fused scans at n/3 and n iterations; the slope isolates per-iteration
+    device time from the ~60-100 ms/program tunnel dispatch, which the CPU
+    baseline does not pay).  The dispatch-inclusive total/n rate at
+    DFM_BENCH_ITERS=150 — the r1-r4 headline figure — is reported alongside
+    as ``iters_per_sec_with_dispatch``, and the dispatch cost itself as
+    ``dispatch_ms_per_program``.
 
 Diagnostics go to stderr.  Shapes/lengths can be overridden for smoke tests
 via DFM_BENCH_N / DFM_BENCH_T / DFM_BENCH_K / DFM_BENCH_ITERS /
@@ -167,40 +172,72 @@ def main():
     # NOTE: jax.block_until_ready is a no-op on the axon PJRT plugin
     # (measured: returns in 0.1 ms while the program is still running);
     # a device->host transfer is the only reliable execution barrier here.
-    def timed_em(Yj):
+    #
+    # Two-point measurement (docs/PERF.md "fixed vs marginal"): every
+    # program execution pays a ~60-100 ms FIXED dispatch/transfer cost
+    # through the tunnel regardless of content, so a single total/n
+    # division reports mostly tunnel latency at small n.  Timing the SAME
+    # fused scan at n_iters and 3*n_iters separates the two:
+    #     sustained rate = (n_hi - n_lo) / (t_hi - t_lo)
+    # — the device rate a fit-to-convergence call sustains once the per-
+    # chunk dispatch is amortized (the CPU baseline has no analogous fixed
+    # cost, so this is also the apples-to-apples comparison); the
+    # dispatch-inclusive rate at n_iters is reported alongside.  The hi/lo
+    # executions are INTERLEAVED and the slope is the median of per-pair
+    # slopes: run-to-run drift on this tunnel (+/-30% across a few seconds,
+    # docs/PERF.md item 6) would otherwise swamp the difference.
+    n_lo = n_iters
+    n_hi = 3 * n_iters
+
+    def timed_em(n):
         t0 = time.perf_counter()
-        _, lls, _ = em_fit_scan(Yj, pj, n_iters, cfg=cfg)
+        _, lls, _ = em_fit_scan(Yj, pj, n, cfg=cfg)
         lls = np.asarray(lls)  # forces completion
         return time.perf_counter() - t0, lls
 
-    def timed_eval(Yj):
+    def timed_eval(n):
         t0 = time.perf_counter()
-        lls = np.asarray(loglik_scan(Yj, pj, n_iters))
+        lls = np.asarray(loglik_scan(Yj, pj, n))
         return time.perf_counter() - t0, lls
 
-    with jax.default_matmul_precision("highest"):
-        log(f"compiling fused {n_iters}-iter EM scan ...")
+    def two_point(timed, label):
+        log(f"compiling fused {label} scans ({n_lo} and {n_hi}) ...")
         t0 = time.perf_counter()
-        compile_secs, lls = timed_em(Yj)
-        log(f"first call (compile+run): {compile_secs:.2f} s")
-        reps = [timed_em(Yj)[0] for _ in range(3)]
-        log(f"EM reps: {[f'{r:.3f}' for r in reps]} s")
-        run_secs = min(reps)
-
-        log(f"compiling fused {n_iters}-eval loglik scan ...")
-        t0 = time.perf_counter()
-        _, eval_lls = timed_eval(Yj)
+        _, lls = timed(n_lo)
         log(f"first call (compile+run): {time.perf_counter() - t0:.2f} s")
-        ereps = [timed_eval(Yj)[0] for _ in range(3)]
-        log(f"eval reps: {[f'{r:.3f}' for r in ereps]} s")
-        eval_run_secs = min(ereps)
+        timed(n_hi)  # compile the long program too
+        pairs = [(timed(n_hi)[0], timed(n_lo)[0]) for _ in range(5)]
+        log(f"{label} (hi, lo) pairs: "
+            f"{[(f'{a:.3f}', f'{b:.3f}') for a, b in pairs]} s")
+        slopes = [(a - b) / (n_hi - n_lo) for a, b in pairs]
+        med = float(np.median(slopes))
+        t_lo = float(np.median([b for _, b in pairs]))
+        slope_ok = med > 0
+        if not slope_ok:
+            # Jitter swamped the hi-lo signal (possible in smoke-size runs
+            # where the whole program is a few ms): fall back to the
+            # dispatch-inclusive figure instead of reporting a fantasy rate.
+            log(f"WARNING: {label} two-point slope non-positive "
+                f"({med:.2e}); falling back to total/n for the sustained "
+                "figure")
+            med = t_lo / n_lo
+        dispatch_ms = max(t_lo - n_lo * med, 0.0) * 1e3
+        return t_lo / n_lo, med, dispatch_ms, slope_ok, lls
 
-    tpu_secs = run_secs / n_iters
-    tpu_eval_secs = eval_run_secs / n_iters
-    log(f"TPU EM: {tpu_secs * 1e3:.2f} ms/iter "
-        f"({1.0 / tpu_secs:.2f} iters/sec)")
-    log(f"TPU loglik eval: {tpu_eval_secs * 1e3:.2f} ms/eval "
-        f"({1.0 / tpu_eval_secs:.2f} evals/sec)")
+    with jax.default_matmul_precision("highest"):
+        (tpu_secs_e2e, tpu_secs, em_dispatch_ms, em_slope_ok,
+         lls) = two_point(timed_em, "EM")
+        (tpu_eval_secs_e2e, tpu_eval_secs, ev_dispatch_ms, ev_slope_ok,
+         eval_lls) = two_point(timed_eval, "loglik-eval")
+
+    log(f"TPU EM: {tpu_secs * 1e3:.3f} ms/iter sustained "
+        f"({1.0 / tpu_secs:.1f} iters/sec); with dispatch at {n_iters} "
+        f"iters: {tpu_secs_e2e * 1e3:.3f} ms/iter "
+        f"({1.0 / tpu_secs_e2e:.1f}/sec); "
+        f"dispatch ~{em_dispatch_ms:.0f} ms/program")
+    log(f"TPU loglik eval: {tpu_eval_secs * 1e3:.3f} ms/eval sustained "
+        f"({1.0 / tpu_eval_secs:.1f} evals/sec); with dispatch "
+        f"{tpu_eval_secs_e2e * 1e3:.3f} ms/eval")
     # Fused-eval self-consistency: every eval is at the same params.
     ev_spread = float(np.max(eval_lls) - np.min(eval_lls))
     log(f"eval loglik spread across fused repeats: {ev_spread:.3g}")
@@ -251,12 +288,29 @@ def main():
 
     value = 1.0 / tpu_secs
     print(json.dumps({
-        "metric": f"em_iters_per_sec_{N}x{T}_k{k}",
+        # Round 5 renamed the metric: `value` is now the SUSTAINED device
+        # rate (two-point slope — the dispatch-free figure the CPU baseline
+        # is actually comparable to); the r1-r4 dispatch-inclusive total/n
+        # figure continues under `iters_per_sec_with_dispatch`.  The metric
+        # string carries the definition so longitudinal consumers cannot
+        # silently mix the two.
+        "metric": f"em_iters_per_sec_sustained_{N}x{T}_k{k}",
         "value": round(value, 4),
         "unit": "iters/sec",
+        "value_definition": ("sustained device rate, per-program dispatch "
+                             "excluded (see docs/PERF.md round-5 metric "
+                             "note)" if em_slope_ok else
+                             "FALLBACK total/n (two-point slope was "
+                             "jitter-dominated)"),
+        "sustained_measurement_ok": bool(em_slope_ok and ev_slope_ok),
         "vs_baseline": round(value * cpu_secs, 2),
+        "iters_per_sec_with_dispatch": round(1.0 / tpu_secs_e2e, 4),
+        "dispatch_ms_per_program": round(em_dispatch_ms, 1),
+        "n_iters_fused": n_iters,
         "loglik_evals_per_sec": round(1.0 / tpu_eval_secs, 4),
         "loglik_vs_baseline": round(cpu_eval_secs / tpu_eval_secs, 2),
+        "loglik_evals_per_sec_with_dispatch": round(
+            1.0 / tpu_eval_secs_e2e, 4),
         "loglik_rel_err_iter3": rel3_p,
         "loglik_rel_err_iter50": rel50_p,
         "loglik_rel_err_fast_iter3": rel3_f,

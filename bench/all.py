@@ -6,20 +6,58 @@ headline 10k x 500 metric (VERDICT r2 item 4) — iters/sec for the EM
 configs, rounds/sec for TVL, filter-pass/sec for SV.  Each config runs in
 this process sequentially; the device stays warm between configs but every
 config's own warm pass is what its metric comes from (see bench.run).
+
+Every config also gets a SINGLE-THREADED CPU baseline (VERDICT r4 item 3
+— BASELINE.json:5 defines the target *vs single-threaded CPU*): a pinned
+subprocess runs ``bench.cpu_baseline`` (same algorithm class per family)
+and ``vs_cpu`` records rate_tpu / rate_cpu per config.  Disable with
+``--no-cpu`` for a quick device-only sweep.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import shutil
+import subprocess
 import sys
 import time
+
+
+def _rate(rec):
+    """The config's headline rate (iters/sec or filter-passes/sec)."""
+    if not isinstance(rec, dict):
+        return None
+    return rec.get("sv_filter_passes_per_sec") or rec.get("em_iters_per_sec")
+
+
+def cpu_baseline(name: str, timeout: float = 3600.0):
+    """Run ``bench.cpu_baseline --config name`` pinned to one core."""
+    env = dict(os.environ,
+               OMP_NUM_THREADS="1", OPENBLAS_NUM_THREADS="1",
+               MKL_NUM_THREADS="1")
+    cmd = [sys.executable, "-m", "bench.cpu_baseline", "--config", name]
+    if shutil.which("taskset"):
+        cmd = ["taskset", "-c", "0"] + cmd
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=timeout, cwd=os.path.dirname(
+                             os.path.dirname(os.path.abspath(__file__))))
+    if out.returncode != 0:
+        raise RuntimeError(f"cpu baseline rc={out.returncode}: "
+                           f"{out.stderr.strip()[-400:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default="BENCH_ALL.json")
-    ap.add_argument("--configs", default="s1,s2,s3,s4,s5,headline")
+    ap.add_argument("--configs",
+                    default="s1,s2,s3,s4,s5,s5@sharded,headline",
+                    help="comma list; a 'name@backend' entry runs that "
+                         "config on a non-default backend (no CPU rerun)")
+    ap.add_argument("--no-cpu", action="store_true",
+                    help="skip the single-threaded CPU baselines")
     args = ap.parse_args(argv)
 
     import jax
@@ -30,10 +68,14 @@ def main(argv=None):
     t_start = time.time()
     for name in args.configs.split(","):
         name = name.strip()
+        cfg_name, _, backend = name.partition("@")
+        run_args = ["--config", cfg_name, "--quiet"]
+        if backend:
+            run_args += ["--backend", backend]
         print(f"=== {name} ===", file=sys.stderr, flush=True)
         t0 = time.perf_counter()
         try:
-            results[name] = bench_run.main(["--config", name, "--quiet"])
+            results[name] = bench_run.main(run_args)
         # SystemExit included: configs raise it for unknown names/kinds, and
         # one bad config must not discard the sweep's earlier device time.
         except (Exception, SystemExit) as e:
@@ -41,6 +83,19 @@ def main(argv=None):
                              "error": f"{type(e).__name__}: {e}"}
             print(f"{name} FAILED: {e}", file=sys.stderr, flush=True)
         results[name]["total_secs"] = time.perf_counter() - t0
+        if args.no_cpu or backend or "error" in results[name]:
+            continue   # name@backend variants share the base config's CPU
+        print(f"=== {name} cpu baseline ===", file=sys.stderr, flush=True)
+        try:
+            cpu = cpu_baseline(cfg_name)
+            results[name]["cpu"] = cpu
+            r_tpu, r_cpu = _rate(results[name]), _rate(cpu)
+            if r_tpu and r_cpu:
+                results[name]["vs_cpu"] = round(r_tpu / r_cpu, 2)
+        except Exception as e:
+            results[name]["cpu"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"{name} cpu baseline FAILED: {e}", file=sys.stderr,
+                  flush=True)
 
     out = {
         "device": f"{dev.platform} ({dev.device_kind})",
@@ -52,7 +107,7 @@ def main(argv=None):
     print(json.dumps({k: {kk: vv for kk, vv in v.items()
                           if kk in ("em_iters_per_sec",
                                     "sv_filter_passes_per_sec", "loglik",
-                                    "error")}
+                                    "vs_cpu", "error")}
                       for k, v in results.items()}))
     print(f"wrote {args.out}", file=sys.stderr)
 

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Single-threaded CPU baseline for one BASELINE.json config.
+
+Run as a SUBPROCESS by ``bench.all`` (or by hand):
+
+    taskset -c 0 python -m bench.cpu_baseline --config s3
+
+and prints ONE summary JSON line (the same shape ``bench.run`` emits).  The
+comparison class per family (BASELINE.json:5 "vs single-threaded CPU
+backend", same algorithm class):
+
+  s1/s2/headline  NumPy f64 info-form EM (``CPUBackend(filter="info")`` for
+                  N >= 32, dense below) through the same ``bench.run`` path
+  s3 (MF)         the SAME constrained-EM code on the XLA CPU backend in
+                  f64 (no NumPy twin exists; CPU x64 IS the oracle dtype
+                  regime the tests golden against)
+  s4 (TVL)        likewise (dual-Kalman rounds on CPU f64)
+  s5 (SV)         RBPF filter-pass rate on CPU f64, timed on a T-prefix
+                  (DFM_SV_CPU_T_PREFIX, default 100) and extrapolated
+                  linearly — the pass cost is linear in T and a full
+                  10k x 1000 x 256-particle pass on one core is minutes
+
+Thread pinning: the parent sets OMP/MKL/OPENBLAS_NUM_THREADS=1 in the
+subprocess environment (before numpy loads) and prepends ``taskset -c 0``
+where available, which bounds XLA's own thread pool to one core as well.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", default="s1")
+    args = ap.parse_args(argv)
+
+    import jax
+    # jax is already imported at interpreter startup on this machine
+    # (sitecustomize registers the TPU plugin), so the platform must be
+    # forced via config, not env (see tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+    from .configs import get
+    from . import run as bench_run
+
+    cfg = get(args.config)
+
+    if cfg.kind == "sv":
+        # Filter-pass rate only (the metric BENCH_ALL records for s5),
+        # timed on a T-prefix and extrapolated linearly in T.
+        from dfm_tpu.models.sv import sv_filter
+        from dfm_tpu.ssm.params import SSMParams as JP
+        from dfm_tpu.backends import cpu_ref
+        from dfm_tpu.utils.data import standardize as _std
+        import jax.numpy as jnp
+
+        T_pre = int(os.environ.get("DFM_SV_CPU_T_PREFIX", 100))
+        Y, mask, _ = bench_run.make_data(cfg)
+        Yz, _ = _std(np.asarray(Y, np.float64))
+        Ypre = Yz[:T_pre]
+        # Params from a cheap PCA init on the prefix: the pass cost is
+        # parameter-independent (same op count), this just keeps R sane.
+        p0 = cpu_ref.pca_init(Ypre, cfg.k)
+        spec = bench_run.sv_bench_spec(cfg)
+        Yj = jnp.asarray(Ypre, jnp.float64)
+        pj = JP.from_numpy(p0, dtype=jnp.float64)
+        key = jax.random.PRNGKey(bench_run.SV_BENCH_SEED)
+
+        def one_pass():
+            t0 = time.perf_counter()
+            r = sv_filter(Yj, pj, spec, key=key, store_paths=False)
+            float(r.loglik)
+            return time.perf_counter() - t0
+
+        one_pass()                                   # compile
+        pass_pre = min(one_pass() for _ in range(2))
+        pass_secs = pass_pre * (cfg.T / T_pre)
+        summary = {
+            "config": cfg.name, "backend": "cpu-1thread",
+            "N": cfg.N, "T": cfg.T, "k": cfg.k,
+            "sv_filter_pass_secs": pass_secs,
+            "sv_filter_passes_per_sec": 1.0 / pass_secs,
+            "n_particles": spec.n_particles,
+            "extrapolated_from_T": T_pre,
+        }
+        print(json.dumps(summary))
+        return summary
+
+    # Everything else: the regular bench.run timing path on the CPU device.
+    # Plain configs go through CPUBackend (NumPy f64; info form at scale);
+    # MF/TVL run their own fit drivers, which land on the CPU XLA device.
+    if cfg.kind in ("plain", "missing"):
+        from dfm_tpu.api import CPUBackend, register_backend
+
+        class _CPUInfo(CPUBackend):
+            def __init__(self):
+                super().__init__(filter="info" if cfg.N >= 32 else "dense")
+
+        register_backend("cpu-baseline", _CPUInfo)
+        backend = "cpu-baseline"
+    else:
+        backend = "cpu"  # ignored by the MF/TVL paths; device is CPU here
+    summary = bench_run.main(["--config", args.config, "--backend", backend,
+                              "--quiet"])
+    summary["backend"] = "cpu-1thread"
+    print(json.dumps(summary))   # last stdout line = the record (parent
+    return summary               # parses it; bench_run printed its own too)
+
+
+if __name__ == "__main__":
+    main()

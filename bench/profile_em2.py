@@ -102,6 +102,22 @@ def main():
             return out, out
         return lax.scan(body, jnp.zeros((), b.dtype), None, length=n)[1]
 
+    @partial(jax.jit, static_argnames=("n",))
+    def means_ascan(b, M_path, Pfilt, n):
+        def body(carry, _):
+            bb = chain(b, carry)
+            d = jnp.einsum("tkl,tl->tk", Pfilt[1:], bb[1:])
+            Mp, dp = lax.associative_scan(
+                lambda a, bb_: steady._affine_combine(a, bb_),
+                (M_path[1:], d))
+            x_tail = jnp.einsum("tkl,l->tk", Mp, bb[0]) + dp
+            Jr, cr = lax.associative_scan(
+                lambda a, bb_: steady._affine_combine(a, bb_),
+                (M_path[1:], d), reverse=True)
+            out = jnp.sum(x_tail) + jnp.sum(Jr[0]) + jnp.sum(cr)
+            return out, out
+        return lax.scan(body, jnp.zeros((), b.dtype), None, length=n)[1]
+
     C0 = np.asarray((p0.Lam / p0.R[:, None]).T @ p0.Lam, np.float32)
     Cj = jnp.asarray(C0)
     b0 = jnp.asarray(rng.standard_normal((T, k)), dtype)
@@ -122,19 +138,15 @@ def main():
                   f"({[f'{t:.3f}' for t in ts]})")
             return fixed, marg
 
-        slope("trivial scan", lambda n: trivial_scan(pj, n))
-        slope("panel", lambda n: panel_scan(Yj, pj, n))
         slope("means", lambda n: means_scan(b0, M0, Pf0, n))
-        for tau in (16, 32):
+        slope("means assoc", lambda n: means_ascan(b0, M0, Pf0, n))
+        for tau in (8, 16):
             slope(f"cov tau={tau}",
                   lambda n, tau=tau: cov_scan(pj, Cj, n, tau))
-        for tau in (16, 32):
+        for tau in (8, 16):
             cfg = EMConfig(filter="ss", tau=tau)
             slope(f"FULL em tau={tau}",
                   lambda n, cfg=cfg: em_fit_scan(Yj, pj, n, cfg=cfg)[1])
-        cfg = EMConfig(filter="info")
-        slope("FULL em info",
-              lambda n, cfg=cfg: em_fit_scan(Yj, pj, n, cfg=cfg)[1])
 
 
 if __name__ == "__main__":

@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Within-process ablation of the steady-state EM iteration.
+
+Replicates the ss EM body (e_step + M-step, unmasked) with switchable
+pieces, scans it 300x fused, and times every variant in ONE process (the
+between-process variance on this tunnel is +/-50%, so cross-process
+comparisons lie; within-process ones are stable).  full - variant = the
+ablated piece's marginal cost.  Run: ``python -m bench.profile_em3``."""
+
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def main():
+    N = int(os.environ.get("DFM_BENCH_N", 10_000))
+    T = int(os.environ.get("DFM_BENCH_T", 500))
+    k = int(os.environ.get("DFM_BENCH_K", 10))
+    tau = int(os.environ.get("DFM_BENCH_TAU", 8))
+    n_iters = int(os.environ.get("DFM_BENCH_ITERS", 300))
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dfm_tpu.backends import cpu_ref
+    from dfm_tpu.utils import dgp
+    from dfm_tpu.estim.em import (EMConfig, _m_step, moment_sums,
+                                  mstep_rows, mstep_dynamics_sums)
+    from dfm_tpu.ssm.params import SSMParams as JP, SmootherResult
+    from dfm_tpu.ssm import steady
+    from dfm_tpu.ssm.steady import _cov_path, _freeze, _affine_combine
+    from dfm_tpu.ssm.info_filter import (obs_stats, quad_local, u_from_stats,
+                                         loglik_from_terms)
+    from dfm_tpu.ops.linalg import sym, psd_cholesky, chol_solve
+    from dfm_tpu.ops.scan import blocked_scan
+
+    rng = np.random.default_rng(0)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y, _ = dgp.simulate(p_true, T, rng)
+    Y = (Y - Y.mean(0)) / Y.std(0)
+    p0 = cpu_ref.pca_init(Y, k)
+    dtype = jnp.float32
+    Yj = jax.device_put(jnp.asarray(Y, dtype))
+    pj = JP.from_numpy(p0, dtype=dtype)
+
+    # Ablation switches (static): each removes ONE piece, replacing its
+    # output with a cheap same-shaped fake that keeps upstream alive.
+    PIECES = ("covpath", "fwdmeans", "smcov", "jpath", "revmeans",
+              "quad", "syf", "bpass", "moments")
+
+    def em_body(Y, p, cfg, skip: frozenset, Ysq):
+        T_, k_ = Y.shape[0], p.A.shape[0]
+        I_k = jnp.eye(k_, dtype=Y.dtype)
+        if "bpass" in skip:
+            G = p.Lam[:64] / p.R[:64, None]
+            b = Y[:, :64] @ G                       # 64-series stand-in
+            C = p.Lam.T @ (p.Lam / p.R[:, None])
+            from dfm_tpu.ssm.info_filter import ObsStats
+            from dfm_tpu.ops.precision import accum_dtype
+            acc = accum_dtype(Y.dtype)
+            stats = ObsStats(b, C, jnp.full((T_,), float(N), Y.dtype),
+                             jnp.full((T_,), 1.0).astype(acc))
+        else:
+            stats = obs_stats(Y, p.Lam, p.R)
+        C = stats.C
+
+        if "covpath" in skip:
+            P1 = sym(p.P0 * 0.5)
+            Pp_ex = jnp.broadcast_to(P1, (tau, k_, k_))
+            Pf_ex = jnp.broadcast_to(P1 * 0.3, (tau, k_, k_))
+            M_ex = jnp.broadcast_to(p.A * 0.5, (tau, k_, k_))
+            ldG_ex = jnp.ones((tau,), Y.dtype)
+            delta = jnp.zeros((), Y.dtype)
+        else:
+            Pp_ex, Pf_ex, M_ex, ldG_ex, delta = _cov_path(
+                C, p.A, p.Q, p.P0, tau, Y.dtype)
+        P_pred = _freeze(Pp_ex, T_, tau)
+        P_filt = _freeze(Pf_ex, T_, tau)
+        M_path = _freeze(M_ex, T_, tau)
+        logdetG = _freeze(ldG_ex, T_, tau)
+
+        b = stats.b
+        x0 = p.mu0 + Pf_ex[0] @ (b[0] - C @ p.mu0)
+        if "fwdmeans" in skip:
+            x_filt = jnp.einsum("tkl,tl->tk", P_filt, b)
+        else:
+            d = jnp.einsum("tkl,tl->tk", P_filt[1:], b[1:])
+            Mpref, dpref = blocked_scan(_affine_combine, (M_path[1:], d))
+            x_tail = jnp.einsum("tkl,l->tk", Mpref, x0) + dpref
+            x_filt = jnp.concatenate([x0[None], x_tail], axis=0)
+        x_pred = jnp.concatenate([p.mu0[None], x_filt[:-1] @ p.A.T], axis=0)
+
+        if "jpath" in skip:
+            J = jnp.broadcast_to(p.A * 0.4, (T_ - 1, k_, k_))
+            J_ss = p.A * 0.4
+        else:
+            Lp_ex = psd_cholesky(Pp_ex[1:])
+            APf_ex = jnp.einsum("ij,tjk->tik", p.A, Pf_ex[:-1])
+            J_ex = jnp.swapaxes(jax.vmap(chol_solve)(Lp_ex, APf_ex), -1, -2)
+            Lp_ss = psd_cholesky(Pp_ex[-1])
+            J_ss = chol_solve(Lp_ss, p.A @ Pf_ex[-1]).T
+            J = jnp.concatenate(
+                [J_ex, jnp.broadcast_to(J_ss, (T_ - tau, k_, k_))], axis=0)
+
+        Pp_ss, Pf_ss = Pp_ex[-1], Pf_ex[-1]
+        if "smcov" in skip:
+            P_sm = P_filt
+        else:
+            def bstep_ss(Ps, _):
+                Ps_new = sym(Pf_ss + J_ss @ (Ps - Pp_ss) @ J_ss.T)
+                return Ps_new, Ps_new
+
+            Ps_mid, Psm_end_rev = lax.scan(bstep_ss, Pf_ss, None, length=tau)
+            Psm_end = jnp.flip(Psm_end_rev, axis=0)
+
+            def bstep_ex(Ps, inp):
+                P_f_t, P_p_next, J_t = inp
+                Ps_new = sym(P_f_t + J_t @ (Ps - P_p_next) @ J_t.T)
+                return Ps_new, Ps_new
+
+            Pp_next_ex = jnp.concatenate([Pp_ex[1:], Pp_ex[-1:]], axis=0)
+            _, Psm_front_rev = lax.scan(
+                bstep_ex, Ps_mid, (Pf_ex, Pp_next_ex, J[:tau]), reverse=True)
+            n_mid = T_ - 1 - 2 * tau
+            P_sm = jnp.concatenate([
+                Psm_front_rev,
+                jnp.broadcast_to(Ps_mid, (n_mid, k_, k_)),
+                Psm_end,
+                Pf_ss[None],
+            ], axis=0)
+
+        if "revmeans" in skip:
+            x_sm = x_filt
+        else:
+            c = x_filt[:-1] - jnp.einsum("tkl,tl->tk", J, x_pred[1:])
+            Jr, cr = blocked_scan(
+                lambda late, early: _affine_combine(late, early),
+                (J, c), reverse=True)
+            x_head = jnp.einsum("tkl,l->tk", Jr, x_filt[-1]) + cr
+            x_sm = jnp.concatenate([x_head, x_filt[-1:]], axis=0)
+
+        P_lag_tail = jnp.einsum("tij,tkj->tik", P_sm[1:], J)
+        P_lag = jnp.concatenate([jnp.zeros((1, k_, k_), Y.dtype),
+                                 P_lag_tail], axis=0)
+        sm = SmootherResult(x_sm, P_sm, P_lag)
+
+        if "quad" in skip:
+            quad_R = stats.n
+        else:
+            quad_R, _ = quad_local(Y, p.Lam, p.R, x_pred, None)
+        ll = loglik_from_terms(stats, logdetG, P_filt, quad_R,
+                               u_from_stats(stats, x_pred))
+
+        # ----- M-step -----
+        if "moments" in skip:
+            S_ff = C * 0.1 + I_k * float(T_)
+            S_lag = S_cur = S_ff
+            S_cross = S_ff * 0.5
+        else:
+            S_ff, S_lag, S_cur, S_cross = moment_sums(sm)
+        if "syf" in skip:
+            Lam, R = p.Lam, p.R
+        else:
+            Lam, R = mstep_rows(Y, None, sm.x_sm, None, None, S_ff,
+                                1e-6, Ysq=Ysq)
+        A, Q, mu0, P0 = mstep_dynamics_sums(sm, S_lag, S_cur, S_cross,
+                                            p, EMConfig())
+        return JP(Lam, A, Q, R, mu0, P0), (ll, delta)
+
+    @partial(jax.jit, static_argnames=("skip", "n"))
+    def em_scan(Y, p, skip, n):
+        Ysq = jnp.einsum("ti,ti->i", Y, Y)
+
+        def body(p_c, _):
+            return em_body(Y, p_c, None, skip, Ysq)
+
+        return lax.scan(body, p, None, length=n)[1]
+
+    def timed(skip):
+        f = lambda: em_scan(Yj, pj, skip, n_iters)
+        np.asarray(f()[0])
+        reps = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            np.asarray(f()[0])
+            reps.append(time.perf_counter() - t0)
+        return min(reps)
+
+    with jax.default_matmul_precision("highest"):
+        full = timed(frozenset())
+        print(f"{'FULL replica':12s} {full / n_iters * 1e3:7.3f} ms/iter "
+              f"(tau={tau}, {n_iters} fused)")
+        for piece in PIECES:
+            t = timed(frozenset([piece]))
+            print(f"-{piece:11s} {t / n_iters * 1e3:7.3f} ms/iter   "
+                  f"piece costs {(full - t) / n_iters * 1e3:+7.3f}")
+        t = timed(frozenset(PIECES))
+        print(f"-ALL         {t / n_iters * 1e3:7.3f} ms/iter (skeleton)")
+        # real em_fit_scan for cross-check, same process
+        from dfm_tpu.estim.em import em_fit_scan
+        cfg = EMConfig(filter="ss", tau=tau)
+        np.asarray(em_fit_scan(Yj, pj, n_iters, cfg=cfg)[1])
+        reps = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            np.asarray(em_fit_scan(Yj, pj, n_iters, cfg=cfg)[1])
+            reps.append(time.perf_counter() - t0)
+        print(f"real em_fit_scan {min(reps) / n_iters * 1e3:7.3f} ms/iter")
+
+
+if __name__ == "__main__":
+    main()

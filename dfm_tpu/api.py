@@ -106,19 +106,34 @@ class Backend:
 
 
 class CPUBackend(Backend):
-    """NumPy float64 reference backend (the golden oracle)."""
+    """NumPy float64 reference backend (the golden oracle).
+
+    filter: "dense" (N x N innovation covariance — the canonical oracle and
+    the default) or "info" (information form, O(N k^2)/step — the same
+    algorithm class as the accelerator path; what the single-threaded CPU
+    baselines of BASELINE.json:5 time at shapes where the dense form's
+    O(N^3)/step is infeasible).  Both agree to fp tolerance (tested).
+    """
 
     name = "cpu"
+
+    def __init__(self, filter: str = "dense"):
+        if filter not in ("dense", "info"):
+            raise ValueError(f"unknown cpu filter {filter!r}")
+        self.filter = filter
 
     def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
         p, lls, converged = cpu_ref.em_fit(
             Y, p0, mask=mask, max_iters=max_iters, tol=tol,
             estimate_A=model.estimate_A, estimate_Q=model.estimate_Q,
-            estimate_init=model.estimate_init, callback=callback)
+            estimate_init=model.estimate_init, callback=callback,
+            filter=self.filter)
         return p, np.asarray(lls), converged, len(lls)
 
     def smooth(self, Y, mask, params):
-        kf = cpu_ref.kalman_filter(Y, params, mask=mask)
+        ff = (cpu_ref.kalman_filter_info if self.filter == "info"
+              else cpu_ref.kalman_filter)
+        kf = ff(Y, params, mask=mask)
         sm = cpu_ref.rts_smoother(kf, params)
         return np.asarray(sm.x_sm), np.asarray(sm.P_sm)
 
@@ -307,16 +322,21 @@ class ShardedBackend(TPUBackend):
     so the multi-device path is not program-dispatch-bound (one ~60-100 ms
     dispatch per chunk instead of per iteration).  Callbacks receive
     chunk-entry params, unpadded to the true series count.
+
+    debug: checkify float checks around the whole shard_map program — the
+    sharded analog of ``TPUBackend(debug=True)`` (a poisoned shard raises a
+    located error instead of silently psum-ing NaNs).
     """
 
     name = "sharded"
 
     def __init__(self, dtype=None, n_devices=None, filter: str = "info",
-                 matmul_precision: str = "highest", fused_chunk: int = 8):
+                 matmul_precision: str = "highest", fused_chunk: int = 8,
+                 debug: bool = False):
         super().__init__(dtype=dtype,
                          filter="info" if filter == "auto" else filter,
                          matmul_precision=matmul_precision,
-                         fused_chunk=fused_chunk)
+                         fused_chunk=fused_chunk, debug=debug)
         if self.filter not in ("info", "ss"):
             raise ValueError(
                 f"sharded filter must be 'info' or 'ss'; got {filter!r}")
@@ -354,16 +374,14 @@ class ShardedBackend(TPUBackend):
     def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
         from .estim.em import EMConfig
         from .parallel.sharded import ShardedEM, sharded_em_fit
-        if self.debug:
-            import warnings
-            warnings.warn(
-                "debug (checkify) mode is not supported under sharding; "
-                "running unchecked — debug single-device with "
-                "TPUBackend(debug=True) instead", RuntimeWarning,
-                stacklevel=2)
+        # debug: the checkify float checks wrap the whole shard_map program
+        # (parallel.sharded._sharded_em_*_checked_impl) — a poisoned shard
+        # raises a LOCATED error through the psum, same contract as the
+        # single-device TPUBackend(debug=True).
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
-                       estimate_init=model.estimate_init, filter=self.filter)
+                       estimate_init=model.estimate_init, filter=self.filter,
+                       debug=self.debug)
         with self._precision_ctx():
             if self.fused_chunk <= 1:
                 p, lls, converged, drv = sharded_em_fit(

@@ -24,7 +24,8 @@ from ..ssm.params import SSMParams, SmootherResult
 
 __all__ = ["EMConfig", "em_step", "em_fit", "em_fit_scan", "run_em_loop",
            "run_em_chunked", "em_progress", "noise_floor_for",
-           "warn_ss_delta", "moments", "mstep_rows", "mstep_dynamics"]
+           "warn_ss_delta", "moments", "moment_sums", "mstep_rows",
+           "mstep_dynamics", "mstep_dynamics_sums"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,17 +65,21 @@ class EMConfig:
     def smoother_fn(self):
         return pit_smoother if self.filter == "pit" else rts_smoother
 
-    def e_step(self, Y, mask, p):
+    def e_step(self, Y, mask, p, sumsq=None):
         """Filter + smoother under the configured implementation.
 
         Returns (kf, sm, delta): ``delta`` is the steady-state freeze
         diagnostic (relative covariance error at the freeze point) for
         filter="ss", and exact 0 for the exact filters — surfaced so ss
         users learn when ``tau`` is too small (ADVICE r1 item 1).
+
+        ``sumsq`` (optional, precomputed Y*Y): enables the ss path's
+        expanded-form loglik quadratic (see ``ss_filter_smoother``).
         """
         if self.filter == "ss":
             from ..ssm.steady import ss_filter_smoother
-            kf, sm, delta = ss_filter_smoother(Y, p, mask=mask, tau=self.tau)
+            kf, sm, delta = ss_filter_smoother(Y, p, mask=mask, tau=self.tau,
+                                               sumsq=sumsq)
             return kf, sm, delta
         kf = self.filter_fn()(Y, p, mask=mask)
         return kf, self.smoother_fn()(kf, p), jnp.zeros((), Y.dtype)
@@ -85,6 +90,8 @@ def moments(sm: SmootherResult):
 
     Compute ONCE per M-step and thread into ``mstep_rows`` /
     ``mstep_dynamics`` — the (T,k,k) einsums are not free at scale.
+    Needed only on the MASKED path (per-series S_ff_i sums); the unmasked
+    M-step uses ``moment_sums``, which never materializes them.
     """
     x, P, Pl = sm.x_sm, sm.P_sm, sm.P_lag
     EffT = P + jnp.einsum("ti,tj->tij", x, x)
@@ -92,21 +99,43 @@ def moments(sm: SmootherResult):
     return EffT, cross
 
 
-def mstep_rows(Y, mask, Ef, EffT, P_sm, S_ff, r_floor: float):
+def moment_sums(sm: SmootherResult):
+    """Unmasked M-step moment sums in matmul form.
+
+    Returns (S_ff, S_ff_lag, S_ff_cur, S_cross): the summed-over-t second
+    moments the closed-form updates need, computed as (k,T)x(T,k) matmuls +
+    (T,k,k) reductions — no per-t outer-product temporaries, fewer/larger
+    ops than summing ``moments`` (measured on the headline shape as part of
+    the per-iteration sequential-tail cost, docs/PERF.md roofline table).
+    """
+    x, P, Pl = sm.x_sm, sm.P_sm, sm.P_lag
+    S_ff = P.sum(0) + x.T @ x
+    last = P[-1] + jnp.outer(x[-1], x[-1])
+    first = P[0] + jnp.outer(x[0], x[0])
+    S_cross = Pl[1:].sum(0) + x[1:].T @ x[:-1]
+    return S_ff, S_ff - last, S_ff - first, S_cross
+
+
+def mstep_rows(Y, mask, Ef, EffT, P_sm, S_ff, r_floor: float, Ysq=None):
     """Per-series M-step rows: new (Lam (n, k), R (n,)) for a series block.
 
     ``Y`` is (T, n) — the full panel or one device's shard.  Each series' row
     of Lam/R depends only on that series' own column of Y plus the replicated
     smoother moments, so under sharding this runs locally with NO collective
     (the psum lives in the E-step; SURVEY.md section 3.1 device boundary).
+
+    ``Ysq``: optional precomputed per-series sum of squares (unmasked path).
+    It is EM-iteration-invariant, so fused-scan drivers hoist the panel pass
+    out of the iteration loop and thread it in.
     """
     T = Y.shape[0]
     dtype = Y.dtype
     if mask is None:
         S_yf = Y.T @ Ef                                       # (n, k)
         Lam = solve_psd(S_ff, S_yf.T).T
-        R = (jnp.einsum("ti,ti->i", Y, Y)
-             - jnp.einsum("ik,ik->i", Lam, S_yf)) / T
+        if Ysq is None:
+            Ysq = jnp.einsum("ti,ti->i", Y, Y)
+        R = (Ysq - jnp.einsum("ik,ik->i", Lam, S_yf)) / T
     else:
         k = S_ff.shape[0]
         W = mask.astype(dtype)
@@ -124,13 +153,10 @@ def mstep_rows(Y, mask, Ef, EffT, P_sm, S_ff, r_floor: float):
     return Lam, jnp.maximum(R, r_floor)
 
 
-def mstep_dynamics(sm: SmootherResult, EffT, cross, p: SSMParams,
-                   cfg: EMConfig):
-    """Replicated k x k M-step updates (A, Q, mu0, P0) from smoother moments."""
+def mstep_dynamics_sums(sm: SmootherResult, S_ff_lag, S_ff_cur, S_cross,
+                        p: SSMParams, cfg: EMConfig):
+    """Replicated k x k M-step updates (A, Q, mu0, P0) from SUMMED moments."""
     T = sm.x_sm.shape[0]
-    S_ff_lag = EffT[:-1].sum(0)
-    S_ff_cur = EffT[1:].sum(0)
-    S_cross = cross.sum(0)
     A, Q = p.A, p.Q
     if cfg.estimate_A:
         A = solve_psd(S_ff_lag, S_cross.T).T
@@ -146,19 +172,49 @@ def mstep_dynamics(sm: SmootherResult, EffT, cross, p: SSMParams,
     return A, Q, mu0, P0
 
 
-def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig):
-    EffT, cross = moments(sm)
-    S_ff = EffT.sum(0)
-    Lam, R = mstep_rows(Y, mask, sm.x_sm, EffT, sm.P_sm, S_ff, cfg.r_floor)
-    A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p, cfg)
+def mstep_dynamics(sm: SmootherResult, EffT, cross, p: SSMParams,
+                   cfg: EMConfig):
+    """Replicated k x k M-step updates (A, Q, mu0, P0) from smoother moments."""
+    return mstep_dynamics_sums(sm, EffT[:-1].sum(0), EffT[1:].sum(0),
+                               cross.sum(0), p, cfg)
+
+
+def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig,
+            Ysq=None):
+    if mask is None:
+        S_ff, S_lag, S_cur, S_cross = moment_sums(sm)
+        Lam, R = mstep_rows(Y, None, sm.x_sm, None, None, S_ff, cfg.r_floor,
+                            Ysq=Ysq)
+        A, Q, mu0, P0 = mstep_dynamics_sums(sm, S_lag, S_cur, S_cross, p, cfg)
+    else:
+        EffT, cross = moments(sm)
+        S_ff = EffT.sum(0)
+        Lam, R = mstep_rows(Y, mask, sm.x_sm, EffT, sm.P_sm, S_ff,
+                            cfg.r_floor)
+        A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p, cfg)
     return SSMParams(Lam, A, Q, R, mu0, P0)
+
+
+def _panel_consts(Y, has_mask: bool, cfg: EMConfig):
+    """EM-iteration-invariant panel reductions (hoisted by the fused scans).
+
+    Returns (sumsq (T,N) | None, Ysq (N,) | None): ``sumsq`` feeds the ss
+    path's expanded loglik quadratic, ``Ysq`` the unmasked M-step rows.
+    """
+    if has_mask:
+        return None, None
+    if cfg.filter == "ss":
+        sumsq = Y * Y
+        return sumsq, jnp.sum(sumsq, axis=0)
+    return None, jnp.einsum("ti,ti->i", Y, Y)
 
 
 @partial(jax.jit, static_argnames=("cfg", "has_mask"))
 def _em_step_impl(Y, mask, p: SSMParams, cfg: EMConfig, has_mask: bool):
     m = mask if has_mask else None
-    kf, sm, delta = cfg.e_step(Y, m, p)
-    p_new = _m_step(Y, m, sm, p, cfg)
+    sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
+    kf, sm, delta = cfg.e_step(Y, m, p, sumsq=sumsq)
+    p_new = _m_step(Y, m, sm, p, cfg, Ysq=Ysq)
     return p_new, kf.loglik, delta
 
 
@@ -170,8 +226,9 @@ def _em_step_checked_impl(Y, mask, p: SSMParams, cfg: EMConfig,
 
     def f(Y, mask, p):
         m = mask if has_mask else None
-        kf, sm, delta = cfg.e_step(Y, m, p)
-        return _m_step(Y, m, sm, p, cfg), kf.loglik, delta
+        sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
+        kf, sm, delta = cfg.e_step(Y, m, p, sumsq=sumsq)
+        return _m_step(Y, m, sm, p, cfg, Ysq=Ysq), kf.loglik, delta
 
     return checkify.checkify(f, errors=checkify.float_checks)(Y, mask, p)
 
@@ -404,10 +461,12 @@ def em_fit(Y, p0: SSMParams, mask=None, cfg: EMConfig = EMConfig(),
 @partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
 def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
     m = mask if has_mask else None
+    # Iteration-invariant panel passes hoisted out of the fused loop.
+    sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
 
     def body(p, _):
-        kf, sm, delta = cfg.e_step(Y, m, p)
-        return _m_step(Y, m, sm, p, cfg), (kf.loglik, delta)
+        kf, sm, delta = cfg.e_step(Y, m, p, sumsq=sumsq)
+        return _m_step(Y, m, sm, p, cfg, Ysq=Ysq), (kf.loglik, delta)
 
     p, (lls, deltas) = jax.lax.scan(body, p0, None, length=n_iters)
     return p, lls, deltas
@@ -422,10 +481,11 @@ def _em_fit_scan_checked_impl(Y, mask, p0, cfg, has_mask, n_iters):
 
     def g(Y, mask, p0):
         m = mask if has_mask else None
+        sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
 
         def body(p, _):
-            kf, sm, delta = cfg.e_step(Y, m, p)
-            return _m_step(Y, m, sm, p, cfg), (kf.loglik, delta)
+            kf, sm, delta = cfg.e_step(Y, m, p, sumsq=sumsq)
+            return _m_step(Y, m, sm, p, cfg, Ysq=Ysq), (kf.loglik, delta)
 
         p, (lls, deltas) = jax.lax.scan(body, p0, None, length=n_iters)
         return p, lls, deltas

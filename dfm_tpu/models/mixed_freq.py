@@ -39,7 +39,7 @@ from ..ssm.kalman import rts_smoother
 from ..ssm.params import SSMParams
 
 __all__ = ["MixedFreqSpec", "MFParams", "augment", "mf_em_step", "mf_fit",
-           "mf_forecast", "MFResult"]
+           "mf_forecast", "mf_loglik_eval", "MFResult"]
 
 MM_WEIGHTS = (1.0 / 3, 2.0 / 3, 1.0, 2.0 / 3, 1.0 / 3)
 
@@ -209,6 +209,25 @@ def mf_em_core(Y, mask, p: MFParams, spec: MixedFreqSpec,
         mu0 = x[0]
         P0 = sym(P[0])
     return MFParams(Lam_m, Lam_q, A, Q, R, mu0, P0), kf.loglik, sm
+
+
+def mf_loglik_eval(Y, mask, p: MFParams, spec: MixedFreqSpec,
+                   precise: bool = True) -> float:
+    """Reporting-grade log-likelihood of the MF model at given params.
+
+    The mixed-frequency model is EXACTLY linear-Gaussian in its augmented
+    state, so this is the same contract as ``ssm.info_filter.loglik_eval``
+    (f64 on device when ``precise`` and x64 are on; falls back to the
+    compute dtype with a warning otherwise): augment the params (in f64, so
+    the Mariano-Murasawa weight products don't round) and run the masked
+    info-form filter.  Backs the per-config accuracy artifact of
+    BASELINE.json:5 for S3 (VERDICT r4 item 4).
+    """
+    from ..ssm.info_filter import loglik_eval
+    if precise and jax.config.jax_enable_x64:
+        p = MFParams(*(jnp.asarray(np.asarray(x), jnp.float64) for x in p))
+    aug = augment(p, spec)
+    return loglik_eval(Y, aug, mask=mask, precise=precise)
 
 
 @partial(jax.jit, static_argnames=("spec",))

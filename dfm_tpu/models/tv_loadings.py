@@ -44,7 +44,7 @@ from ..ssm.params import SSMParams
 
 __all__ = ["TVLSpec", "TVLParams", "tvl_fit", "tvl_forecast", "TVLResult",
            "factor_pass_tv", "loading_pass", "tvl_round_core",
-           "tvl_round_scan"]
+           "tvl_round_scan", "tvl_loglik_eval"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -247,6 +247,45 @@ def tvl_round_core(Y, mask, Lam_t, p: TVLParams, spec: TVLSpec,
     p_new = TVLParams(Lam0=lam_sm[0], tau2=tau2, A=A, Q=Q, R=R,
                       mu0=p.mu0, P0=p.P0)
     return lam_sm, p_new, kf.loglik, F
+
+
+@partial(jax.jit, static_argnames=("has_mask",))
+def _tvl_loglik_impl(Y, mask, Lam_t, p: TVLParams, has_mask: bool):
+    m = mask if has_mask else None
+    stats = obs_stats_tv(Y, Lam_t, p.R, mask=m)
+    xp, Pp, xf, Pf, logdetG = info_scan(stats, p.A, p.Q, p.mu0, p.P0)
+    V = Y - jnp.einsum("tnk,tk->tn", Lam_t, xp)
+    if m is not None:
+        V = m.astype(Y.dtype) * jnp.nan_to_num(V)
+    VR = V / p.R[None, :]
+    quad_R = jnp.einsum("tn,tn->t", V, VR)
+    U = jnp.einsum("tn,tnk->tk", VR, Lam_t)
+    return loglik_from_terms(stats, logdetG, Pf, quad_R, U)
+
+
+def tvl_loglik_eval(Y, Lam_t, p: TVLParams, mask=None,
+                    precise: bool = True) -> float:
+    """Reporting-grade CONDITIONAL log-likelihood at (Lam_t, params).
+
+    Semantics (documented, per BASELINE.json:5 / VERDICT r4 item 4): the
+    TVL model's exact joint likelihood is intractable (bilinear in factors
+    and loadings), so the estimation monitor — and this evaluator — is the
+    factor-filter likelihood CONDITIONAL on the loading paths, i.e.
+    p(Y | Lam_{1:T}, theta).  ``precise`` re-evaluates it in float64 on
+    device (needs x64; falls back to the compute dtype with a warning).
+    """
+    use_f64 = precise and jax.config.jax_enable_x64
+    if precise and not use_f64:
+        import warnings
+        warnings.warn(
+            "precise tvl_loglik_eval needs jax_enable_x64; evaluating in "
+            "the compute dtype instead", RuntimeWarning, stacklevel=2)
+    dtype = jnp.float64 if use_f64 else jnp.asarray(Y).dtype
+    Yj = jnp.asarray(np.nan_to_num(np.asarray(Y, np.float64)), dtype)
+    Lj = jnp.asarray(np.asarray(Lam_t, np.float64), dtype)
+    pj = TVLParams(*(jnp.asarray(np.asarray(x), dtype) for x in p))
+    mj = jnp.asarray(mask, dtype) if mask is not None else Yj
+    return float(_tvl_loglik_impl(Yj, mj, Lj, pj, mask is not None))
 
 
 @partial(jax.jit, static_argnames=("has_mask",))

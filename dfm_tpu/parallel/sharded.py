@@ -36,10 +36,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import dataclasses
 
-from ..estim.em import (EMConfig, moments, mstep_rows, mstep_dynamics,
-                        run_em_loop)
-from ..ssm.info_filter import (ObsStats, obs_stats, info_scan,
-                               loglik_terms_local, loglik_from_terms)
+from ..estim.em import (EMConfig, moments, moment_sums, mstep_rows,
+                        mstep_dynamics, mstep_dynamics_sums, run_em_loop)
+from ..ssm.info_filter import (ObsStats, obs_stats, info_scan, quad_expanded,
+                               quad_local, u_from_stats, loglik_from_terms)
 from ..ssm.kalman import rts_smoother
 from ..ssm.params import SSMParams, FilterResult
 from .mesh import SERIES_AXIS, make_mesh, pad_panel, unpad_rows
@@ -54,7 +54,7 @@ def _psum_stats(stats: ObsStats) -> ObsStats:
 
 def _shard_filter_smoother(Y_s, mask_s, p_s: SSMParams,
                            cfg: EMConfig = EMConfig(filter="info"),
-                           gate_s=None):
+                           gate_s=None, sumsq_s=None):
     """Per-device body: local stats -> psum -> replicated k x k scans.
 
     The loglik quadratic is reduced in a second psum of the per-shard
@@ -79,7 +79,8 @@ def _shard_filter_smoother(Y_s, mask_s, p_s: SSMParams,
     """
     T = Y_s.shape[0]
     use_ss = (cfg.filter == "ss" and mask_s is None and T > 2 * cfg.tau + 4)
-    stats = _psum_stats(obs_stats(Y_s, p_s.Lam, p_s.R, mask=mask_s))
+    stats_loc = obs_stats(Y_s, p_s.Lam, p_s.R, mask=mask_s)
+    stats = _psum_stats(stats_loc)
     if gate_s is not None and mask_s is None:
         n_real = lax.psum(jnp.sum(gate_s), SERIES_AXIS)
         stats = stats._replace(n=jnp.full_like(stats.n, n_real))
@@ -91,9 +92,21 @@ def _shard_filter_smoother(Y_s, mask_s, p_s: SSMParams,
         xp, Pp, xf, Pf, logdetG = info_scan(stats, p_s.A, p_s.Q,
                                             p_s.mu0, p_s.P0)
         delta = jnp.zeros((), Y_s.dtype)
-    quad_R, U = loglik_terms_local(Y_s, p_s.Lam, p_s.R, xp, mask_s)
+    # Panel pass only for the quadratic; U = b - C x_pred is k-sized and
+    # psums exactly like the residual form (linear in the local stats).
+    # The expanded quadratic is used exactly when the single-device driver
+    # uses it (ss engine active + f64 assembly available) so sharded and
+    # single-device trajectories stay comparable form-for-form.
+    from ..ops.precision import accum_dtype
+    if (use_ss and sumsq_s is not None
+            and accum_dtype(Y_s.dtype) != Y_s.dtype):
+        # Expanded form from the LOCAL stats (every piece is a local series
+        # sum, so the psum'd total equals the global expansion).
+        quad_R = quad_expanded(sumsq_s, 1.0 / p_s.R, stats_loc, xp)
+    else:
+        quad_R, _ = quad_local(Y_s, p_s.Lam, p_s.R, xp, mask_s)
     quad_R = lax.psum(quad_R, SERIES_AXIS)
-    U = lax.psum(U, SERIES_AXIS)
+    U = lax.psum(u_from_stats(stats_loc, xp), SERIES_AXIS)
     kf = FilterResult(xp, Pp, xf, Pf,
                       loglik_from_terms(stats, logdetG, Pf, quad_R, U))
     if not use_ss:
@@ -101,19 +114,28 @@ def _shard_filter_smoother(Y_s, mask_s, p_s: SSMParams,
     return kf, sm, delta
 
 
-def _shard_em_step(Y_s, mask_s, p_s: SSMParams, cfg: EMConfig, gate_s=None):
-    kf, sm, delta = _shard_filter_smoother(Y_s, mask_s, p_s, cfg, gate_s)
-    EffT, cross = moments(sm)
-    S_ff = EffT.sum(0)
-    Lam_s, R_s = mstep_rows(Y_s, mask_s, sm.x_sm, EffT, sm.P_sm, S_ff,
-                            cfg.r_floor)
+def _shard_em_step(Y_s, mask_s, p_s: SSMParams, cfg: EMConfig, gate_s=None,
+                   Ysq_s=None, sumsq_s=None):
+    kf, sm, delta = _shard_filter_smoother(Y_s, mask_s, p_s, cfg, gate_s,
+                                           sumsq_s=sumsq_s)
+    if mask_s is None:
+        S_ff, S_lag, S_cur, S_cross = moment_sums(sm)
+        Lam_s, R_s = mstep_rows(Y_s, None, sm.x_sm, None, None, S_ff,
+                                cfg.r_floor, Ysq=Ysq_s)
+        A, Q, mu0, P0 = mstep_dynamics_sums(sm, S_lag, S_cur, S_cross,
+                                            p_s, cfg)
+    else:
+        EffT, cross = moments(sm)
+        S_ff = EffT.sum(0)
+        Lam_s, R_s = mstep_rows(Y_s, mask_s, sm.x_sm, EffT, sm.P_sm, S_ff,
+                                cfg.r_floor)
+        A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p_s, cfg)
     if gate_s is not None and mask_s is None:
         # Keep the pads at their neutral (Lam=0, R=1): the unmasked M-step
         # would otherwise drive a pad's R to r_floor (its residual is 0),
         # poisoning ldR = sum log R in the next iteration's loglik.
         Lam_s = gate_s[:, None] * Lam_s
         R_s = jnp.where(gate_s > 0, R_s, jnp.ones_like(R_s))
-    A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p_s, cfg)
     return SSMParams(Lam_s, A, Q, R_s, mu0, P0), kf.loglik, delta
 
 
@@ -126,9 +148,11 @@ def _param_specs():
 def _sharded_em_step_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
                           cfg: EMConfig, has_mask: bool, has_gate: bool):
     def body(Y_s, mask_s, gate_s, p_s):
+        sumsq_s = None if has_mask else Y_s * Y_s
+        Ysq_s = None if has_mask else jnp.sum(sumsq_s, axis=0)
         p_new, ll, delta = _shard_em_step(
             Y_s, mask_s if has_mask else None, p_s, cfg,
-            gate_s if has_gate else None)
+            gate_s if has_gate else None, Ysq_s, sumsq_s)
         return p_new, ll, delta
 
     mapped = jax.shard_map(
@@ -158,9 +182,13 @@ def _sharded_em_scan_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
     def body(Y_s, mask_s, gate_s, p_s):
         m = mask_s if has_mask else None
         g = gate_s if has_gate else None
+        # Iteration-invariant panel passes, hoisted out of the fused loop.
+        sumsq_s = None if has_mask else Y_s * Y_s
+        Ysq_s = None if has_mask else jnp.sum(sumsq_s, axis=0)
 
         def it(p_c, _):
-            p_new, ll, delta = _shard_em_step(Y_s, m, p_c, cfg, g)
+            p_new, ll, delta = _shard_em_step(Y_s, m, p_c, cfg, g, Ysq_s,
+                                              sumsq_s)
             return p_new, (ll, delta)
 
         p_f, (lls, deltas) = lax.scan(it, p_s, None, length=n_iters)
@@ -177,6 +205,42 @@ def _sharded_em_scan_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
     if gate is None:
         gate = jnp.ones((Y.shape[1],), Y.dtype)
     return mapped(Y, mask, gate, p)
+
+
+@partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask", "has_gate"))
+def _sharded_em_step_checked_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
+                                  cfg: EMConfig, has_mask: bool,
+                                  has_gate: bool):
+    """Debug-mode sharded EM step: checkify float checks AROUND the
+    shard_map program (composes — a poisoned shard raises a located error
+    through the psum; tested on the fake mesh).  See ``EMConfig.debug``."""
+    from jax.experimental import checkify
+
+    def f(Y, mask, gate, p):
+        return _sharded_em_step_impl(Y, mask, gate, p, mesh, cfg,
+                                     has_mask, has_gate)
+
+    return checkify.checkify(f, errors=checkify.float_checks)(
+        Y, mask, gate, p)
+
+
+@partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask", "has_gate",
+                                   "n_iters"))
+def _sharded_em_scan_checked_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
+                                  cfg: EMConfig, has_mask: bool,
+                                  has_gate: bool, n_iters: int):
+    """Debug-mode fused sharded chunk: the checkify error state threads
+    through the iteration scan, so the raised error locates the first bad
+    op across ALL fused iterations (sharded analog of
+    ``estim.em._em_fit_scan_checked_impl``)."""
+    from jax.experimental import checkify
+
+    def f(Y, mask, gate, p):
+        return _sharded_em_scan_impl(Y, mask, gate, p, mesh, cfg,
+                                     has_mask, has_gate, n_iters)
+
+    return checkify.checkify(f, errors=checkify.float_checks)(
+        Y, mask, gate, p)
 
 
 @partial(jax.jit, static_argnames=("mesh", "has_mask", "has_gate"))
@@ -239,21 +303,34 @@ class ShardedEM:
             mu0=jnp.asarray(p0.mu0, dtype), P0=jnp.asarray(p0.P0, dtype))
 
     def step(self):
-        """One EM iteration; returns loglik at the entering params."""
-        self.p, ll, self.last_delta = _sharded_em_step_impl(
-            self.Y, self.mask, self.gate, self.p, self.mesh, self.cfg,
-            self.has_mask, self.has_gate)
+        """One EM iteration; returns loglik at the entering params.
+
+        With ``cfg.debug`` the step is checkified (located error on the
+        first NaN/inf any primitive produces, shard_map included)."""
+        args = (self.Y, self.mask, self.gate, self.p, self.mesh, self.cfg,
+                self.has_mask, self.has_gate)
+        if self.cfg.debug:
+            err, out = _sharded_em_step_checked_impl(*args)
+            err.throw()
+            self.p, ll, self.last_delta = out
+            return ll
+        self.p, ll, self.last_delta = _sharded_em_step_impl(*args)
         return ll
 
     def run_scan(self, p: SSMParams, n_iters: int):
         """n fused EM iterations from ``p`` (does NOT update ``self.p``).
 
         Returns (params, logliks (n,), ss_deltas (n,)) — the sharded analog
-        of ``estim.em.em_fit_scan``, one XLA dispatch total.
+        of ``estim.em.em_fit_scan``, one XLA dispatch total.  With
+        ``cfg.debug`` the whole fused chunk is checkified.
         """
-        return _sharded_em_scan_impl(self.Y, self.mask, self.gate, p,
-                                     self.mesh, self.cfg, self.has_mask,
-                                     self.has_gate, n_iters)
+        args = (self.Y, self.mask, self.gate, p, self.mesh, self.cfg,
+                self.has_mask, self.has_gate, n_iters)
+        if self.cfg.debug:
+            err, out = _sharded_em_scan_checked_impl(*args)
+            err.throw()
+            return out
+        return _sharded_em_scan_impl(*args)
 
     def smooth(self):
         x_sm, P_sm, ll = _sharded_smooth_impl(

@@ -45,8 +45,9 @@ from .params import SSMParams, FilterResult, SmootherResult
 from .kalman import rts_smoother
 
 __all__ = ["ObsStats", "obs_stats", "info_scan", "loglik_terms_local",
-           "loglik_from_terms", "info_filter_from_stats", "info_filter",
-           "info_filter_smoother", "loglik_eval"]
+           "quad_local", "u_from_stats", "loglik_from_terms",
+           "info_filter_from_stats", "info_filter", "info_filter_smoother",
+           "loglik_eval"]
 
 _LOG2PI = 1.8378770664093453
 
@@ -150,15 +151,71 @@ def loglik_terms_local(Y: jax.Array, Lam: jax.Array, R: jax.Array,
     stays f32, only the (T, N) -> (T,) reduction upgrades.  U has random
     signs (no amplification) and stays on the f32 MXU path.
     """
+    quad_R, V = quad_local(Y, Lam, R, x_pred, mask)
+    U = (V / R[None, :]) @ Lam
+    return quad_R, U
+
+
+def quad_local(Y: jax.Array, Lam: jax.Array, R: jax.Array,
+               x_pred: jax.Array, mask: Optional[jax.Array]):
+    """The quad_R half of ``loglik_terms_local`` (returns (quad_R, V)).
+
+    Callers holding the observation stats get U for free as
+    ``U_t = b_t - C_t x_pred,t`` (``u_from_stats`` — exactly the innovation
+    information vector the filter update uses, a k-sized computation), so
+    only the quadratic needs a panel pass: one (T,N)x(N,k) matmul with the
+    square-and-reduce fused into its epilogue.  Unlike the fully-expanded
+    quadratic (c2 - 2 x'b + x'Cx, catastrophic in f32 — module docstring),
+    b and C x_pred are SAME-magnitude sums over series with no blow-up
+    (both are Lam' R^{-1}-weighted panel reductions; measured headline-
+    shape f32 loglik noise is unchanged at ~1e-5, bench.py's fast check).
+    """
     V = Y - x_pred @ Lam.T
     if mask is not None:
         V = mask.astype(Y.dtype) * jnp.nan_to_num(V)
-    VR = V / R[None, :]
     from ..ops.precision import accum_dtype
     acc = accum_dtype(Y.dtype)
-    quad_R = jnp.sum((V * VR).astype(acc), axis=1)
-    U = VR @ Lam
-    return quad_R, U
+    quad_R = jnp.sum((V * (V / R[None, :])).astype(acc), axis=1)
+    return quad_R, V
+
+
+def u_from_stats(stats: ObsStats, x_pred: jax.Array) -> jax.Array:
+    """U (T, k) = Lam'R^{-1}v = b_t - C_t x_pred,t from the (already
+    reduced) observation stats — no panel pass.  With per-shard stats this
+    is the LOCAL U (psum-able: the map is linear in (b, C))."""
+    if stats.C.ndim == 2:
+        return stats.b - x_pred @ stats.C          # C symmetric
+    return stats.b - jnp.einsum("tkl,tl->tk", stats.C, x_pred)
+
+
+def quad_expanded(sumsq: jax.Array, Rinv: jax.Array, stats: ObsStats,
+                  x_pred: jax.Array):
+    """v'R^{-1}v per step WITHOUT a residual panel pass (unmasked only).
+
+    Expands v'R^{-1}v = sum_i y^2/R - 2 x_p.b + x_p'C x_p with ``sumsq`` a
+    PRECOMPUTED (T, N) array of y^2 (data-constant: fused EM drivers hoist
+    it out of the iteration loop), so the per-iteration panel traffic is
+    one (T,N)x(N,) matvec instead of the residual form's (T,N)x(N,k)
+    matmul + subtract + reduce.
+
+    Numerics: the naive f32 version of this expansion was measured at
+    ~1e-3 relative loglik error (module docstring) because the ~2x-larger
+    pieces cancel in f32.  Here the three (T,)-sized pieces are assembled
+    in the f64 accum dtype, and each piece's own f32 rounding is the same
+    ~eps * piece noise every other loglik piece already carries — callers
+    must only use this when ``accum_dtype`` actually upgrades (x64 on; the
+    drivers check).  The contract-grade evaluator (``loglik_eval``) never
+    uses this path.
+    """
+    from ..ops.precision import accum_dtype
+    acc = accum_dtype(sumsq.dtype)
+    c2 = (sumsq @ Rinv).astype(acc)                    # sum_i y^2/R, (T,)
+    xb = jnp.einsum("tk,tk->t", x_pred, stats.b).astype(acc)
+    if stats.C.ndim == 2:
+        xCx = jnp.einsum("tk,kl,tl->t", x_pred, stats.C, x_pred)
+    else:
+        xCx = jnp.einsum("tk,tkl,tl->t", x_pred, stats.C, x_pred)
+    return c2 - 2.0 * xb + xCx.astype(acc)
 
 
 def loglik_from_terms(stats: ObsStats, logdetG, P_filt, quad_R, U):
@@ -174,8 +231,13 @@ def loglik_from_terms(stats: ObsStats, logdetG, P_filt, quad_R, U):
     """
     from ..ops.precision import accum_dtype
     acc = accum_dtype(stats.b.dtype)
-    quad = quad_R.astype(acc) - jnp.einsum(
-        "tk,tkl,tl->t", U.astype(acc), P_filt.astype(acc), U.astype(acc))
+    # The U'P_f U einsum stays in the COMPUTE dtype (on TPUs f64 is
+    # emulated, and this (T,k,k) contraction would pay ~10x for rounding
+    # that is already ~eps * piece — the same noise every piece carries);
+    # only the (T,)-sized assembly of the cancelling pieces upgrades.
+    upu = jnp.einsum("tk,tkl,tl->t", U.astype(P_filt.dtype), P_filt,
+                     U.astype(P_filt.dtype))
+    quad = quad_R.astype(acc) - upu.astype(acc)
     lls = -0.5 * (stats.n.astype(acc) * _LOG2PI + stats.ldR.astype(acc)
                   + logdetG.astype(acc) + quad)
     return jnp.sum(lls)
@@ -184,11 +246,11 @@ def loglik_from_terms(stats: ObsStats, logdetG, P_filt, quad_R, U):
 def info_filter_from_stats(stats: ObsStats, A, Q, mu0, P0, Y=None, Lam=None,
                            R=None, mask=None) -> FilterResult:
     """Scan + loglik in one call (single-device; Y/Lam/R for the residual
-    pass).  Sharded callers instead compose info_scan + loglik_terms_local +
-    psum + loglik_from_terms (see ``parallel.sharded``)."""
+    pass).  Sharded callers instead compose info_scan + quad_local/
+    u_from_stats + psum + loglik_from_terms (see ``parallel.sharded``)."""
     xp, Pp, xf, Pf, logdetG = info_scan(stats, A, Q, mu0, P0)
-    quad_R, U = loglik_terms_local(Y, Lam, R, xp, mask)
-    ll = loglik_from_terms(stats, logdetG, Pf, quad_R, U)
+    quad_R, _ = quad_local(Y, Lam, R, xp, mask)
+    ll = loglik_from_terms(stats, logdetG, Pf, quad_R, u_from_stats(stats, xp))
     return FilterResult(xp, Pp, xf, Pf, ll)
 
 

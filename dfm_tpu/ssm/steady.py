@@ -41,8 +41,8 @@ from jax import lax
 
 from ..ops.linalg import sym, psd_cholesky, chol_solve, chol_logdet
 from ..ops.scan import blocked_scan
-from .info_filter import (obs_stats, info_filter, loglik_terms_local,
-                          loglik_from_terms)
+from .info_filter import (obs_stats, info_filter, loglik_from_terms,
+                          quad_expanded, quad_local, u_from_stats)
 from .kalman import rts_smoother
 from .params import SSMParams, FilterResult, SmootherResult
 
@@ -102,13 +102,14 @@ def _cov_path(C, A, Q, P0, tau, dtype):
     logdetG) stacked plus a convergence diagnostic."""
     k = A.shape[0]
     I_k = jnp.eye(k, dtype=dtype)
+    CA = C @ A       # loop-invariant: M = (I - P_f C) A = A - P_f (C A)
 
     def step(P, _):
         Lp = psd_cholesky(P)
         G = I_k + Lp.T @ (C @ Lp)
         Lg = psd_cholesky(G, jitter=0.0)
         P_f = sym(Lp @ chol_solve(Lg, Lp.T))
-        M = (I_k - P_f @ C) @ A
+        M = A - P_f @ CA
         P_next = sym(A @ P_f @ A.T + Q)
         return P_next, (P, P_f, M, chol_logdet(Lg))
 
@@ -132,8 +133,8 @@ def ss_from_stats(stats, p: SSMParams, T: int, tau: int):
     psum'd under sharding — see ``parallel.sharded``), so every device runs it
     identically.  Returns (x_pred, P_pred, x_filt, P_filt, logdetG, sm,
     delta); the innovation-quadratic loglik pieces are NOT computed here —
-    callers run ``loglik_terms_local`` on their (local) panel block and
-    assemble with ``loglik_from_terms``.
+    callers run ``quad_local`` on their (local) panel block, take U from
+    ``u_from_stats``, and assemble with ``loglik_from_terms``.
     """
     dtype = stats.b.dtype
     k = p.A.shape[0]
@@ -211,13 +212,19 @@ def ss_from_stats(stats, p: SSMParams, T: int, tau: int):
 
 
 def ss_filter_smoother(Y: jax.Array, p: SSMParams, tau: int = DEFAULT_TAU,
-                       mask: Optional[jax.Array] = None
+                       mask: Optional[jax.Array] = None, sumsq=None
                        ) -> Tuple[FilterResult, SmootherResult, jax.Array]:
     """Filter + smoother with steady-state acceleration.
 
     Returns (FilterResult, SmootherResult, convergence_diagnostic).  Falls
     back to the exact sequential pair when masked or T <= 2 tau + 4 (the
     diagnostic is then 0).
+
+    ``sumsq``: optional precomputed Y*Y (T, N) — data-constant, so fused EM
+    drivers hoist it out of the iteration loop.  When provided AND the
+    accum dtype upgrades (x64 on), the loglik quadratic uses the expanded
+    form (one matvec over ``sumsq`` instead of a residual matmul pass — see
+    ``info_filter.quad_expanded`` for why this needs the f64 assembly).
     """
     T = Y.shape[0]
     if mask is not None or T <= 2 * tau + 4:
@@ -228,8 +235,13 @@ def ss_filter_smoother(Y: jax.Array, p: SSMParams, tau: int = DEFAULT_TAU,
     stats = obs_stats(Y, p.Lam, p.R)         # C static, b (T, k)
     x_pred, P_pred, x_filt, P_filt, logdetG, sm, delta = ss_from_stats(
         stats, p, T, tau)
-    quad_R, U = loglik_terms_local(Y, p.Lam, p.R, x_pred, None)
-    ll = loglik_from_terms(stats, logdetG, P_filt, quad_R, U)
+    from ..ops.precision import accum_dtype
+    if sumsq is not None and accum_dtype(Y.dtype) != Y.dtype:
+        quad_R = quad_expanded(sumsq, 1.0 / p.R, stats, x_pred)
+    else:
+        quad_R, _ = quad_local(Y, p.Lam, p.R, x_pred, None)
+    ll = loglik_from_terms(stats, logdetG, P_filt, quad_R,
+                           u_from_stats(stats, x_pred))
     return FilterResult(x_pred, P_pred, x_filt, P_filt, ll), sm, delta
 
 

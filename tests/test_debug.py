@@ -82,3 +82,36 @@ def test_fit_debug_does_not_stick_to_user_backend(panel):
     assert b.debug is False
     fit(model, Yz, backend=b, max_iters=2, debug=True)
     assert b.debug is False
+
+
+def test_sharded_debug_raises_located_error(panel):
+    """ShardedBackend(debug=True): checkify composes with shard_map — a
+    poisoned sharded fit raises a located error on the fake mesh, both in
+    the fused-chunk and per-iteration drivers (VERDICT r4 item 7)."""
+    from dfm_tpu.api import ShardedBackend
+    Yz, p0 = panel
+    model = DynamicFactorModel(n_factors=2)
+    bad = p0.copy()
+    bad.R = -np.abs(bad.R)          # log R = NaN inside the loglik pieces
+    for chunk in (8, 1):
+        b = ShardedBackend(dtype=jnp.float64, n_devices=8,
+                           fused_chunk=chunk, debug=True)
+        with pytest.raises(Exception, match="(?i)nan"):
+            fit(model, Yz, backend=b, init=bad, max_iters=3, tol=0.0)
+    # same poisoned fit without debug: sails through to a garbage loglik
+    r = fit(model, Yz, init=bad, max_iters=3, tol=0.0,
+            backend=ShardedBackend(dtype=jnp.float64, n_devices=8))
+    assert not np.isfinite(r.loglik)
+
+
+def test_sharded_debug_clean_fit_matches_unchecked(panel):
+    """Clean inputs pass the checked sharded path unharmed and unchanged."""
+    from dfm_tpu.api import ShardedBackend
+    Yz, p0 = panel
+    model = DynamicFactorModel(n_factors=2)
+    r_dbg = fit(model, Yz, init=p0, max_iters=3, tol=0.0,
+                backend=ShardedBackend(dtype=jnp.float64, n_devices=8,
+                                       debug=True))
+    r_ref = fit(model, Yz, init=p0, max_iters=3, tol=0.0,
+                backend=ShardedBackend(dtype=jnp.float64, n_devices=8))
+    np.testing.assert_allclose(r_dbg.logliks, r_ref.logliks, rtol=1e-12)

@@ -173,8 +173,11 @@ def test_sharded_ss_active_with_padding(panel):
     _, lls_d, deltas_d = em_fit_scan(jnp.asarray(Yz),
                                      JP.from_numpy(p0, jnp.float64),
                                      4, cfg=cfg)
+    # The diagnostic itself sits at the f64 noise floor (~3e-12 here): the
+    # padded shard sums in a different order than the single device, so an
+    # absolute floor at relative-rounding scale is needed alongside rtol.
     np.testing.assert_allclose(np.asarray(deltas), np.asarray(deltas_d),
-                               rtol=1e-9)
+                               rtol=1e-9, atol=1e-15)
     np.testing.assert_allclose(np.asarray(lls_s), np.asarray(lls_d),
                                rtol=1e-9)
 
@@ -189,3 +192,29 @@ def test_sharded_ss_fit_api(panel):
     # T=70 < 2*96+4 -> ss falls back to the exact path here; equality is
     # exact.  The true ss path is covered by the tau=24 scan test above.
     np.testing.assert_allclose(r_ss.logliks, r_info.logliks, rtol=1e-9)
+
+
+def test_sharded_f32_expanded_quad_loglik(panel):
+    """f32 + ss: the sharded loglik quadratic takes the EXPANDED form
+    (f64-assembled; dead code in the suite's f64 runs, so this f32 case is
+    its only fake-mesh coverage — code-review r5).  Pin it against the f32
+    single-device ss path (same form; tight) AND the f64 NumPy oracle
+    chain (noise-floor tolerance)."""
+    from dfm_tpu.estim.em import em_fit_scan
+    from dfm_tpu.parallel.sharded import ShardedEM
+    Yz, p0 = panel
+    cfg = EMConfig(filter="ss", tau=8)
+    drv = ShardedEM(Yz, p0, mesh=make_mesh(7), dtype=jnp.float32, cfg=cfg)
+    _, lls_s, _ = drv.run_scan(drv.p, 4)
+    _, lls_1, _ = em_fit_scan(jnp.asarray(Yz, jnp.float32),
+                              JP.from_numpy(p0, jnp.float32), 4, cfg=cfg)
+    floor = 200 * np.finfo(np.float32).eps * Yz.size
+    np.testing.assert_allclose(np.asarray(lls_s), np.asarray(lls_1),
+                               atol=floor, rtol=1e-5)
+    p = p0.copy()
+    lls_np = []
+    for _ in range(4):
+        p, ll, _ = cpu_ref.em_step(Yz, p, filter="info")
+        lls_np.append(ll)
+    np.testing.assert_allclose(np.asarray(lls_s, np.float64), lls_np,
+                               atol=floor, rtol=1e-4)
