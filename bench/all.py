@@ -53,7 +53,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default="BENCH_ALL.json")
     ap.add_argument("--configs",
-                    default="s1,s2,s3,s4,s5,s5@sharded,headline",
+                    default="s1,s2,s3,s4,s5,s3@sharded,s4@sharded,"
+                            "s5@sharded,headline",
                     help="comma list; a 'name@backend' entry runs that "
                          "config on a non-default backend (no CPU rerun)")
     ap.add_argument("--no-cpu", action="store_true",

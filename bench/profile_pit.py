@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Settle the parallel-in-time filter's promised win (VERDICT r4 item 8).
+
+Times one fused log-likelihood evaluation (filter only) for the
+sequential info-form scan vs the associative-scan PIT filter vs the
+steady-state engine, across T, at small N/k (the long-context regime the
+PIT filter exists for).  Run on the current device:
+
+    python -m bench.profile_pit                 # real TPU
+    JAX_PLATFORMS='' python -m bench.profile_pit --cpu   # multi-core CPU
+
+(--cpu forces the multithreaded XLA CPU backend in-process; the
+sequential scan cannot use extra cores, the PIT combines can.)
+"""
+
+import argparse
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--N", type=int, default=32)
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--Ts", default="2048,8192,32768")
+    args = ap.parse_args(argv)
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from dfm_tpu.backends import cpu_ref
+    from dfm_tpu.utils import dgp
+    from dfm_tpu.ssm.info_filter import info_filter
+    from dfm_tpu.ssm.parallel_filter import pit_filter
+    from dfm_tpu.ssm.steady import ss_filter
+    from dfm_tpu.ssm.params import SSMParams as JP
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+    dtype = jnp.float32 if dev.platform == "tpu" else jnp.float64
+
+    rng = np.random.default_rng(0)
+    N, k = args.N, args.k
+    p_true = dgp.dfm_params(N, k, rng)
+
+    @partial(jax.jit, static_argnames=("which",))
+    def ll(Y, p, which):
+        f = {"info": info_filter, "pit": pit_filter,
+             "ss": partial(ss_filter, tau=16)}[which]
+        return f(Y, p).loglik
+
+    def timed(Y, p, which):
+        np.asarray(ll(Y, p, which))
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(ll(Y, p, which))
+            reps.append(time.perf_counter() - t0)
+        return min(reps)
+
+    print(f"{'T':>7s} {'info ms':>9s} {'pit ms':>9s} {'ss ms':>9s} "
+          f"{'pit speedup':>12s}")
+    with jax.default_matmul_precision("highest"):
+        for T in (int(t) for t in args.Ts.split(",")):
+            Y, _ = dgp.simulate(p_true, T, rng)
+            Y = (Y - Y.mean(0)) / Y.std(0)
+            Yj = jnp.asarray(Y, dtype)
+            pj = JP.from_numpy(cpu_ref.pca_init(Y, k), dtype=dtype)
+            ti = timed(Yj, pj, "info")
+            tp = timed(Yj, pj, "pit")
+            ts = timed(Yj, pj, "ss")
+            print(f"{T:7d} {ti * 1e3:9.1f} {tp * 1e3:9.1f} {ts * 1e3:9.1f} "
+                  f"{ti / tp:11.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -250,27 +250,42 @@ def main(argv=None):
             print(json.dumps(rec), file=sys.stderr)
 
     extra = {}
+    sharded = args.backend == "sharded"
     t0 = time.perf_counter()
     if cfg.kind == "mixed_freq":
         from dfm_tpu.models.mixed_freq import MixedFreqSpec, mf_fit
         spec = MixedFreqSpec(n_monthly=cfg.N - cfg.n_quarterly,
                              n_quarterly=cfg.n_quarterly, n_factors=cfg.k)
-        res = mf_fit(Y, spec, mask=mask, max_iters=iters, tol=args.tol,
+        if sharded:
+            from functools import partial
+            from dfm_tpu.parallel.mesh import make_mesh
+            from dfm_tpu.parallel.sharded_mf import sharded_mf_fit
+            fit_fn = partial(sharded_mf_fit, mesh=make_mesh())
+        else:
+            fit_fn = mf_fit
+        res = fit_fn(Y, spec, mask=mask, max_iters=iters, tol=args.tol,
                      callback=cb)
         wall_cold = time.perf_counter() - t0
         t0 = time.perf_counter()
-        mf_fit(Y, spec, mask=mask, max_iters=iters, tol=args.tol)
+        fit_fn(Y, spec, mask=mask, max_iters=iters, tol=args.tol)
         wall_warm = time.perf_counter() - t0
-        res_backend = "tpu"
+        res_backend = "sharded" if sharded else "tpu"
     elif cfg.kind == "tvl":
         from dfm_tpu.models.tv_loadings import TVLSpec, tvl_fit
         tvl_spec = TVLSpec(n_factors=cfg.k, n_rounds=iters, tol=args.tol)
-        res = tvl_fit(Y, tvl_spec, mask=mask, callback=cb)
+        if sharded:
+            from functools import partial
+            from dfm_tpu.parallel.mesh import make_mesh
+            from dfm_tpu.parallel.sharded_tvl import sharded_tvl_fit
+            fit_fn = partial(sharded_tvl_fit, mesh=make_mesh())
+        else:
+            fit_fn = tvl_fit
+        res = fit_fn(Y, tvl_spec, mask=mask, callback=cb)
         wall_cold = time.perf_counter() - t0
         t0 = time.perf_counter()
-        tvl_fit(Y, tvl_spec, mask=mask)
+        fit_fn(Y, tvl_spec, mask=mask)
         wall_warm = time.perf_counter() - t0
-        res_backend = "tpu"
+        res_backend = "sharded" if sharded else "tpu"
     elif cfg.kind == "sv":
         res, wall_cold, pass_secs = _run_sv(cfg, Y, iters, args.backend, cb)
         wall_warm = None

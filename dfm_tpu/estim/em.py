@@ -458,8 +458,7 @@ def em_fit(Y, p0: SSMParams, mask=None, cfg: EMConfig = EMConfig(),
     return p, jnp.asarray(lls), converged, p_iters
 
 
-@partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
-def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
+def _em_scan_core(Y, mask, p0, cfg, has_mask, n_iters):
     m = mask if has_mask else None
     # Iteration-invariant panel passes hoisted out of the fused loop.
     sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
@@ -469,7 +468,12 @@ def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
         return _m_step(Y, m, sm, p, cfg, Ysq=Ysq), (kf.loglik, delta)
 
     p, (lls, deltas) = jax.lax.scan(body, p0, None, length=n_iters)
-    return p, lls, deltas
+    return p, lls, deltas, sumsq
+
+
+@partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
+def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
+    return _em_scan_core(Y, mask, p0, cfg, has_mask, n_iters)[:3]
 
 
 @partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))

@@ -274,7 +274,10 @@ class ShardedEM:
 
     def __init__(self, Y: np.ndarray, p0, mask: Optional[np.ndarray] = None,
                  mesh: Optional[Mesh] = None, dtype=jnp.float32,
-                 cfg: EMConfig = EMConfig()):
+                 cfg: EMConfig = EMConfig(), Y_dev=None):
+        """``Y_dev``: an already-on-device copy of ``Y`` (e.g. the
+        device-init panel cache) — reused instead of a fresh host->device
+        transfer when no padding or mask forces a host-side rewrite."""
         self.mesh = mesh if mesh is not None else make_mesh()
         n_shards = self.mesh.devices.size
         Lam0 = np.asarray(p0.Lam)
@@ -292,7 +295,12 @@ class ShardedEM:
         if cfg.filter != "ss":
             cfg = dataclasses.replace(cfg, filter="info")
         self.cfg = cfg
-        self.Y = jnp.asarray(Yp, dtype)
+        if (Y_dev is not None and self.n_pad == 0 and mask is None
+                and Y_dev.dtype == jnp.dtype(dtype)
+                and Y_dev.shape == Yp.shape):
+            self.Y = Y_dev
+        else:
+            self.Y = jnp.asarray(Yp, dtype)
         self.mask = jnp.asarray(Wp, dtype) if self.has_mask else None
         self.gate = (jnp.asarray(
             np.concatenate([np.ones(Y.shape[1]), np.zeros(self.n_pad)]),
@@ -381,11 +389,13 @@ def sharded_filter_smoother(Y, p, mask=None, mesh=None):
 
 def sharded_em_fit(Y, p0, mask=None, mesh=None, cfg: EMConfig = EMConfig(),
                    max_iters: int = 50, tol: float = 1e-6, dtype=jnp.float32,
-                   callback=None):
+                   callback=None, Y_dev=None):
     """EM driver over the mesh; mirrors ``estim.em.em_fit``'s contract,
     including the callback receiving the (unpadded) params the loglik was
-    evaluated at.  Returns (params, logliks, converged, driver)."""
-    drv = ShardedEM(Y, p0, mask=mask, mesh=mesh, dtype=dtype, cfg=cfg)
+    evaluated at.  Returns (params, logliks, converged, driver).
+    ``Y_dev``: see ``ShardedEM``."""
+    drv = ShardedEM(Y, p0, mask=mask, mesh=mesh, dtype=dtype, cfg=cfg,
+                    Y_dev=Y_dev)
 
     entering = prev_entering = drv.p
     max_delta = 0.0

@@ -218,3 +218,27 @@ def test_sharded_f32_expanded_quad_loglik(panel):
         lls_np.append(ll)
     np.testing.assert_allclose(np.asarray(lls_s, np.float64), lls_np,
                                atol=floor, rtol=1e-4)
+
+
+def test_sharded_y_dev_reuse_equivalence(panel):
+    """ShardedEM(Y_dev=...) panel reuse: identical trajectory when the
+    gates allow reuse, and every gate (padding, dtype, mask) rejects a
+    panel that would need a host-side rewrite (code-review r5)."""
+    from dfm_tpu.parallel.sharded import ShardedEM
+    Yz, p0 = panel
+    Yj = jnp.asarray(Yz, jnp.float64)
+    drv_a = ShardedEM(Yz, p0, mesh=make_mesh(8), dtype=jnp.float64,
+                      Y_dev=Yj)
+    assert drv_a.Y is Yj                 # N=48 divides 8: reused
+    drv_b = ShardedEM(Yz, p0, mesh=make_mesh(8), dtype=jnp.float64)
+    _, lls_a, _ = drv_a.run_scan(drv_a.p, 3)
+    _, lls_b, _ = drv_b.run_scan(drv_b.p, 3)
+    np.testing.assert_allclose(np.asarray(lls_a), np.asarray(lls_b),
+                               rtol=1e-14)
+    assert ShardedEM(Yz, p0, mesh=make_mesh(5), dtype=jnp.float64,
+                     Y_dev=Yj).Y is not Yj          # padding rejects
+    assert ShardedEM(Yz, p0, mesh=make_mesh(8), dtype=jnp.float32,
+                     Y_dev=Yj).Y is not Yj          # dtype rejects
+    W = dgp.random_mask(*Yz.shape, np.random.default_rng(5), 0.1)
+    assert ShardedEM(Yz, p0, mask=W, mesh=make_mesh(8), dtype=jnp.float64,
+                     Y_dev=Yj).Y is not Yj          # mask rejects
