@@ -93,6 +93,14 @@ def main(argv=None):
             r_tpu, r_cpu = _rate(results[name]), _rate(cpu)
             if r_tpu and r_cpu:
                 results[name]["vs_cpu"] = round(r_tpu / r_cpu, 2)
+            # Same-basis marginal-rate comparison (dispatch/init-free on
+            # both sides — what BASELINE.json:2/5 actually define; the
+            # end-to-end vs_cpu above is fixed-cost-bound at these short
+            # fit lengths on BOTH device classes, see docs/PERF.md).
+            s_tpu = results[name].get("em_iters_per_sec_sustained")
+            s_cpu = cpu.get("em_iters_per_sec_sustained")
+            if s_tpu and s_cpu:
+                results[name]["vs_cpu_sustained"] = round(s_tpu / s_cpu, 2)
         except Exception as e:
             results[name]["cpu"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"{name} cpu baseline FAILED: {e}", file=sys.stderr,
@@ -107,8 +115,9 @@ def main(argv=None):
         json.dump(out, f, indent=1)
     print(json.dumps({k: {kk: vv for kk, vv in v.items()
                           if kk in ("em_iters_per_sec",
+                                    "em_iters_per_sec_sustained",
                                     "sv_filter_passes_per_sec", "loglik",
-                                    "vs_cpu", "error")}
+                                    "vs_cpu", "vs_cpu_sustained", "error")}
                       for k, v in results.items()}))
     print(f"wrote {args.out}", file=sys.stderr)
 

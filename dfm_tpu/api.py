@@ -146,7 +146,11 @@ class TPUBackend(Backend):
 
     filter: "dense" (N x N innovation covariance), "info" (information form —
     k x k scan, N enters only through matmul reductions; the scalable path),
-    or "auto" (info for N >= 32).  Both agree to fp tolerance (tested).
+    "ss" (steady-state accelerated), "pit" (parallel-in-time), or "auto":
+    dense below N=32, info from there, ss for unmasked panels at N >= 512
+    (benchmark scale — ~5-30x faster in-loop, trajectory contract-checked;
+    masked panels stay on the exact info scan).  All agree to fp tolerance
+    (tested).
 
     matmul_precision: XLA matmul precision.  TPU MXUs round f32 matmul inputs
     to bf16 at the default setting, which costs ~1e-4 relative log-likelihood
@@ -233,9 +237,48 @@ class TPUBackend(Backend):
         import jax.numpy as jnp
         return jnp.asarray(Y, dt)
 
-    def _filter_for(self, N: int) -> str:
+    def prep_standardize(self, Y, model):
+        """Device-side panel standardization (``estim.init
+        .standardize_device``) for large fully-observed panels, or ``None``
+        when the host path should run (small panel, missing data, or
+        ``device_init`` off — same gate as the device PCA init, since the
+        win is the same: the raw panel transfers once and every N-sized
+        prep pass happens on device instead of in host NumPy).
+
+        Returns ``(Yz_device, Standardizer)``; ``fit`` passes the device
+        array through as the panel, and ``default_init``/``run_em``/
+        ``smooth`` all already accept it (the identity-keyed caches make
+        it zero-copy).  The stats are computed in the compute dtype — at
+        f32 the mean/scale differ from the host f64 transform by ~1e-6
+        relative, which only re-units the standardized problem; small
+        panels keep the host path so cpu==tpu goldens stay exact.
+        """
+        if not model.standardize or not self._use_device_init(Y):
+            return None
+        if not bool(np.isfinite(Y).all()):
+            return None          # missing data: host masked path
+        import jax.numpy as jnp
+        from .estim.init import standardize_device
+        with self._precision_ctx():
+            Yj, stats = standardize_device(jnp.asarray(Y, self._dtype()))
+        stats = np.asarray(stats, np.float64)
+        return Yj, Standardizer(stats[0], stats[1])
+
+    def _filter_for(self, N: int, masked: bool = False) -> str:
         if self.filter == "auto":
-            return "info" if N >= 32 else "dense"
+            if N < 32:
+                return "dense"
+            if not masked and N >= 512:
+                # Steady-state accelerated engine at benchmark scale: the
+                # in-loop iteration is ~5-30x the exact info scan (docs/
+                # PERF.md) and the trajectory meets the 1e-5 contract at
+                # 1e-10 (checked every bench run); run_em picks tau from
+                # the measured Riccati mixing time, the freeze diagnostic
+                # guards it at runtime, and the reporting smooth is exact
+                # info-form regardless.  Small panels keep the exact
+                # engines so cpu==tpu goldens stay bit-tight.
+                return "ss"
+            return "info"
         return self.filter
 
     def _precision_ctx(self):
@@ -258,11 +301,18 @@ class TPUBackend(Backend):
         Yj = self._device_panel(Y, mask, dt)
         mj = jnp.asarray(mask, dt) if mask is not None else None
         pj = JaxParams.from_numpy(p0, dtype=dt)
+        flt = self._filter_for(Y.shape[1], mask is not None)
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
                        estimate_init=model.estimate_init,
-                       filter=self._filter_for(Y.shape[1]),
-                       debug=self.debug)
+                       filter=flt, debug=self.debug)
+        if flt == "ss":
+            # tau from the measured covariance-recursion mixing time at the
+            # init params (k x k on host, microseconds) — the same choice
+            # bench.py makes; the freeze diagnostic warns if EM drifts the
+            # params enough that tau stops covering the mixing time.
+            from .ssm.steady import auto_tau
+            cfg = dataclasses.replace(cfg, tau=auto_tau(p0))
         with self._precision_ctx():
             if self.fused_chunk <= 1:
                 p, lls, converged, p_iters = em_fit(
@@ -347,9 +397,11 @@ class ShardedBackend(TPUBackend):
     (see ``parallel.sharded``).  n_devices=None uses every local device; on a
     single chip this degrades gracefully to a 1-shard mesh.
 
-    filter: "info" (exact information-form scan) or "ss" (steady-state
+    filter: "info" (exact information-form scan), "ss" (steady-state
     accelerated — the single-chip headline path, replicated k x k under
-    sharding; falls back to info on masked panels).  "auto" means "info".
+    sharding; falls back to info on masked panels), or "auto" (ss for
+    unmasked panels at N >= 512, info otherwise — same tiering as
+    ``TPUBackend``).
 
     fused_chunk: as in ``TPUBackend`` — EM iterations fused into one XLA
     program (``lax.scan`` over the shard_map body) between host round-trips,
@@ -364,17 +416,17 @@ class ShardedBackend(TPUBackend):
 
     name = "sharded"
 
-    def __init__(self, dtype=None, n_devices=None, filter: str = "info",
+    def __init__(self, dtype=None, n_devices=None, filter: str = "auto",
                  matmul_precision: str = "highest", fused_chunk: int = 8,
                  debug: bool = False, device_init="auto"):
-        super().__init__(dtype=dtype,
-                         filter="info" if filter == "auto" else filter,
+        super().__init__(dtype=dtype, filter=filter,
                          matmul_precision=matmul_precision,
                          fused_chunk=fused_chunk, debug=debug,
                          device_init=device_init)
-        if self.filter not in ("info", "ss"):
+        if self.filter not in ("auto", "info", "ss"):
             raise ValueError(
-                f"sharded filter must be 'info' or 'ss'; got {filter!r}")
+                f"sharded filter must be 'auto', 'info' or 'ss'; "
+                f"got {filter!r}")
         self.n_devices = n_devices
         self._drv = None          # ShardedEM from the last run_em
         self._drv_params = None   # the numpy params it ended at
@@ -383,6 +435,21 @@ class ShardedBackend(TPUBackend):
     def _mesh(self):
         from .parallel.mesh import make_mesh
         return make_mesh(self.n_devices)
+
+    def prep_standardize(self, Y, model):
+        # Only when the series axis divides the mesh evenly: otherwise
+        # ShardedEM must pad on host, which needs the host panel anyway.
+        if Y.shape[1] % self._mesh().devices.size:
+            return None
+        return super().prep_standardize(Y, model)
+
+    def _filter_for(self, N: int, masked: bool = False) -> str:
+        # Same auto tiering as TPUBackend minus the dense oracle (the
+        # sharded E-steps are info/ss only); ShardedEM itself falls back to
+        # the exact info scan when a mask defeats the ss freeze.
+        if self.filter == "auto":
+            return "ss" if not masked and N >= 512 else "info"
+        return self.filter
 
     @staticmethod
     def _unpad_callback(callback, drv):
@@ -413,10 +480,14 @@ class ShardedBackend(TPUBackend):
         # (parallel.sharded._sharded_em_*_checked_impl) — a poisoned shard
         # raises a LOCATED error through the psum, same contract as the
         # single-device TPUBackend(debug=True).
+        flt = self._filter_for(Y.shape[1], mask is not None)
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
-                       estimate_init=model.estimate_init, filter=self.filter,
+                       estimate_init=model.estimate_init, filter=flt,
                        debug=self.debug)
+        if flt == "ss":
+            from .ssm.steady import auto_tau
+            cfg = dataclasses.replace(cfg, tau=auto_tau(p0))
         # Consume the device-init panel cache up front (one-shot — consuming
         # releases the pinned host+HBM copies even on paths that cannot
         # reuse it); same identity contract as TPUBackend._device_panel.
@@ -540,7 +611,7 @@ def fit(model: DynamicFactorModel,
         means non-finite values the mask logic cannot see, e.g. a bad
         ``init`` or a data bug reintroducing inf after masking.)
     """
-    Y = np.asarray(Y, dtype=np.float64)
+    Y = np.asarray(Y)
     if Y.ndim != 2:
         raise ValueError(f"Y must be (T, N); got shape {Y.shape}")
     T, N = Y.shape
@@ -549,13 +620,33 @@ def fit(model: DynamicFactorModel,
     if T < 2 and model.dynamics == "ar1":
         raise ValueError("ar1 dynamics needs T >= 2 (the M-step divides by T-1)")
 
-    W = build_mask(Y, mask)
-    any_missing = bool((W == 0).any())
+    b = get_backend(backend)
     std: Optional[Standardizer] = None
-    if model.standardize:
-        Y, std = standardize(Y, mask=W if any_missing else None)
-    Wm = W if any_missing else None
-    Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
+    dev_prep = None
+    if mask is None and checkpoint_path is None:
+        # Device-side prep for large fully-observed panels on JAX backends:
+        # the raw panel transfers once and standardization runs on device
+        # (one fused program) instead of ~0.5 s of host NumPy passes at the
+        # 10k x 500 shape.  Checkpointing keeps the host path — the data
+        # fingerprint hashes host bytes.
+        prep = getattr(b, "prep_standardize", None)
+        if prep is not None:
+            dev_prep = prep(Y, model)
+    if dev_prep is not None:
+        Yz, std = dev_prep         # Yz lives on device; Standardizer on host
+        any_missing = False
+        Wm = None
+    else:
+        Y = np.asarray(Y, dtype=np.float64)
+        W = build_mask(Y, mask)
+        any_missing = bool((W == 0).any())
+        if model.standardize:
+            Y, std = standardize(Y, mask=W if any_missing else None)
+        Wm = W if any_missing else None
+        # Fully observed: Y already has no NaNs and the where() would be an
+        # identity — skip the 40 MB copy (panels are never mutated).
+        Yz = (Y if not any_missing
+              else np.where(W > 0, np.nan_to_num(Y), 0.0))
 
     fingerprint = None
     done_iters = 0
@@ -573,7 +664,6 @@ def fit(model: DynamicFactorModel,
             done_iters = ck[1]
         else:
             ck = None
-    b = get_backend(backend)
     if init is None:
         init = b.default_init(Yz, Wm, model)
     # debug only toggles THIS fit: user-supplied backend instances are

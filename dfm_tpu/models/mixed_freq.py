@@ -226,8 +226,24 @@ def mf_loglik_eval(Y, mask, p: MFParams, spec: MixedFreqSpec,
     from ..ssm.info_filter import loglik_eval
     if precise and jax.config.jax_enable_x64:
         p = MFParams(*(jnp.asarray(np.asarray(x), jnp.float64) for x in p))
-    aug = augment(p, spec)
-    return loglik_eval(Y, aug, mask=mask, precise=precise)
+        aug = augment(p, spec)
+        return loglik_eval(Y, aug, mask=mask, precise=True)
+    if precise:
+        import warnings
+        warnings.warn(
+            "precise mf_loglik_eval needs jax_enable_x64; evaluating in "
+            "the compute dtype instead", RuntimeWarning, stacklevel=2)
+    # Fast (compute-dtype) path: evaluate through the fit's OWN E-step
+    # program — ``mf_em_step``'s second return is the loglik at the entry
+    # params, i.e. exactly the in-loop figure whose noise this diagnostic
+    # reports.  A standalone f32 masked ``info_scan`` at the augmented
+    # shape SIGABRTs the axon TPU compiler (fusion-merge check failure,
+    # 2026-07; see ``info_filter._loglik_eval_impl``), while this
+    # fit-shaped program is the one every S3 run already compiles.
+    Yj = jnp.asarray(Y)
+    mj = jnp.asarray(mask, Yj.dtype)
+    _, ll = mf_em_step(Yj, mj, p.astype(Yj.dtype), spec)
+    return float(ll)
 
 
 @partial(jax.jit, static_argnames=("spec",))

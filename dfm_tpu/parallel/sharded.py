@@ -282,8 +282,20 @@ class ShardedEM:
         n_shards = self.mesh.devices.size
         Lam0 = np.asarray(p0.Lam)
         R0 = np.asarray(p0.R)
-        Yp, Wp, Lp, Rp, self.n_pad = pad_panel(
-            np.asarray(Y, np.float64), mask, Lam0, R0, n_shards)
+        # Decide device-copy reuse BEFORE touching Y's values: when the
+        # cached device panel applies (no padding, no mask, right shape and
+        # dtype), Y may itself BE a device array (api.fit's device-side
+        # prep) and np.asarray(Y) would pay a ~0.7 s device->host transfer
+        # just to rebuild what we already hold.
+        use_dev = (Y_dev is not None and mask is None
+                   and (-Y.shape[1]) % n_shards == 0
+                   and Y_dev.dtype == jnp.dtype(dtype)
+                   and Y_dev.shape == Y.shape)
+        if use_dev:
+            Yp, Wp, Lp, Rp, self.n_pad = Y, mask, Lam0, R0, 0
+        else:
+            Yp, Wp, Lp, Rp, self.n_pad = pad_panel(
+                np.asarray(Y, np.float64), mask, Lam0, R0, n_shards)
         # A REAL mask (user-supplied / NaN pattern) selects the masked code
         # paths; mesh-divisibility padding alone does NOT — it is handled by
         # the row gate so unmasked panels keep the cheap time-invariant
@@ -295,12 +307,7 @@ class ShardedEM:
         if cfg.filter != "ss":
             cfg = dataclasses.replace(cfg, filter="info")
         self.cfg = cfg
-        if (Y_dev is not None and self.n_pad == 0 and mask is None
-                and Y_dev.dtype == jnp.dtype(dtype)
-                and Y_dev.shape == Yp.shape):
-            self.Y = Y_dev
-        else:
-            self.Y = jnp.asarray(Yp, dtype)
+        self.Y = Y_dev if use_dev else jnp.asarray(Yp, dtype)
         self.mask = jnp.asarray(Wp, dtype) if self.has_mask else None
         self.gate = (jnp.asarray(
             np.concatenate([np.ones(Y.shape[1]), np.zeros(self.n_pad)]),

@@ -99,9 +99,19 @@ def sharded_sv_filter(Y, p: SSMParams, spec: SVSpec,
     h0s = jnp.asarray(spec.h0_scale, dtype)
 
     R_unpadded = np.asarray(p.R, np.float64)
-    Yp, _, Lp, Rp, _ = pad_panel(np.asarray(Y, np.float64), None,
-                                 np.asarray(p.Lam, np.float64), R_unpadded,
-                                 int(mesh.devices.size))
+    n_pad = (-Y.shape[1]) % int(mesh.devices.size)
+    if n_pad:
+        Yp, _, Lp, Rp, _ = pad_panel(np.asarray(Y, np.float64), None,
+                                     np.asarray(p.Lam, np.float64),
+                                     R_unpadded, int(mesh.devices.size))
+    else:
+        # No padding: consume the caller's arrays as-is.  Repeated filter
+        # passes (particle-EM E-steps, the S5 pass timing) hand a DEVICE-
+        # resident panel here, and the unconditional np.asarray above paid
+        # a device->host->device round trip of the 40 MB panel per call —
+        # measured 2.4 -> 0.31 passes/sec at the S5 shape on a 1-shard
+        # mesh (the whole r4 "sharded SV is slower" artifact).
+        Yp, Lp, Rp = Y, p.Lam, p.R
     # True-f32 matmul products, matching sv_filter (bf16 default distorts
     # the particle weights at large N).
     with jax.default_matmul_precision("highest"):

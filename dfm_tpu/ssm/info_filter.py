@@ -298,6 +298,13 @@ def loglik_eval(Y, p, mask=None, precise: bool = True) -> float:
 
 @partial(jax.jit, static_argnames=("has_mask",))
 def _loglik_eval_impl(Y, p, mask, has_mask):
+    # NOTE: in float32 with a mask at the MF augmented shape (state dim
+    # ~25, time-varying C) this loglik-only program SIGABRTs the axon TPU
+    # compiler (TpuInstructionFusion::MergeFusionInstruction check failure,
+    # 2026-07) — barriers and keeping the scan outputs alive do not dodge
+    # it; the f64 program and the full fit-shaped programs compile fine.
+    # ``models.mixed_freq.mf_loglik_eval`` therefore routes its fast path
+    # through the fit's own E-step program instead of this one.
     return info_filter(Y, p, mask=mask if has_mask else None).loglik
 
 

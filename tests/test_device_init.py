@@ -61,6 +61,75 @@ def test_device_init_masked_panel_cache_hits_and_is_mask_safe(panel):
     assert b._device_panel(Yz, W2, jnp.float64) is not cached
 
 
+@pytest.fixture(scope="module")
+def raw_panel():
+    """UN-standardized panel (nonzero means, heterogeneous scales) so the
+    standardization step actually matters."""
+    rng = np.random.default_rng(23)
+    p = dgp.dfm_params(64, 3, rng)
+    Y, _ = dgp.simulate(p, 90, rng)
+    return Y * np.exp(rng.normal(size=64)) + 10.0 * rng.normal(size=64)
+
+
+def test_device_prep_standardize_matches_host(raw_panel):
+    """fit() with device-side standardization (prep_standardize) reproduces
+    the host-prep fit: same transform, same loglik trajectory.  x64 CPU runs
+    make the device stats near-exact; the residual tolerance is summation
+    order."""
+    from dfm_tpu.utils.data import standardize
+    model = DynamicFactorModel(n_factors=3)
+    r_host = fit(model, raw_panel, backend=TPUBackend(device_init=False),
+                 max_iters=8, tol=0.0)
+    b = TPUBackend(device_init=True)
+    r_dev = fit(model, raw_panel, backend=b, max_iters=8, tol=0.0)
+    _, std_host = standardize(raw_panel)
+    np.testing.assert_allclose(r_dev.standardizer.mean, std_host.mean,
+                               rtol=1e-9)
+    np.testing.assert_allclose(r_dev.standardizer.scale, std_host.scale,
+                               rtol=1e-9)
+    # Host init (SVD) vs device init (Gram eigh) start EM from different
+    # rotations of the same subspace; compare the trajectory through the
+    # rotation-invariant loglik.
+    np.testing.assert_allclose(r_dev.logliks, r_host.logliks,
+                               rtol=1e-6, atol=1e-5)
+
+
+def test_device_prep_skips_missing_data(raw_panel):
+    """A NaN anywhere routes prep to the host masked path: the standardizer
+    must be the HOST masked transform bit-for-bit (prep_standardize never
+    sees the panel)."""
+    from dfm_tpu.utils.data import build_mask, standardize
+    Y = raw_panel.copy()
+    Y[5, 7] = np.nan
+    model = DynamicFactorModel(n_factors=3)
+    r = fit(model, Y, backend=TPUBackend(device_init=True), max_iters=3)
+    W = build_mask(Y)
+    _, std_host = standardize(Y, mask=W)
+    np.testing.assert_array_equal(r.standardizer.mean, std_host.mean)
+    np.testing.assert_array_equal(r.standardizer.scale, std_host.scale)
+    assert np.isfinite(r.loglik)
+
+
+def test_device_prep_sharded(raw_panel):
+    """ShardedBackend device prep (N divisible by the mesh) matches the
+    host-prep sharded fit; a non-divisible N falls back to the host path."""
+    from dfm_tpu.api import ShardedBackend
+    model = DynamicFactorModel(n_factors=3)
+    r_host = fit(model, raw_panel, backend=ShardedBackend(device_init=False),
+                 max_iters=6, tol=0.0)
+    r_dev = fit(model, raw_panel, backend=ShardedBackend(device_init=True),
+                max_iters=6, tol=0.0)
+    np.testing.assert_allclose(r_dev.logliks, r_host.logliks,
+                               rtol=1e-6, atol=1e-5)
+    # 63 series over an 8-device mesh: prep must decline (padding needs the
+    # host panel) and the fit still run end-to-end through the host path.
+    Y63 = np.ascontiguousarray(raw_panel[:, :63])
+    b = ShardedBackend(device_init=True)
+    assert b.prep_standardize(Y63, model) is None
+    r63 = fit(model, Y63, backend=b, max_iters=3)
+    assert np.isfinite(r63.loglik)
+
+
 def test_device_init_panel_cache_not_reused_across_panels(panel):
     """The on-device panel cache is keyed by object identity: fitting a
     SECOND panel on the same backend must not reuse the first's data."""
