@@ -11,16 +11,18 @@ module exploits that:
      t >= tau.  The freeze error decays like rho(A_closed)^(2 tau) — a
      convergence diagnostic (relative last-step change) is returned.
   2. The filtered-mean recursion x_f[t] = M_t x_f[t-1] + P_f[t] b_t now has
-     piecewise-constant coefficients — a pure k x k AFFINE semigroup, run by
-     the work-efficient blocked scan (``ops.scan``) whose combine is one
-     matmul + one matvec: no factorizations anywhere on the T axis.
+     piecewise-constant coefficients: a short sequential vector scan covers
+     the tau exact-coefficient steps, and the frozen tail runs as a
+     log-depth shift-doubling prefix (``ops.scan.affine_const_prefix`` —
+     each round is ONE (T, k) x (k, k) batched matmul; no (k, k) prefix
+     products, no factorizations anywhere on the T axis).
   3. The smoother reuses the trick backward: the smoothed covariance solves
      a fixed-point equation in the interior (iterated tau steps from the
      end), with exact boundary passes of length tau at both edges; smoothed
-     means are another reverse blocked affine scan; the log-likelihood is
-     the same batched residual pass as ``info_filter``.
+     means are the same doubling-plus-short-scan in reverse; the
+     log-likelihood is the same batched residual pass as ``info_filter``.
 
-Sequential depth drops from 2T (filter + smoother) to ~3 tau + O(sqrt(T))
+Sequential depth drops from 2T (filter + smoother) to ~3 tau + O(log T)
 regardless of T.  Masked panels and T <= 2 tau + 4 fall back to the exact
 sequential path automatically (shape-level Python branch, resolved at trace
 time).  Select with ``EMConfig(filter="ss")`` / ``TPUBackend(filter="ss")``.
@@ -40,7 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.linalg import sym, psd_cholesky, chol_solve, chol_logdet
-from ..ops.scan import blocked_scan
+from ..ops.scan import affine_const_prefix
 from .info_filter import (obs_stats, info_filter, loglik_from_terms,
                           quad_expanded, quad_local, u_from_stats)
 from .kalman import rts_smoother
@@ -91,7 +93,13 @@ def auto_tau(p, margin: float = 2.0, lo: int = 8, hi: int = 192) -> int:
 
 
 def _affine_combine(earlier, later):
-    """(M, d) semigroup: apply earlier first.  x -> M_l (M_e x + d_e) + d_l."""
+    """(M, d) semigroup: apply earlier first.  x -> M_l (M_e x + d_e) + d_l.
+
+    No longer on the hot path (the mean recursions use
+    ``affine_const_prefix`` since the doubling change) but kept for the
+    ``bench/profile_em*`` ablation scripts, which decompose the old
+    blocked-scan formulation piece by piece.
+    """
     Me, de = earlier
     Ml, dl = later
     return (Ml @ Me, jnp.einsum("...kl,...l->...k", Ml, de) + dl)
@@ -146,13 +154,27 @@ def ss_from_stats(stats, p: SSMParams, T: int, tau: int):
     M_path = _freeze(M_ex, T, tau)
     logdetG = _freeze(ldG_ex, T, tau)
 
-    # Filtered means: x_f[0] from the prior update, then the affine scan.
+    # Filtered means: x_f[0] from the prior update; then
+    # x_f[t] = M_t x_f[t-1] + P_f[t] b_t with M_t EXACT for t < tau and
+    # CONSTANT after — a short sequential vector scan over the exact head
+    # plus the log-depth doubling prefix over the frozen tail (faster than
+    # composing (k,k) affine elements with ``blocked_scan`` over all T:
+    # ~tau + log2(T) batched steps and only vector carries).
     b = stats.b
     x0 = p.mu0 + Pf_ex[0] @ (b[0] - C @ p.mu0)
     d = jnp.einsum("tkl,tl->tk", P_filt[1:], b[1:])          # (T-1, k)
-    Mpref, dpref = blocked_scan(_affine_combine, (M_path[1:], d))
-    x_tail = jnp.einsum("tkl,l->tk", Mpref, x0) + dpref
-    x_filt = jnp.concatenate([x0[None], x_tail], axis=0)
+
+    def vstep(x, inp):
+        M_t, d_t = inp
+        x_new = M_t @ x + d_t
+        return x_new, x_new
+
+    if tau > 1:
+        x_h_last, x_head = lax.scan(vstep, x0, (M_ex[1:], d[:tau - 1]))
+    else:
+        x_h_last, x_head = x0, jnp.zeros((0, k), dtype)
+    x_tail = affine_const_prefix(M_ex[-1], d[tau - 1:], x_h_last)
+    x_filt = jnp.concatenate([x0[None], x_head, x_tail], axis=0)
     x_pred = jnp.concatenate([p.mu0[None], x_filt[:-1] @ p.A.T], axis=0)
 
     # ----- smoother -----
@@ -195,14 +217,20 @@ def ss_from_stats(stats, p: SSMParams, T: int, tau: int):
         Pf_ss[None],
     ], axis=0)
 
-    # Smoothed means: reverse affine blocked scan over
-    # x_sm[t] = J_t x_sm[t+1] + c_t.
+    # Smoothed means, x_sm[t] = J_t x_sm[t+1] + c_t backward from t = T-2:
+    # in reversed time the coefficient is J_ss for the first T-tau steps
+    # (J[t] is frozen for t >= tau-1) and exact for the final tau-1 — the
+    # same doubling-plus-short-scan structure as the filtered means.
     c = x_filt[:-1] - jnp.einsum("tkl,tl->tk", J, x_pred[1:])
-    Jr, cr = blocked_scan(
-        lambda late, early: _affine_combine(late, early),  # reverse order
-        (J, c), reverse=True)
-    x_head = jnp.einsum("tkl,l->tk", Jr, x_filt[-1]) + cr
-    x_sm = jnp.concatenate([x_head, x_filt[-1:]], axis=0)
+    c_rev = jnp.flip(c, axis=0)                   # c_rev[s-1] = c[T-1-s]
+    y_const = affine_const_prefix(J_ss, c_rev[: T - tau], x_filt[-1])
+    if tau > 1:
+        _, y_exact = lax.scan(vstep, y_const[-1],
+                              (jnp.flip(J_ex, axis=0), c_rev[T - tau:]))
+        ys = jnp.concatenate([y_const, y_exact], axis=0)
+    else:
+        ys = y_const
+    x_sm = jnp.concatenate([jnp.flip(ys, axis=0), x_filt[-1:]], axis=0)
 
     P_lag_tail = jnp.einsum("tij,tkj->tik", P_sm[1:], J)
     P_lag = jnp.concatenate([jnp.zeros((1, k, k), dtype), P_lag_tail],
