@@ -55,7 +55,8 @@ from .info_filter import (ObsStats, obs_stats, loglik_terms_local,
                           loglik_from_terms)
 from .params import SSMParams, FilterResult, SmootherResult
 
-__all__ = ["pit_filter", "pit_smoother", "pit_filter_smoother"]
+__all__ = ["pit_filter", "pit_smoother", "pit_filter_smoother",
+           "pit_from_stats"]
 
 _LOG2PI = 1.8378770664093453
 
@@ -126,17 +127,16 @@ def _combine_filter(ei, ej):
     return (A, b, C, eta, J)
 
 
-def pit_filter(Y: jax.Array, p: SSMParams,
-               mask: Optional[jax.Array] = None,
-               scan_impl: str = "blocked") -> FilterResult:
-    """Parallel-in-time information-form filter; same contract as
-    ``info_filter`` (exact loglik, predicted/filtered moments).
-
-    scan_impl: "blocked" (work-efficient sqrt(T)-depth blocked scan — the
-    fast path on TPU, see ops.scan) or "associative" (log-depth
-    lax.associative_scan — more parallelism, ~2T combines)."""
-    p = p.astype(Y.dtype)
-    stats = obs_stats(Y, p.Lam, p.R, mask=mask)
+def pit_from_stats(stats: ObsStats, p: SSMParams,
+                   scan_impl: str = "blocked"):
+    """The replicated part of the PIT filter, from (possibly psum'd) stats:
+    element build + prefix product + batched moment/logdet assembly.
+    Returns (x_pred, P_pred, x_filt, P_filt, logdetG); the innovation
+    quadratic is the caller's (it needs the panel).  Shared by
+    ``pit_filter`` and the mixed-frequency E-step (``mixed_freq
+    .mf_em_core`` with ``time_scan="pit"`` — the m = L*k augmented scan is
+    that family's dominant cost and has no steady-state shortcut, the mask
+    makes C time-varying)."""
     elems = _filter_elements(stats, p.A, p.Q, p.mu0, p.P0)
     if scan_impl == "blocked":
         pref = blocked_scan(_combine_filter, elems)
@@ -151,16 +151,31 @@ def pit_filter(Y: jax.Array, p: SSMParams,
          sym(jnp.einsum("ij,tjl,kl->tik", p.A, P_f[:-1], p.A) + p.Q[None])],
         axis=0)
 
-    # Log-likelihood, zero sequential steps: batched logdet + residual pass.
+    # Batched logdet: log|I + L' C_t L| over the predicted-cov choleskys.
     k = p.A.shape[0]
-    T = Y.shape[0]
+    T = stats.b.shape[0]
     C_t = stats.C
     if C_t.ndim == 2:
         C_t = jnp.broadcast_to(C_t, (T, k, k))
     Lp = psd_cholesky(P_pred)
-    G = jnp.eye(k, dtype=Y.dtype)[None] + jnp.einsum(
+    G = jnp.eye(k, dtype=x_f.dtype)[None] + jnp.einsum(
         "tlk,tlm,tmn->tkn", Lp, C_t, Lp)
     logdetG = chol_logdet(psd_cholesky(G, jitter=0.0))
+    return x_pred, P_pred, x_f, P_f, logdetG
+
+
+def pit_filter(Y: jax.Array, p: SSMParams,
+               mask: Optional[jax.Array] = None,
+               scan_impl: str = "blocked") -> FilterResult:
+    """Parallel-in-time information-form filter; same contract as
+    ``info_filter`` (exact loglik, predicted/filtered moments).
+
+    scan_impl: "blocked" (work-efficient sqrt(T)-depth blocked scan — the
+    fast path on TPU, see ops.scan) or "associative" (log-depth
+    lax.associative_scan — more parallelism, ~2T combines)."""
+    p = p.astype(Y.dtype)
+    stats = obs_stats(Y, p.Lam, p.R, mask=mask)
+    x_pred, P_pred, x_f, P_f, logdetG = pit_from_stats(stats, p, scan_impl)
     quad_R, U = loglik_terms_local(Y, p.Lam, p.R, x_pred, mask)
     ll = loglik_from_terms(stats, logdetG, P_f, quad_R, U)
     return FilterResult(x_pred, P_pred, x_f, P_f, ll)

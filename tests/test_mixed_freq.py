@@ -131,3 +131,43 @@ def test_mf_fused_chunk_matches_per_iteration():
     np.testing.assert_allclose(np.asarray(r3.params.Lam_m),
                                np.asarray(r1.params.Lam_m), atol=1e-10)
     np.testing.assert_allclose(r3.nowcast, r1.nowcast, atol=1e-9)
+
+
+def test_mf_pit_time_scan_matches_seq():
+    """spec.time_scan="pit" (parallel-in-time E-step) reproduces the
+    sequential filter/smoother EM trajectory exactly (x64)."""
+    import dataclasses
+    rng = np.random.default_rng(33)
+    Y, mask, _, _ = dgp.simulate_mixed_freq(24, 6, 70, 2, rng)
+    spec = MixedFreqSpec(n_monthly=24, n_quarterly=6, n_factors=2)
+    r_seq = mf_fit(Y, spec, mask=mask, max_iters=6, tol=0.0)
+    r_pit = mf_fit(Y, dataclasses.replace(spec, time_scan="pit"),
+                   mask=mask, max_iters=6, tol=0.0)
+    np.testing.assert_allclose(r_pit.logliks, r_seq.logliks, rtol=1e-9)
+    np.testing.assert_allclose(r_pit.nowcast, r_seq.nowcast, atol=1e-6)
+    with pytest.raises(ValueError):
+        MixedFreqSpec(n_monthly=24, n_quarterly=6, n_factors=2,
+                      time_scan="parallel")
+
+
+def test_mf_pit_time_scan_matches_seq_f32():
+    """f32-tolerance variant (CLAUDE.md convention): the pit E-step's
+    compute-dtype trajectory stays within the in-loop noise band of the
+    sequential one."""
+    import dataclasses
+    import jax.numpy as jnp
+    from dfm_tpu.models.mixed_freq import mf_em_scan
+    rng = np.random.default_rng(34)
+    Y, mask, _, _ = dgp.simulate_mixed_freq(24, 6, 70, 2, rng)
+    spec = MixedFreqSpec(n_monthly=24, n_quarterly=6, n_factors=2)
+    r0 = mf_fit(Y, spec, mask=mask, max_iters=2, tol=0.0)   # warm params
+    Yz = r0.standardizer.transform(np.nan_to_num(Y))
+    W = np.where(np.isfinite(Y), mask, 0.0)
+    Yz = np.where(W > 0, Yz, 0.0)
+    args = (jnp.asarray(Yz, jnp.float32), jnp.asarray(W, jnp.float32),
+            r0.params.astype(jnp.float32))
+    _, lls_seq = mf_em_scan(*args, spec, 4)
+    _, lls_pit = mf_em_scan(
+        *args, dataclasses.replace(spec, time_scan="pit"), 4)
+    np.testing.assert_allclose(np.asarray(lls_pit), np.asarray(lls_seq),
+                               rtol=2e-4)

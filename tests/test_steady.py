@@ -46,6 +46,25 @@ def test_ss_matches_exact_filter_smoother(setup):
                                np.asarray(sm_s.P_lag), atol=1e-10)
 
 
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 8, 64, 100])
+def test_affine_const_prefix_matches_sequential(n):
+    """The doubling prefix reproduces x_t = M x_{t-1} + d_t exactly for
+    every length class (powers of two, odd, 1)."""
+    from dfm_tpu.ops.scan import affine_const_prefix
+    rng = np.random.default_rng(n)
+    k = 4
+    M = rng.normal(size=(k, k)) * 0.3          # rho < 1, like the engines
+    d = rng.normal(size=(n, k))
+    x0 = rng.normal(size=k)
+    got = np.asarray(affine_const_prefix(jnp.asarray(M), jnp.asarray(d),
+                                         jnp.asarray(x0)))
+    x, want = x0, []
+    for t in range(n):
+        x = M @ x + d[t]
+        want.append(x)
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-12)
+
+
 def test_ss_fallback_short_T(setup):
     p, _ = setup
     rng = np.random.default_rng(62)
