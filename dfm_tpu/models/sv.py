@@ -56,7 +56,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.linalg import sym
+from ..ops.linalg import (UNROLL_K_MAX, chol_solve_unrolled, chol_unrolled,
+                          matmul_vpu, matvec_vpu, sym)
 from ..ssm.params import SSMParams
 
 __all__ = ["SVSpec", "SVResult", "SVFit", "sv_filter", "sv_smooth_h",
@@ -130,31 +131,46 @@ def _rbpf_scan(Y, Lam, R, C, B, A, mu0, P0, h_center, sigma_h, h0_scale, key,
         key, kh, kr = jax.random.split(key, 3)
         # Propagate log-vols; per-particle predicted moments.
         h = h + sigma_h[None, :] * jax.random.normal(kh, (M, k), dtype)
-        x_p = x @ A.T
-        P_p = jnp.einsum("ij,mjl,kl->mik", A, P, A)
+        # Per-particle contractions via the VPU helpers (ops.linalg
+        # matmul_vpu/matvec_vpu — batched small dot_generals cost ~100x);
+        # only the (M, n) panel products below stay matmuls.
+        x_p = matvec_vpu(A[None], x)                             # x A'
+        P_p = matmul_vpu(matmul_vpu(A[None], P), A.T[None])      # A P A'
         P_p = P_p + jnp.exp(h)[:, :, None] * I_k[None]
-        # Info-form update, batched over particles (k x k only).
-        Lp = jnp.linalg.cholesky(sym(P_p) + 1e-6 * I_k[None])
-        CL = jnp.einsum("kl,mln->mkn", C, Lp)
-        Gm = I_k[None] + jnp.einsum("mlk,mln->mkn", Lp, CL)
-        Lg = jnp.linalg.cholesky(Gm)
+        # Info-form update, batched over particles (k x k only).  Unrolled
+        # small-k Cholesky: the batched-linalg primitives inside this scan
+        # step dominate the pass wall otherwise (same finding as the S4
+        # loading smoother — see ops.linalg.chol_unrolled).
+        if k <= UNROLL_K_MAX:
+            Lp = chol_unrolled(sym(P_p), jitter=1e-6)
+        else:
+            Lp = jnp.linalg.cholesky(sym(P_p) + 1e-6 * I_k[None])
         LpT = jnp.swapaxes(Lp, -1, -2)
-        P_f = jnp.einsum("mkl,mln->mkn",
-                         Lp, jax.scipy.linalg.cho_solve((Lg, True), LpT))
-        P_f = sym(P_f)
+        Gm = I_k[None] + matmul_vpu(LpT, matmul_vpu(C[None], Lp))
+        if k <= UNROLL_K_MAX:
+            Lg = chol_unrolled(Gm)
+            Xs = chol_solve_unrolled(Lg, LpT)
+        else:
+            Lg = jnp.linalg.cholesky(Gm)
+            Xs = jax.scipy.linalg.cho_solve((Lg, True), LpT)
+        P_f = sym(matmul_vpu(Lp, Xs))
+
+        def quad_form(P, u):                         # u' P u, (M,)
+            return (matvec_vpu(P, u) * u).sum(-1)
+
         if residual:
             # Cancellation-free: true residuals per particle (module docstring).
-            V = y_t[None, :] - x_p @ LamT             # (M, n_local)
+            V = y_t[None, :] - x_p @ LamT             # (M, n_local) — MXU
             VR = V * Rinv[None, :]
-            c2_p = reduce_fn(jnp.einsum("mn,mn->m", V, VR))  # v'R^{-1}v >= 0
+            c2_p = reduce_fn((V * VR).sum(-1))        # v'R^{-1}v >= 0
             u = reduce_fn(VR @ Lam)                   # Lam'R^{-1}v, (M, k)
-            quad = c2_p - jnp.einsum("mk,mkl,ml->m", u, P_f, u)
+            quad = c2_p - quad_form(P_f, u)
         else:
-            u = b_t[None, :] - x_p @ C.T              # (M, k)
-            quad = (-2.0 * (x_p @ b_t)
-                    + jnp.einsum("mk,kl,ml->m", x_p, C, x_p)
-                    - jnp.einsum("mk,mkl,ml->m", u, P_f, u))
-        x_f = x_p + jnp.einsum("mkl,ml->mk", P_f, u)
+            u = b_t[None, :] - matvec_vpu(C[None], x_p)
+            quad = (-2.0 * (x_p * b_t[None, :]).sum(-1)
+                    + (matvec_vpu(C[None], x_p) * x_p).sum(-1)
+                    - quad_form(P_f, u))
+        x_f = x_p + matvec_vpu(P_f, u)
         logdetG = 2.0 * jnp.sum(
             jnp.log(jnp.diagonal(Lg, axis1=-2, axis2=-1)), axis=-1)
         lw = -0.5 * (logdetG + quad)

@@ -36,7 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.linalg import sym, solve_psd
+from ..ops.linalg import (UNROLL_K_MAX, chol_solve_unrolled, chol_unrolled,
+                          matmul_vpu, matvec_vpu, solve_psd, sym)
 from ..ssm.info_filter import (ObsStats, info_scan, loglik_from_terms)
 from ..ssm.params import FilterResult, SmootherResult
 from ..ssm.kalman import rts_smoother
@@ -145,16 +146,19 @@ def loading_pass(Y, F, p: TVLParams, mask=None):
     Yz = jnp.nan_to_num(Y) if mask is None else jnp.nan_to_num(Y) * W
 
     def fstep(carry, inp):
+        # Every contraction is a VPU broadcast-multiply+sum over the STATIC
+        # k axis (ops.linalg matmul_vpu rationale): batched (N, 4, 4)
+        # dot_generals cost ~100x on TPU.
         lam, P = carry                   # (N, k), (N, k, k) filtered t-1
         y_t, f_t, w_t = inp
         P_pred = P + tau2[:, None, None] * I_k[None]
-        Pf = jnp.einsum("nkl,l->nk", P_pred, f_t)       # (N, k)
-        S = jnp.einsum("nk,k->n", Pf, f_t) + R          # (N,)
+        Pf = matvec_vpu(P_pred, f_t[None])              # (N, k)
+        S = (Pf * f_t[None, :]).sum(-1) + R             # (N,)
         gate = w_t if w_t is not None else jnp.ones((N,), dtype)
         K = gate[:, None] * Pf / S[:, None]             # (N, k)
-        v = y_t - lam @ f_t                             # innovation vs pred
+        v = y_t - (lam * f_t[None, :]).sum(-1)          # innovation vs pred
         lam_f = lam + K * v[:, None]
-        P_f = P_pred - jnp.einsum("nk,nl->nkl", K, Pf)
+        P_f = P_pred - K[:, :, None] * Pf[:, None, :]
         P_f = sym(P_f)
         return (lam_f, P_f), (lam, P_pred, lam_f, P_f)
 
@@ -170,18 +174,27 @@ def loading_pass(Y, F, p: TVLParams, mask=None):
 
     # RTS for the random walk: J_t = P_f[t] (P_pred[t+1])^{-1}; both are
     # (N, k, k) PSD; batched Cholesky solve over (T-1, N).
+    small_k = k <= UNROLL_K_MAX
+
     def bstep(carry, inp):
         lam_n, P_n, incr = carry         # smoothed at t+1, running increment
         lam_f, P_f, lam_p_next, P_p_next = inp
-        L = jnp.linalg.cholesky(P_p_next)
-        # J' = solve(P_pred, P_f) using the Cholesky factor.
-        tmp = jax.scipy.linalg.cho_solve((L, True), P_f)   # (N, k, k) = J'
+        # J' = solve(P_pred, P_f) via Cholesky.  The unrolled small-k path
+        # is ~8x the batched-linalg one here (docs/PERF.md S4 note): the
+        # (N, k, k) jnp.linalg.cholesky + cho_solve inside this scan step
+        # WAS the whole S4 wall.
+        if small_k:
+            tmp = chol_solve_unrolled(chol_unrolled(P_p_next), P_f)
+        else:
+            L = jnp.linalg.cholesky(P_p_next)
+            tmp = jax.scipy.linalg.cho_solve((L, True), P_f)  # (N,k,k) = J'
         J = jnp.swapaxes(tmp, -1, -2)
-        lam_s = lam_f + jnp.einsum("nkl,nl->nk", J, lam_n - lam_p_next)
-        P_s = sym(P_f + jnp.einsum("nkl,nlm,npm->nkp", J, P_n - P_p_next, J))
+        JT = tmp
+        lam_s = lam_f + matvec_vpu(J, lam_n - lam_p_next)
+        P_s = sym(P_f + matmul_vpu(matmul_vpu(J, P_n - P_p_next), JT))
         # E|lam_{t+1} - lam_t|^2 = |dlam|^2 + tr(P_s[t+1]) + tr(P_s[t])
         #                          - 2 tr(P_lag), P_lag = P_sm[t+1] J'
-        P_lag = jnp.einsum("nkl,nml->nkm", P_n, J)
+        P_lag = matmul_vpu(P_n, JT)
         d = lam_n - lam_s
         incr = incr + (jnp.einsum("nk,nk->n", d, d)
                        + jnp.trace(P_n, axis1=-2, axis2=-1)

@@ -12,7 +12,14 @@ import jax.numpy as jnp
 from jax.scipy.linalg import solve_triangular
 
 __all__ = ["sym", "psd_cholesky", "chol_solve", "chol_logdet",
-           "solve_psd", "default_jitter"]
+           "solve_psd", "default_jitter", "chol_unrolled",
+           "chol_solve_unrolled", "matmul_vpu", "matvec_vpu",
+           "UNROLL_K_MAX"]
+
+# Unrolling is ~k^2/2 fused elementwise ops for the factorization and
+# ~k^2 per solve column; past this bound compile time and op count beat
+# the batched-linalg savings.
+UNROLL_K_MAX = 8
 
 
 def sym(M: jax.Array) -> jax.Array:
@@ -51,3 +58,86 @@ def chol_logdet(L: jax.Array) -> jax.Array:
 def solve_psd(M: jax.Array, B: jax.Array, jitter: float | None = None) -> jax.Array:
     """Solve M X = B for symmetric PSD M via Cholesky."""
     return chol_solve(psd_cholesky(M, jitter), B)
+
+
+def chol_unrolled(P: jax.Array, jitter: float = 0.0) -> jax.Array:
+    """Batched Cholesky for SMALL static k, unrolled into elementwise ops.
+
+    ``jnp.linalg.cholesky`` on (batch, k, k) with k ~ 4-8 lowers to a
+    batched-linalg path that costs ~ms per call on this TPU toolchain —
+    inside a ``lax.scan`` step that is the whole wall (measured: the S4
+    loading smoother spent ~0.7 s/round in it, ~8x the rest of the pass;
+    the S5 RBPF the same pattern per particle).  The unrolled form is
+    ~k^2/2 Python-generated fused VPU ops over the batch: same math, same
+    stability (it IS the textbook factorization), no linalg primitive.
+    Use for k <= UNROLL_K_MAX; fall back to ``psd_cholesky`` above it.
+    """
+    k = P.shape[-1]
+    L: list = [[None] * k for _ in range(k)]
+    for i in range(k):
+        s = P[..., i, i] + jitter
+        for j in range(i):
+            s = s - L[i][j] * L[i][j]
+        # No clamp: a negative pivot must produce NaN exactly like the
+        # jnp.linalg.cholesky paths this replaces (and the k > UNROLL_K_MAX
+        # fallback), so indefinite inputs FAIL VISIBLY instead of silently
+        # corrupting downstream weights/logdets.
+        L[i][i] = jnp.sqrt(s)
+        for r in range(i + 1, k):
+            s2 = P[..., r, i]
+            for j in range(i):
+                s2 = s2 - L[r][j] * L[i][j]
+            L[r][i] = s2 / L[i][i]
+    zeros = jnp.zeros_like(P[..., 0, 0])
+    rows = [jnp.stack([L[i][j] if j <= i else zeros for j in range(k)],
+                      axis=-1) for i in range(k)]
+    return jnp.stack(rows, axis=-2)
+
+
+def matmul_vpu(A: jax.Array, B: jax.Array) -> jax.Array:
+    """(..., i, j) x (..., j, l) -> (..., i, l) as broadcast multiply + sum.
+
+    For SMALL static trailing dims inside scan loops: a batched (B, k, k)
+    ``dot_general`` with k ~ 4-8 pads the MXU's 128-wide tiles ~97% empty
+    and costs ~100x this fused-VPU form (measured — the S4/S5 hot-loop
+    finding, docs/PERF.md).  Leading dims broadcast normally, so a global
+    (k, k) factor composes with a batched (B, k, k) via ``A[None]``.
+    Use real matmuls for anything with a large contracted axis.
+    """
+    return (A[..., :, :, None] * B[..., None, :, :]).sum(-2)
+
+
+def matvec_vpu(A: jax.Array, v: jax.Array) -> jax.Array:
+    """(..., i, j) x (..., j) -> (..., i); same rationale as matmul_vpu."""
+    return (A * v[..., None, :]).sum(-1)
+
+
+def chol_solve_unrolled(L: jax.Array, B: jax.Array) -> jax.Array:
+    """Solve (L L') X = B by unrolled forward/back substitution.
+
+    ``L`` from ``chol_unrolled`` (or any lower factor), ``B`` (..., k) or
+    (..., k, r) with small static k and r.  Same result as ``chol_solve``;
+    every op is an elementwise multiply-add over the batch dims.
+    """
+    vec = B.ndim == L.ndim - 1
+    if vec:
+        B = B[..., None]
+    k = L.shape[-1]
+    r = B.shape[-1]
+    cols = []
+    for c in range(r):
+        y: list = [None] * k
+        for i in range(k):
+            s = B[..., i, c]
+            for j in range(i):
+                s = s - L[..., i, j] * y[j]
+            y[i] = s / L[..., i, i]
+        x: list = [None] * k
+        for i in reversed(range(k)):
+            s = y[i]
+            for j in range(i + 1, k):
+                s = s - L[..., j, i] * x[j]
+            x[i] = s / L[..., i, i]
+        cols.append(jnp.stack(x, axis=-1))
+    X = jnp.stack(cols, axis=-1)
+    return X[..., 0] if vec else X
