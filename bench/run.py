@@ -296,7 +296,12 @@ def sustained_fields(cfg, res, Y, mask):
                     np.asarray(em_fit_scan(Yj, pj, n, cfg=emc)[1])
                     return time.perf_counter() - t0
 
-                rate, ok = _two_point_rate(run_n, 50, 150)
+                # Wide two-point window for the fast ss engine (its
+                # per-iteration cost is ~0.1-0.3 ms, so a 100-iteration
+                # delta would drown in dispatch jitter; bench.py uses the
+                # same 150/450 pair).
+                n_pts = (150, 450) if flt == "ss" else (50, 150)
+                rate, ok = _two_point_rate(run_n, *n_pts)
             out = {"em_iters_per_sec_sustained": rate,
                    "sustained_filter": flt}
         elif cfg.kind == "mixed_freq":

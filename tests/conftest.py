@@ -23,6 +23,23 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled-program state between test modules.
+
+    With the whole suite in one process the XLA CPU compiler segfaults
+    compiling ``tvl_round_scan`` near the END of the run (reproducibly at
+    test_tvl in full-suite order; never in any half-suite prefix or
+    standalone — an accumulated-state compiler bug, 2026-07).  Clearing
+    JAX's executable caches between modules bounds that state; programs
+    shared across modules recompile, which costs far less than the
+    headroom it buys.
+    """
+    yield
+    jax.clear_caches()
