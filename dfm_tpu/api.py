@@ -583,22 +583,128 @@ register_backend("jax", TPUBackend)
 register_backend("sharded", ShardedBackend)
 
 
-def fit(model: DynamicFactorModel,
+def _family_fit(model, Y, mask, backend, max_iters, tol, init, callback,
+                checkpoint_path, debug):
+    """Route the non-plain model families through their drivers.
+
+    The reference exposes ONE estimation seam — ``fit(dfm; backend=...)``
+    (BASELINE.json:5) — so ours accepts every family's spec as ``model``:
+    ``MixedFreqSpec`` -> ``models.mixed_freq.mf_fit``, ``TVLSpec`` ->
+    ``models.tv_loadings.tvl_fit``, ``SVSpec`` -> ``models.sv.sv_fit``,
+    each swapping to its sharded driver under ``backend="sharded"``.
+    Returns the family's own result type (``MFResult``/``TVLResult``/
+    ``SVFit`` — their fields differ by model semantics), or None when
+    ``model`` is a plain ``DynamicFactorModel``.
+
+    ``max_iters``/``tol`` override the family defaults only when given
+    (``None`` keeps e.g. ``TVLSpec.n_rounds``); for SV, ``max_iters`` maps
+    to the particle-EM round count ``sv_iters`` and ``tol`` is ignored
+    (convergence there is monotone only up to MC noise — see models.sv).
+    """
+    from .models.mixed_freq import MFParams, MixedFreqSpec
+    from .models.sv import SVSpec
+    from .models.tv_loadings import TVLParams, TVLSpec
+    if not isinstance(model, (MixedFreqSpec, TVLSpec, SVSpec)):
+        return None
+    name = type(model).__name__
+    if checkpoint_path is not None:
+        raise ValueError(
+            f"checkpointing is not supported for the {name} family yet")
+    if debug:
+        import warnings
+        warnings.warn(
+            f"the {name} family has no checkify debug mode; running "
+            "unchecked", RuntimeWarning, stacklevel=3)
+    b = get_backend(backend)
+    if isinstance(b, ShardedBackend):
+        mesh = b._mesh()
+    elif isinstance(b, TPUBackend):
+        mesh = None
+    else:
+        raise ValueError(
+            f"backend {b.name!r} cannot run the {name} family: these "
+            "fits run on the default JAX device (their f64 oracle regime "
+            "is a CPU-device process with x64 — see tests/conftest.py)")
+    # A configured backend instance's knobs carry over where the family
+    # drivers support them (dtype, fused_chunk); filter is plain-model
+    # only and debug warned above.
+    kw = dict(dtype=b.dtype if mesh is None else b._dtype(),
+              fused_chunk=b.fused_chunk)
+    iters = max_iters if max_iters is not None else 50
+    tol_v = tol if tol is not None else 1e-6
+    if isinstance(model, MixedFreqSpec):
+        if init is not None and not isinstance(init, MFParams):
+            raise TypeError(
+                f"init for the {name} family must be MFParams; "
+                f"got {type(init).__name__}")
+        if mesh is not None:
+            from .parallel.sharded_mf import sharded_mf_fit
+            return sharded_mf_fit(Y, model, mask=mask, mesh=mesh,
+                                  max_iters=iters, tol=tol_v,
+                                  init=init, callback=callback, **kw)
+        from .models.mixed_freq import mf_fit
+        return mf_fit(Y, model, mask=mask, max_iters=iters, tol=tol_v,
+                      init=init, callback=callback, **kw)
+    if isinstance(model, TVLSpec):
+        if init is not None and not isinstance(init, TVLParams):
+            raise TypeError(
+                f"init for the {name} family must be TVLParams; "
+                f"got {type(init).__name__}")
+        spec = model
+        if max_iters is not None or tol is not None:
+            spec = dataclasses.replace(
+                model,
+                n_rounds=max_iters if max_iters is not None
+                else model.n_rounds,
+                tol=tol if tol is not None else model.tol)
+        if mesh is not None:
+            from .parallel.sharded_tvl import sharded_tvl_fit
+            return sharded_tvl_fit(Y, spec, mask=mask, mesh=mesh,
+                                   init=init, callback=callback, **kw)
+        from .models.tv_loadings import tvl_fit
+        return tvl_fit(Y, spec, mask=mask, init=init, callback=callback,
+                       **kw)
+    if mask is not None:
+        raise ValueError("the SV family does not support missing data")
+    if init is not None:
+        raise ValueError("sv_fit estimates its own warm start; init is "
+                         "not supported (see models.sv.sv_fit)")
+    if callback is not None:
+        raise ValueError(
+            "sv_fit has no per-iteration callback (particle-EM rounds "
+            "are fused programs; see models.sv.sv_fit) — call it "
+            "directly and consume SVFit.logliks instead")
+    from .models.sv import sv_fit
+    return sv_fit(Y, model, backend="sharded" if mesh is not None
+                  else "tpu", mesh=mesh,
+                  sv_iters=iters if max_iters is not None else 10)
+
+
+def fit(model,                     # DynamicFactorModel | family spec
         Y: np.ndarray,
         mask: Optional[np.ndarray] = None,
         backend: Union[str, Backend, None] = None,
-        max_iters: int = 50,
-        tol: float = 1e-6,
-        init: Optional[cpu_ref.SSMParams] = None,
-        callback: Optional[Callable] = None,
+        max_iters: Optional[int] = None,
+        tol: Optional[float] = None,
+        init=None,                 # family-typed warm start (SSMParams /
+        callback: Optional[Callable] = None,       # MFParams / TVLParams)
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 10,
-        debug: bool = False) -> FitResult:
+        debug: bool = False):
     """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
+
+    ``model`` may also be a family spec — ``MixedFreqSpec``, ``TVLSpec``,
+    or ``SVSpec`` — in which case the corresponding family driver runs
+    (single-device or sharded per ``backend``) and its own result type
+    (``MFResult``/``TVLResult``/``SVFit``) is returned instead of
+    ``FitResult``; ``init`` must then be that family's params type.  See
+    ``_family_fit``.
 
     Y    : (T, N) panel; NaNs mark missing observations.
     mask : optional explicit {0,1} mask, combined with the NaN pattern.
     backend : "cpu", "tpu", a Backend instance, or a registered name.
+    max_iters / tol : EM budget and relative-loglik stop (default 50 and
+        1e-6; ``None`` keeps each family's own defaults).
     checkpoint_path : if set, EM params are saved there every
         ``checkpoint_every`` iterations (atomic npz) and a compatible
         existing checkpoint is used as the warm start (resume).
@@ -611,6 +717,12 @@ def fit(model: DynamicFactorModel,
         means non-finite values the mask logic cannot see, e.g. a bad
         ``init`` or a data bug reintroducing inf after masking.)
     """
+    family = _family_fit(model, Y, mask, backend, max_iters, tol, init,
+                         callback, checkpoint_path, debug)
+    if family is not None:
+        return family
+    max_iters = 50 if max_iters is None else max_iters
+    tol = 1e-6 if tol is None else tol
     Y = np.asarray(Y)
     if Y.ndim != 2:
         raise ValueError(f"Y must be (T, N); got shape {Y.shape}")
