@@ -606,6 +606,7 @@ def _family_fit(model, Y, mask, backend, max_iters, tol, init, callback,
     from .models.tv_loadings import TVLParams, TVLSpec
     if not isinstance(model, (MixedFreqSpec, TVLSpec, SVSpec)):
         return None
+    Y = np.asarray(Y)
     name = type(model).__name__
     if checkpoint_path is not None:
         raise ValueError(
@@ -664,7 +665,10 @@ def _family_fit(model, Y, mask, backend, max_iters, tol, init, callback,
         from .models.tv_loadings import tvl_fit
         return tvl_fit(Y, spec, mask=mask, init=init, callback=callback,
                        **kw)
-    if mask is not None:
+    if mask is not None or not bool(np.isfinite(Y).all()):
+        # NaN-coded missing data must fail HERE like an explicit mask:
+        # sv_filter has no missing-data handling, and NaNs would silently
+        # poison the loglik/vol paths.
         raise ValueError("the SV family does not support missing data")
     if init is not None:
         raise ValueError("sv_fit estimates its own warm start; init is "
@@ -675,8 +679,10 @@ def _family_fit(model, Y, mask, backend, max_iters, tol, init, callback,
             "are fused programs; see models.sv.sv_fit) — call it "
             "directly and consume SVFit.logliks instead")
     from .models.sv import sv_fit
-    return sv_fit(Y, model, backend="sharded" if mesh is not None
-                  else "tpu", mesh=mesh,
+    # The resolved backend INSTANCE drives the EM pre-fit too, so a
+    # configured mesh/dtype cannot diverge between the pre-fit and the
+    # RBPF (get_backend accepts instances).
+    return sv_fit(Y, model, backend=b, mesh=mesh,
                   sv_iters=iters if max_iters is not None else 10)
 
 
@@ -852,15 +858,19 @@ def forecast(result, horizon: int):
     Returns (y_fore (h, N), f_fore (h, k)).  Reference behavior per SURVEY.md
     section 3.2 (filter to T, iterate dynamics, map through loadings).
     Dispatches across every model family: plain/AR(1) ``FitResult``,
-    mixed-frequency ``MFResult`` (companion-state iteration), and TVL
-    ``TVLResult`` (loadings frozen at T).
+    mixed-frequency ``MFResult`` (companion-state iteration), TVL
+    ``TVLResult`` (loadings frozen at T), and SV ``SVFit`` (conditional
+    means; ``models.sv.sv_forecast`` additionally returns the vol bands).
     """
     from .models.mixed_freq import MFResult, mf_forecast
+    from .models.sv import SVFit, sv_forecast
     from .models.tv_loadings import TVLResult, tvl_forecast
     if isinstance(result, MFResult):
         return mf_forecast(result, horizon)
     if isinstance(result, TVLResult):
         return tvl_forecast(result, horizon)
+    if isinstance(result, SVFit):
+        return sv_forecast(result, horizon)[:2]
     p = result.params
     # Re-filter to the end of sample using smoothed factors' last state:
     x_T = result.factors[-1]

@@ -9,11 +9,13 @@ from .mixed_freq import (MixedFreqSpec, MFParams, MFResult, augment,
                          mf_em_step, mf_fit, mf_forecast, mf_pca_init)
 from .tv_loadings import (TVLSpec, TVLParams, TVLResult, tvl_fit,
                           tvl_forecast)
-from .sv import SVSpec, SVResult, SVFit, sv_filter, sv_smooth_h, sv_fit
+from .sv import (SVSpec, SVResult, SVFit, sv_filter, sv_smooth_h,
+                 sv_fit, sv_forecast)
 
 __all__ = [
     "MixedFreqSpec", "MFParams", "MFResult", "augment",
     "mf_em_step", "mf_fit", "mf_forecast", "mf_pca_init",
     "TVLSpec", "TVLParams", "TVLResult", "tvl_fit", "tvl_forecast",
     "SVSpec", "SVResult", "SVFit", "sv_filter", "sv_smooth_h", "sv_fit",
+    "sv_forecast",
 ]

@@ -61,7 +61,7 @@ from ..ops.linalg import (UNROLL_K_MAX, chol_solve_unrolled, chol_unrolled,
 from ..ssm.params import SSMParams
 
 __all__ = ["SVSpec", "SVResult", "SVFit", "sv_filter", "sv_smooth_h",
-           "sv_fit"]
+           "sv_fit", "sv_forecast"]
 
 _LOG2PI = 1.8378770664093453
 
@@ -345,10 +345,41 @@ class SVFit:
     h_center: np.ndarray = None  # (k,) estimated h_0 prior center
     h_smooth: np.ndarray = None  # (T, k) FFBS-smoothed log-vol means
     logliks: np.ndarray = None   # per-SV-iteration marginal logliks
+    standardizer: object = None  # utils.data.Standardizer from the pre-fit
+
+
+def sv_forecast(fit: SVFit, horizon: int):
+    """h-step forecast for the SV-DFM, mirroring ``api.forecast``'s
+    contract (SURVEY.md section 3.2 extended to the SV family).
+
+    Conditional MEANS are the homoskedastic iteration — volatility moves
+    bands, not means: f_{T+j} = A^j f_T from the filtered particle mean,
+    y = f Lam' de-standardized.  The third return is the factor-innovation
+    vol forecast E[exp(h_{T+j}/2)] under the log-vol random walk,
+    = exp(h_T/2 + j sigma_h^2 / 8) (lognormal mean of h ~ N(h_T, j s^2)).
+    Returns (y_fore (h, N), f_fore (h, k), vol_fore (h, k)).
+    """
+    A = np.asarray(fit.params.A, np.float64)
+    Lam = np.asarray(fit.params.Lam, np.float64)
+    k = A.shape[0]
+    x = np.asarray(fit.result.f_mean[-1], np.float64)
+    h_T = np.asarray(fit.h_smooth[-1], np.float64)
+    s2 = np.asarray(fit.sigma_h, np.float64) ** 2 \
+        if fit.sigma_h is not None else np.zeros(k)
+    f = np.zeros((horizon, k))
+    vol = np.zeros((horizon, k))
+    for j in range(horizon):
+        x = A @ x
+        f[j] = x
+        vol[j] = np.exp(0.5 * h_T + (j + 1) * s2 / 8.0)
+    y = f @ Lam.T
+    if fit.standardizer is not None:
+        y = fit.standardizer.inverse(y)
+    return y, f, vol
 
 
 def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
-           key: Optional[jax.Array] = None, backend: str = "tpu",
+           key: Optional[jax.Array] = None, backend="tpu",
            standardize: bool = True, sv_iters: int = 10,
            sv_accel: float = 3.0, estimate_sv: bool = True,
            mesh=None) -> SVFit:
@@ -451,4 +482,5 @@ def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
                  sigma_h=np.asarray(sigma, np.float64),
                  h_center=np.asarray(h_center, np.float64),
                  h_smooth=h_smooth,
-                 logliks=np.asarray(logliks))
+                 logliks=np.asarray(logliks),
+                 standardizer=pre.standardizer)

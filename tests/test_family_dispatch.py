@@ -56,6 +56,8 @@ def test_fit_dispatches_tvl_and_keeps_spec_defaults():
 
 
 def test_fit_dispatches_sv_and_validates():
+    from dfm_tpu.api import forecast
+    from dfm_tpu.models.sv import sv_forecast
     rng = np.random.default_rng(43)
     Y = dgp.simulate_sv(30, 40, 2, rng)[0]
     spec = SVSpec(n_factors=2, n_particles=32)
@@ -63,6 +65,12 @@ def test_fit_dispatches_sv_and_validates():
     r_dir = sv_fit(Y, spec, sv_iters=2)
     assert np.isfinite(r_api.loglik)
     np.testing.assert_allclose(r_api.loglik, r_dir.loglik, rtol=1e-10)
+    # SV forecast: finite conditional means in DATA units + vol bands.
+    y_f, f_f, vol_f = sv_forecast(r_api, 6)
+    assert y_f.shape == (6, 30) and vol_f.shape == (6, 2)
+    assert np.isfinite(y_f).all() and (vol_f > 0).all()
+    y2, f2 = forecast(r_api, 6)
+    np.testing.assert_array_equal(y2, y_f)
     with pytest.raises(ValueError, match="missing data"):
         fit(spec, Y, mask=np.ones_like(Y))
     with pytest.raises(ValueError, match="cannot run"):
