@@ -144,3 +144,19 @@ def test_device_init_panel_cache_not_reused_across_panels(panel):
     r_fresh = fit(model, Y2, backend=TPUBackend(device_init=True),
                   max_iters=3)
     np.testing.assert_allclose(r_reused.logliks, r_fresh.logliks, rtol=1e-10)
+
+
+def test_device_prep_accepts_f32_panel(raw_panel):
+    """A float32 input panel goes through device prep without an f64 host
+    round trip and fits to the same optimum (f32-tolerance)."""
+    model = DynamicFactorModel(n_factors=3)
+    Y32 = np.asarray(raw_panel, np.float32)
+    # the claimed behavior: f32 input is ACCEPTED by the device-prep path
+    assert TPUBackend(device_init=True).prep_standardize(Y32, model) \
+        is not None
+    r32 = fit(model, Y32, backend=TPUBackend(device_init=True),
+              max_iters=6, tol=0.0)
+    r64 = fit(model, raw_panel, backend=TPUBackend(device_init=True),
+              max_iters=6, tol=0.0)
+    assert np.isfinite(r32.loglik)
+    np.testing.assert_allclose(r32.loglik, r64.loglik, rtol=1e-4)

@@ -159,3 +159,23 @@ def test_auto_tau_buckets_and_floor(setup):
     kf_ex = info_filter(Yj, pj)
     np.testing.assert_allclose(float(kf_ss.loglik), float(kf_ex.loglik),
                                rtol=1e-8)
+
+
+def test_affine_const_prefix_slow_mixing_stable():
+    """Near-unit-root M (rho ~ 0.999): the doubling association must not
+    lose accuracy relative to the sequential recursion over long spans."""
+    from dfm_tpu.ops.scan import affine_const_prefix
+    rng = np.random.default_rng(5)
+    k, n = 3, 2048
+    Q, _ = np.linalg.qr(rng.normal(size=(k, k)))
+    M = Q @ np.diag([0.999, 0.99, 0.9]) @ Q.T
+    d = rng.normal(size=(n, k))
+    x0 = rng.normal(size=k)
+    got = np.asarray(affine_const_prefix(jnp.asarray(M), jnp.asarray(d),
+                                         jnp.asarray(x0)))
+    x = x0
+    for t in range(n):
+        x = M @ x + d[t]
+    # the final state has accumulated ~n combines in both orders
+    np.testing.assert_allclose(got[-1], x, rtol=1e-9)
+    assert np.isfinite(got).all()
