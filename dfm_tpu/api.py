@@ -73,6 +73,8 @@ class FitResult:
     model: DynamicFactorModel
     backend: str
     history: list                      # per-iter dicts {iter, loglik, secs}
+    health: Optional[object] = None    # robust.FitHealth from guarded runs
+    #                                  # (None: CPU oracle / unguarded path)
 
     @property
     def loglik(self) -> float:
@@ -138,6 +140,24 @@ class CPUBackend(Backend):
         return np.asarray(sm.x_sm), np.asarray(sm.P_sm)
 
 
+def _resolve_policy(robust):
+    """``robust`` knob -> RobustPolicy | None (None means unguarded)."""
+    if not robust:
+        return None
+    from .robust.guard import RobustPolicy
+    if robust is True:
+        return RobustPolicy()
+    if isinstance(robust, RobustPolicy):
+        return robust
+    raise TypeError(
+        f"robust must be bool or RobustPolicy; got {type(robust).__name__}")
+
+
+def _TPUGuardControls(Yj, mj, cfg, em_fit_scan):
+    from .robust.controls import TPUControls
+    return TPUControls(Yj, mj, cfg, em_fit_scan)
+
+
 class TPUBackend(Backend):
     """JAX backend: runs on TPU when present, any XLA device otherwise.
 
@@ -172,7 +192,7 @@ class TPUBackend(Backend):
 
     def __init__(self, dtype=None, filter: str = "auto",
                  matmul_precision: str = "highest", fused_chunk: int = 8,
-                 debug: bool = False, device_init="auto"):
+                 debug: bool = False, device_init="auto", robust=True):
         self.dtype = dtype
         if filter not in ("auto", "dense", "info", "ss", "pit"):
             raise ValueError(f"unknown filter {filter!r}")
@@ -182,6 +202,14 @@ class TPUBackend(Backend):
         # checkify NaN/inf guard around the filter scans (EMConfig.debug):
         # poisoned data/params raise located errors instead of silent NaNs.
         self.debug = debug
+        # Health-monitored chunked EM (robust.guard): True uses the default
+        # RobustPolicy, a RobustPolicy instance customizes it, False/None
+        # keeps the legacy unguarded loop.  The guard runs host-side
+        # between fused dispatches only — a healthy fit executes the
+        # identical device workload (docs/PERF.md).
+        self.robust = robust
+        self._last_health = None
+        self._guard_checkpoint = None
         # PCA warm start on device (estim.init) — saves the ~1.2 s host SVD
         # at 10k series.  "auto" (default) switches it on when the panel is
         # large enough that the host SVD dominates the fit's fixed cost
@@ -297,6 +325,7 @@ class TPUBackend(Backend):
         import jax.numpy as jnp
         from .estim.em import EMConfig, em_fit, em_fit_scan
         from .ssm.params import SSMParams as JaxParams
+        self._last_health = None
         dt = self._dtype()
         Yj = self._device_panel(Y, mask, dt)
         mj = jnp.asarray(mask, dt) if mask is not None else None
@@ -339,12 +368,16 @@ class TPUBackend(Backend):
         return pn, np.asarray(lls), converged, p_iters
 
     def _run_em_chunked(self, Yj, mj, pj, cfg, max_iters, tol, callback,
-                        em_fit_scan):
+                        em_fit_scan, controls=None):
         """Fused-chunk driver: one XLA program per ``fused_chunk`` iters.
 
         Thin adapter over the shared ``estim.em.run_em_chunked`` (the exact
         stop/replay semantics — chunk-prefix replay on mid-chunk stops,
-        chunk-entry params to callbacks — are documented there).
+        chunk-entry params to callbacks — are documented there).  With
+        ``self.robust`` enabled, a ``robust.ChunkMonitor`` rides along and
+        the shared driver delegates to its health-monitored twin;
+        ``controls`` lets subclasses supply their own escalation hooks
+        (ShardedBackend re-pads params through its driver).
         """
         from .estim.em import noise_floor_for, run_em_chunked
 
@@ -352,11 +385,28 @@ class TPUBackend(Backend):
             p_new, lls, deltas = em_fit_scan(Yj, p, n, mask=mj, cfg=cfg)
             return p_new, lls, (deltas if cfg.filter == "ss" else None)
 
+        monitor = None
+        # checkify debug mode is a diagnostic: its located errors must
+        # propagate verbatim, not be dispatch-retried (they are
+        # deterministic) or converted into GuardFailure.
+        policy = None if cfg.debug else _resolve_policy(self.robust)
+        if policy is not None:
+            from .robust.guard import ChunkMonitor
+            if controls is None:
+                controls = _TPUGuardControls(Yj, mj, cfg, em_fit_scan)
+            gc = getattr(self, "_guard_checkpoint", None)
+            if gc is not None and policy.checkpoint_path is None:
+                policy = dataclasses.replace(
+                    policy, checkpoint_path=gc[0],
+                    checkpoint_fingerprint=gc[1], iter_offset=gc[2])
+            monitor = ChunkMonitor(policy, controls)
+            self._last_health = monitor.health
         return run_em_chunked(
             scan_fn, pj, max_iters, tol,
             noise_floor_for(Yj.dtype, Yj.size, mult=cfg.noise_floor_mult),
             callback, self.fused_chunk,
-            ss_tau=cfg.tau if cfg.filter == "ss" else None)
+            ss_tau=cfg.tau if cfg.filter == "ss" else None,
+            monitor=monitor)
 
     def smooth(self, Y, mask, params):
         # fit() calls smooth right after run_em with the exact (Y, mask,
@@ -418,11 +468,11 @@ class ShardedBackend(TPUBackend):
 
     def __init__(self, dtype=None, n_devices=None, filter: str = "auto",
                  matmul_precision: str = "highest", fused_chunk: int = 8,
-                 debug: bool = False, device_init="auto"):
+                 debug: bool = False, device_init="auto", robust=True):
         super().__init__(dtype=dtype, filter=filter,
                          matmul_precision=matmul_precision,
                          fused_chunk=fused_chunk, debug=debug,
-                         device_init=device_init)
+                         device_init=device_init, robust=robust)
         if self.filter not in ("auto", "info", "ss"):
             raise ValueError(
                 f"sharded filter must be 'auto', 'info' or 'ss'; "
@@ -476,6 +526,7 @@ class ShardedBackend(TPUBackend):
     def run_em(self, Y, mask, p0, model, max_iters, tol, callback):
         from .estim.em import EMConfig
         from .parallel.sharded import ShardedEM, sharded_em_fit
+        self._last_health = None
         # debug: the checkify float checks wrap the whole shard_map program
         # (parallel.sharded._sharded_em_*_checked_impl) — a poisoned shard
         # raises a LOCATED error through the psum, same contract as the
@@ -512,9 +563,14 @@ class ShardedBackend(TPUBackend):
             def scan_fn(Yj, p, n, mask=None, cfg=None):
                 return drv.run_scan(p, n)
 
+            controls = None
+            if _resolve_policy(self.robust) is not None:
+                from .robust.controls import ShardedControls
+                controls = ShardedControls(drv)
             p, lls, converged, p_iters = self._run_em_chunked(
                 drv.Y, drv.mask, drv.p, drv.cfg, max_iters, tol,
-                self._unpad_callback(callback, drv), scan_fn)
+                self._unpad_callback(callback, drv), scan_fn,
+                controls=controls)
             drv.p, drv.p_iters = p, p_iters
             pn = drv.params_numpy()
         self._drv, self._drv_params = drv, pn
@@ -696,7 +752,8 @@ def fit(model,                     # DynamicFactorModel | family spec
         callback: Optional[Callable] = None,       # MFParams / TVLParams)
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 10,
-        debug: bool = False):
+        debug: bool = False,
+        robust=None):
     """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
 
     ``model`` may also be a family spec — ``MixedFreqSpec``, ``TVLSpec``,
@@ -722,6 +779,14 @@ def fit(model,                     # DynamicFactorModel | family spec
         (NaNs in Y itself are treated as missing data, not poison — poison
         means non-finite values the mask logic cannot see, e.g. a bad
         ``init`` or a data bug reintroducing inf after masking.)
+    robust : health-monitored EM (``robust.guard``) override for THIS fit:
+        ``True`` (default ``RobustPolicy``), a ``RobustPolicy`` instance,
+        or ``False`` (legacy unguarded loop).  ``None`` keeps the backend
+        instance's own setting (JAX backends default to guarded).  When
+        the policy's ``on_failure="cpu"``, a fit whose recovery budget is
+        exhausted (e.g. persistent device dispatch failures) re-runs from
+        the last good params on the NumPy f64 oracle instead of raising;
+        ``FitResult.health`` records everything the guard saw/did.
     """
     family = _family_fit(model, Y, mask, backend, max_iters, tol, init,
                          callback, checkpoint_path, debug)
@@ -737,6 +802,11 @@ def fit(model,                     # DynamicFactorModel | family spec
         raise ValueError(f"n_factors={model.n_factors} exceeds min(T, N)={min(T, N)}")
     if T < 2 and model.dynamics == "ar1":
         raise ValueError("ar1 dynamics needs T >= 2 (the M-step divides by T-1)")
+    from .utils.data import validate_panel
+    # Fail fast with column indices instead of NaN/Inf panels downstream
+    # (all-NaN columns have undefined stats; constant columns explode the
+    # standardization scale floor).
+    validate_panel(Y, mask, check_variance=model.standardize)
 
     b = get_backend(backend)
     std: Optional[Standardizer] = None
@@ -774,6 +844,11 @@ def fit(model,                     # DynamicFactorModel | family spec
         fingerprint = data_fingerprint(Y, W if any_missing else None, model)
     if init is None and checkpoint_path is not None:
         from .utils.checkpoint import load_checkpoint
+        # Fingerprint mismatch -> cold start with the FULL iteration
+        # budget (a checkpoint from foreign data must never warm-start the
+        # fit; pinned by tests/test_select_eval.py).  Callers who want the
+        # mismatch to fail loudly call load_checkpoint(on_mismatch="raise")
+        # themselves.
         ck = load_checkpoint(checkpoint_path, fingerprint=fingerprint)
         if ck is not None and ck[0].Lam.shape == (N, model.n_factors):
             init = ck[0]
@@ -797,6 +872,19 @@ def fit(model,                     # DynamicFactorModel | family spec
             warnings.warn(
                 f"backend {b.name!r} has no debug (checkify) mode; "
                 "running unchecked", RuntimeWarning, stacklevel=2)
+    # robust only toggles THIS fit, same transient contract as debug
+    # (user-supplied backend instances are restored on exit).  The CPU
+    # oracle has no guarded loop — robust= is a no-op there.
+    restore_robust = None
+    if robust is not None and hasattr(b, "robust"):
+        restore_robust = (b.robust,)
+        b.robust = robust
+    restore_gck = None
+    if checkpoint_path is not None and hasattr(b, "_guard_checkpoint"):
+        # Let the guard save the last GOOD params before declaring failure
+        # (resume seam: the next run warm-starts past the trouble).
+        restore_gck = (b._guard_checkpoint,)
+        b._guard_checkpoint = (checkpoint_path, fingerprint, done_iters)
 
     history: list = []
     t_prev = time.perf_counter()
@@ -822,14 +910,42 @@ def fit(model,                     # DynamicFactorModel | family spec
 
     _cb.wants_params_iter = True
 
+    smooth_b = b
+    health = None
     try:
         if ck is not None and done_iters >= max_iters:
             # The checkpoint already exhausted this budget: return its state
             # instead of creeping past max_iters one iteration per rerun.
             params, lls, converged = init, np.asarray(ck[2]), ck[3]
         else:
-            out = b.run_em(Yz, Wm, init, model, max_iters - done_iters, tol,
-                           _cb)
+            try:
+                out = b.run_em(Yz, Wm, init, model, max_iters - done_iters,
+                               tol, _cb)
+                health = getattr(b, "_last_health", None)
+            except Exception as e:
+                from .robust.guard import GuardFailure
+                pol = (_resolve_policy(getattr(b, "robust", None))
+                       if isinstance(e, GuardFailure) else None)
+                if pol is None or pol.on_failure != "cpu":
+                    raise
+                # Graceful degradation: the guard exhausted its recovery
+                # budget — re-run the REMAINING iterations from the last
+                # good params on the NumPy f64 oracle.  Everything the
+                # guard saw (and this fallback) is in FitResult.health.
+                health = e.health
+                health.fallback_backend = "cpu"
+                warm = e.last_good if e.last_good is not None else init
+                remaining = max(max_iters - done_iters - e.p_iters, 1)
+                smooth_b = CPUBackend()
+                cpu_out = smooth_b.run_em(
+                    np.asarray(Yz, np.float64), Wm, warm, model, remaining,
+                    tol, _cb)
+                cpu_piters = (cpu_out[3] if len(cpu_out) > 3
+                              else len(cpu_out[1]))
+                out = (cpu_out[0],
+                       np.concatenate([e.lls[:e.p_iters],
+                                       np.asarray(cpu_out[1])]),
+                       cpu_out[2], e.p_iters + cpu_piters)
             params, lls, converged = out[:3]
             # Built-in backends report how many EM updates the returned
             # params embody (!= len(lls) after a divergence or mid-chunk
@@ -841,15 +957,21 @@ def fit(model,                     # DynamicFactorModel | family spec
                                 done_iters + p_iters,
                                 [h["loglik"] for h in history],
                                 fingerprint=fingerprint, converged=converged)
-        x_sm, P_sm = b.smooth(Yz, Wm, params)
+        x_sm, P_sm = smooth_b.smooth(
+            Yz if smooth_b is b else np.asarray(Yz, np.float64), Wm, params)
     finally:
         if restore_debug is not None:
             b.debug = restore_debug
+        if restore_robust is not None:
+            b.robust = restore_robust[0]
+        if restore_gck is not None:
+            b._guard_checkpoint = restore_gck[0]
     return FitResult(params=params, logliks=np.asarray(lls),
                      factors=x_sm, factor_cov=P_sm,
                      converged=bool(converged), n_iters=len(lls),
-                     standardizer=std, model=model, backend=b.name,
-                     history=history)
+                     standardizer=std, model=model,
+                     backend=smooth_b.name if smooth_b is not b else b.name,
+                     history=history, health=health)
 
 
 def forecast(result, horizon: int):

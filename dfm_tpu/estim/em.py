@@ -326,7 +326,7 @@ def run_em_loop(step, max_iters: int, tol: float, callback=None,
 
 def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                    noise_floor: float, callback=None, fused_chunk: int = 8,
-                   ss_tau=None):
+                   ss_tau=None, monitor=None):
     """Shared fused-chunk EM driver (single-device, sharded, and MF fits).
 
     ``scan_fn(p, n) -> (p_new, logliks (n,), ss_deltas (n,) | None)`` runs n
@@ -344,7 +344,16 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
 
     ``ss_tau``: when set, ss freeze deltas (up to the stop) feed
     ``warn_ss_delta`` with this tau.  Returns (p, lls, converged, p_iters).
+
+    ``monitor``: a ``robust.ChunkMonitor`` switches to the health-monitored
+    twin of this loop (same contract; adds between-chunk recovery and
+    escalation — see ``robust.guard``).  None keeps the legacy loop below.
     """
+    if monitor is not None:
+        from ..robust.guard import guarded_run_em_chunked
+        return guarded_run_em_chunked(
+            scan_fn, p0, max_iters, tol, noise_floor, callback=callback,
+            fused_chunk=fused_chunk, ss_tau=ss_tau, monitor=monitor)
     import numpy as np
     fused_chunk = max(1, int(fused_chunk))   # 0/negative would never advance
     pass_piter = getattr(callback, "wants_params_iter", False)

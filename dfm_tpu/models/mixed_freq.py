@@ -257,7 +257,11 @@ def mf_loglik_eval(Y, mask, p: MFParams, spec: MixedFreqSpec,
     # 2026-07; see ``info_filter._loglik_eval_impl``), while this
     # fit-shaped program is the one every S3 run already compiles.
     Yj = jnp.asarray(Y)
-    mj = jnp.asarray(mask, Yj.dtype)
+    # A fully-observed panel legitimately reaches here with mask=None
+    # (ADVICE r5 finding #1): the E-step program is mask-shaped, so feed
+    # it an all-ones mask rather than crashing in asarray(None).
+    mj = (jnp.asarray(mask, Yj.dtype) if mask is not None
+          else jnp.ones_like(Yj))
     _, ll = mf_em_step(Yj, mj, p.astype(Yj.dtype), spec)
     return float(ll)
 
@@ -349,6 +353,7 @@ class MFResult:
     state_T: np.ndarray = None       # (m,) smoothed augmented state at T
     state_cov_T: np.ndarray = None   # (m, m)
     standardizer: object = None      # utils.data.Standardizer or None
+    health: object = None            # robust.FitHealth (trace-level)
 
     @property
     def loglik(self):
@@ -438,8 +443,10 @@ def mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
     common = x_sm @ np.asarray(aug.Lam, np.float64).T
     if std is not None:
         common = std.inverse(common)
+    from ..robust.health import health_from_trace
     return MFResult(params=p, logliks=np.asarray(lls),
                     factors=x_sm[:, :k], factor_cov=P_sm[:, :k, :k],
                     nowcast=common, converged=converged, spec=spec,
                     state_T=x_sm[-1], state_cov_T=P_sm[-1],
-                    standardizer=std)
+                    standardizer=std,
+                    health=health_from_trace(lls, floor))

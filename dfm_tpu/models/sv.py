@@ -346,6 +346,7 @@ class SVFit:
     h_smooth: np.ndarray = None  # (T, k) FFBS-smoothed log-vol means
     logliks: np.ndarray = None   # per-SV-iteration marginal logliks
     standardizer: object = None  # utils.data.Standardizer from the pre-fit
+    health: object = None        # robust.FitHealth trace record
 
 
 def sv_forecast(fit: SVFit, horizon: int):
@@ -476,6 +477,7 @@ def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
     # path pure); the smoothed proxy is then the filtered h mean.
     h_smooth = np.asarray(jnp.mean(H, axis=1) if H is not None
                           else res.h_mean, np.float64)
+    from ..robust.health import health_from_trace
     return SVFit(params=pre.params, result=res,
                  vol_paths=np.exp(0.5 * h_smooth),
                  loglik=logliks[-1],
@@ -483,4 +485,7 @@ def sv_fit(Y: np.ndarray, spec: SVSpec, em_iters: int = 20,
                  h_center=np.asarray(h_center, np.float64),
                  h_smooth=h_smooth,
                  logliks=np.asarray(logliks),
-                 standardizer=pre.standardizer)
+                 standardizer=pre.standardizer,
+                 # MC particle logliks are noisy by construction: record only
+                 # non-finite values, never count monotonicity "violations".
+                 health=health_from_trace(logliks, noise_floor=np.inf))

@@ -336,6 +336,7 @@ class TVLResult:
     common: np.ndarray         # (T, N) fitted common component
     converged: bool
     spec: TVLSpec
+    health: object = None      # robust.FitHealth trace record
 
     @property
     def loglik(self):
@@ -421,9 +422,10 @@ def tvl_fit(Y: np.ndarray, spec: TVLSpec,
                 Yj, Wj_arg, carry[0], carry[1], spec, Wj is not None, n)
             return (Lam_c, p_c), lls, None
 
+        floor = noise_floor_for(dtype, Yj.size)
         (Lam_t, p), lls, converged, _ = run_em_chunked(
             scan_fn, (Lam_t, p), spec.n_rounds, spec.tol,
-            noise_floor_for(dtype, Yj.size), cb, fused_chunk)
+            floor, cb, fused_chunk)
 
         # Final A-pass at the final state: the fused rounds never
         # materialize the factor path, and this keeps factors consistent
@@ -432,8 +434,10 @@ def tvl_fit(Y: np.ndarray, spec: TVLSpec,
 
     common = np.einsum("tnk,tk->tn", np.asarray(Lam_t, np.float64),
                        np.asarray(F, np.float64))
+    from ..robust.health import health_from_trace
     return TVLResult(params=p,
                      loadings=np.asarray(Lam_t, np.float64),
                      factors=np.asarray(F, np.float64),
                      logliks=np.asarray(lls), common=common,
-                     converged=converged, spec=spec)
+                     converged=converged, spec=spec,
+                     health=health_from_trace(lls, floor))

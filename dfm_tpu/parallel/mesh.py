@@ -20,9 +20,26 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["SERIES_AXIS", "make_mesh", "pad_panel", "unpad_rows"]
+__all__ = ["SERIES_AXIS", "make_mesh", "pad_panel", "unpad_rows", "shard_map"]
 
 SERIES_AXIS = "series"
+
+
+def shard_map(body, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=...)``; older releases
+    only have ``jax.experimental.shard_map.shard_map(..., check_rep=...)``.
+    Replication checking is off either way: the per-shard bodies reduce with
+    explicit psums and several outputs are only replicated post-collective,
+    which the static checker cannot prove.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:

@@ -42,7 +42,7 @@ from ..ssm.info_filter import (ObsStats, obs_stats, info_scan, quad_expanded,
                                quad_local, u_from_stats, loglik_from_terms)
 from ..ssm.kalman import rts_smoother
 from ..ssm.params import SSMParams, FilterResult
-from .mesh import SERIES_AXIS, make_mesh, pad_panel, unpad_rows
+from .mesh import shard_map, SERIES_AXIS, make_mesh, pad_panel, unpad_rows
 
 __all__ = ["sharded_em_step", "sharded_em_fit", "sharded_em_scan",
            "sharded_filter_smoother", "ShardedEM"]
@@ -155,12 +155,11 @@ def _sharded_em_step_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
             gate_s if has_gate else None, Ysq_s, sumsq_s)
         return p_new, ll, delta
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS),
                   P(SERIES_AXIS), _param_specs()),
-        out_specs=(_param_specs(), P(), P()),
-        check_vma=False)
+        out_specs=(_param_specs(), P(), P()))
     if mask is None:
         mask = jnp.ones_like(Y)  # placeholder; body ignores it when !has_mask
     if gate is None:
@@ -194,12 +193,11 @@ def _sharded_em_scan_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
         p_f, (lls, deltas) = lax.scan(it, p_s, None, length=n_iters)
         return p_f, lls, deltas
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS),
                   P(SERIES_AXIS), _param_specs()),
-        out_specs=(_param_specs(), P(), P()),
-        check_vma=False)
+        out_specs=(_param_specs(), P(), P()))
     if mask is None:
         mask = jnp.ones_like(Y)
     if gate is None:
@@ -252,12 +250,11 @@ def _sharded_smooth_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
             gate_s=gate_s if has_gate else None)
         return sm.x_sm, sm.P_sm, kf.loglik
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS),
                   P(SERIES_AXIS), _param_specs()),
-        out_specs=(P(), P(), P()),
-        check_vma=False)
+        out_specs=(P(), P(), P()))
     if mask is None:
         mask = jnp.ones_like(Y)
     if gate is None:
@@ -364,6 +361,24 @@ class ShardedEM:
             mu0=np.asarray(p.mu0, np.float64),
             P0=np.asarray(p.P0, np.float64))
 
+    def params_device(self, p_np) -> SSMParams:
+        """Inverse of ``params_numpy``: re-pad a host params pytree and put
+        it back on the device (zero loading rows / unit variances for the
+        padded series — the same no-contribution contract as ``pad_panel``).
+        The robustness guard uses this to restore or repair params between
+        fused chunks."""
+        dt = self.Y.dtype
+        Lam = np.asarray(p_np.Lam, np.float64)
+        R = np.asarray(p_np.R, np.float64)
+        if self.n_pad:
+            k = Lam.shape[1]
+            Lam = np.concatenate([Lam, np.zeros((self.n_pad, k))], axis=0)
+            R = np.concatenate([R, np.ones(self.n_pad)], axis=0)
+        return SSMParams(
+            Lam=jnp.asarray(Lam, dt), A=jnp.asarray(p_np.A, dt),
+            Q=jnp.asarray(p_np.Q, dt), R=jnp.asarray(R, dt),
+            mu0=jnp.asarray(p_np.mu0, dt), P0=jnp.asarray(p_np.P0, dt))
+
 
 def _sharded_cfg(cfg: EMConfig) -> EMConfig:
     return cfg if cfg.filter == "ss" else dataclasses.replace(cfg,
@@ -396,11 +411,27 @@ def sharded_filter_smoother(Y, p, mask=None, mesh=None):
 
 def sharded_em_fit(Y, p0, mask=None, mesh=None, cfg: EMConfig = EMConfig(),
                    max_iters: int = 50, tol: float = 1e-6, dtype=jnp.float32,
-                   callback=None, Y_dev=None):
+                   callback=None, Y_dev=None,
+                   matmul_precision: str = "highest"):
     """EM driver over the mesh; mirrors ``estim.em.em_fit``'s contract,
     including the callback receiving the (unpadded) params the loglik was
     evaluated at.  Returns (params, logliks, converged, driver).
-    ``Y_dev``: see ``ShardedEM``."""
+    ``Y_dev``: see ``ShardedEM``.
+
+    ``matmul_precision`` defaults to "highest" like every standalone fit
+    driver: the MXU's bf16 input rounding at the default setting costs
+    ~1e-4 relative loglik — outside the 1e-5 oracle contract (docs/PERF.md
+    item 2).  ``ShardedBackend`` already wraps this call in its own
+    precision context; direct callers get the same protection here.
+    """
+    import jax
+    with jax.default_matmul_precision(matmul_precision):
+        return _sharded_em_fit_body(Y, p0, mask, mesh, cfg, max_iters, tol,
+                                    dtype, callback, Y_dev)
+
+
+def _sharded_em_fit_body(Y, p0, mask, mesh, cfg, max_iters, tol, dtype,
+                         callback, Y_dev):
     drv = ShardedEM(Y, p0, mask=mask, mesh=mesh, dtype=dtype, cfg=cfg,
                     Y_dev=Y_dev)
 

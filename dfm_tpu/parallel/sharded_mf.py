@@ -26,7 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..estim.em import run_em_chunked
 from ..models.mixed_freq import (MFParams, MFResult, MixedFreqSpec,
                                  augment, mf_em_core, mf_pca_init)
-from .mesh import SERIES_AXIS, make_mesh
+from .mesh import shard_map, SERIES_AXIS, make_mesh
 
 __all__ = ["sharded_mf_fit"]
 
@@ -66,13 +66,12 @@ def _sharded_mf_step_impl(Ym, Wm, Yq, Wq, Lam_m, Lam_q, Rm, Rq,
 
     col = P(None, SERIES_AXIS)
     row = P(SERIES_AXIS, None)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(col, col, col, col, row, row, P(SERIES_AXIS),
                   P(SERIES_AXIS), P(), P(), P(), P()),
         out_specs=(row, row, P(SERIES_AXIS), P(SERIES_AXIS),
-                   P(), P(), P(), P(), P(), P(), P()),
-        check_vma=False)
+                   P(), P(), P(), P(), P(), P(), P()))
     return mapped(Ym, Wm, Yq, Wq, Lam_m, Lam_q, Rm, Rq, A, Q, mu0, P0)
 
 
@@ -106,13 +105,12 @@ def _sharded_mf_scan_impl(Ym, Wm, Yq, Wq, params, mesh: Mesh,
 
     col = P(None, SERIES_AXIS)
     row = P(SERIES_AXIS, None)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(col, col, col, col, row, row, P(SERIES_AXIS),
                   P(SERIES_AXIS), P(), P(), P(), P()),
         out_specs=(row, row, P(SERIES_AXIS), P(SERIES_AXIS),
-                   P(), P(), P(), P(), P()),
-        check_vma=False)
+                   P(), P(), P(), P(), P()))
     out = mapped(Ym, Wm, Yq, Wq, *params)
     return out[:8], out[8]
 
@@ -192,9 +190,9 @@ def sharded_mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
                 Ymj, Wmj, Yqj, Wqj, pt, mesh, spec_local, n)
             return pt_new, lls, None
 
+        floor = noise_floor_for(dtype, Y.size)
         params, lls, converged, _ = run_em_chunked(
-            scan_fn, params, max_iters, tol,
-            noise_floor_for(dtype, Y.size), cb, fused_chunk)
+            scan_fn, params, max_iters, tol, floor, cb, fused_chunk)
 
         # The fused chunks never materialize smoothers; run one E-pass at
         # the final params for the reported factors/nowcast.
@@ -208,8 +206,10 @@ def sharded_mf_fit(Y: np.ndarray, spec: MixedFreqSpec,
     common = x_sm @ np.asarray(aug.Lam, np.float64).T
     if std is not None:
         common = std.inverse(common)
+    from ..robust.health import health_from_trace
     return MFResult(params=p_final, logliks=np.asarray(lls),
                     factors=x_sm[:, :k], factor_cov=P_sm[:, :k, :k],
                     nowcast=common, converged=converged, spec=spec,
                     state_T=x_sm[-1], state_cov_T=P_sm[-1],
-                    standardizer=std)
+                    standardizer=std,
+                    health=health_from_trace(lls, floor))

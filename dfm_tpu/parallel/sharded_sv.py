@@ -43,7 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.sv import (SVSpec, SVResult, _rbpf_scan, _as_sigma_vec,
                          _host_lls)
 from ..ssm.params import SSMParams
-from .mesh import SERIES_AXIS, make_mesh, pad_panel
+from .mesh import shard_map, SERIES_AXIS, make_mesh, pad_panel
 
 __all__ = ["sharded_sv_filter"]
 
@@ -68,12 +68,11 @@ def _sharded_sv_impl(Y, Lam, R, A, mu0, P0, h_center, sigma_h, h0_scale, key,
     rep = P()
     # _rbpf_scan always returns a 7-tuple; the last two entries are None
     # when store_paths=False (leafless subtrees — any spec matches).
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, SERIES_AXIS), P(SERIES_AXIS, None), P(SERIES_AXIS),
                   rep, rep, rep, rep, rep, rep, rep),
-        out_specs=(rep,) * 7,
-        check_vma=False)
+        out_specs=(rep,) * 7)
     return mapped(Y, Lam, R, A, mu0, P0, h_center, sigma_h, h0_scale, key)
 
 

@@ -22,7 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..estim.em import run_em_chunked, noise_floor_for
 from ..models.tv_loadings import (TVLParams, TVLResult, TVLSpec,
                                   factor_pass_tv, tvl_round_core)
-from .mesh import SERIES_AXIS, make_mesh
+from .mesh import shard_map, SERIES_AXIS, make_mesh
 
 __all__ = ["sharded_tvl_fit"]
 
@@ -52,14 +52,13 @@ def _sharded_tvl_scan_impl(Y, W, carry, mu0, P0, mesh: Mesh, spec: TVLSpec,
         return c_f + (lls,)
 
     col = P(None, SERIES_AXIS)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(col, col, P(None, SERIES_AXIS, None),
                   P(SERIES_AXIS, None), P(SERIES_AXIS), P(SERIES_AXIS),
                   P(), P(), P(), P()),
         out_specs=(P(None, SERIES_AXIS, None), P(SERIES_AXIS, None),
-                   P(SERIES_AXIS), P(SERIES_AXIS), P(), P(), P()),
-        check_vma=False)
+                   P(SERIES_AXIS), P(SERIES_AXIS), P(), P(), P()))
     out = mapped(Y, W, *carry, mu0, P0)
     return out[:6], out[6]
 
@@ -77,13 +76,12 @@ def _sharded_tvl_factors_impl(Y, W, Lam_t, Lam0, tau2, R, A, Q, mu0, P0,
         return sm.x_sm
 
     col = P(None, SERIES_AXIS)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(col, col, P(None, SERIES_AXIS, None),
                   P(SERIES_AXIS, None), P(SERIES_AXIS), P(SERIES_AXIS),
                   P(), P(), P(), P()),
-        out_specs=P(),
-        check_vma=False)
+        out_specs=P())
     return mapped(Y, W, Lam_t, Lam0, tau2, R, A, Q, mu0, P0)
 
 
@@ -168,9 +166,10 @@ def sharded_tvl_fit(Y: np.ndarray, spec: TVLSpec,
                                                 mesh, spec, n)
             return c_new, lls, None
 
+        floor = noise_floor_for(dtype, Yj.size)
         carry, lls, converged, _ = run_em_chunked(
             scan_fn, carry, spec.n_rounds, spec.tol,
-            noise_floor_for(dtype, Yj.size), cb, fused_chunk)
+            floor, cb, fused_chunk)
 
         # Final A-pass at the final state (factors consistent with the
         # returned loadings/params — same semantics as tvl_fit).
@@ -179,6 +178,8 @@ def sharded_tvl_fit(Y: np.ndarray, spec: TVLSpec,
 
     Lam_t = np.asarray(carry[0], np.float64)[:, :N]
     common = np.einsum("tnk,tk->tn", Lam_t, F)
+    from ..robust.health import health_from_trace
     return TVLResult(params=unpad_params(carry), loadings=Lam_t, factors=F,
                      logliks=np.asarray(lls), common=common,
-                     converged=converged, spec=spec)
+                     converged=converged, spec=spec,
+                     health=health_from_trace(lls, floor))

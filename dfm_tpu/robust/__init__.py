@@ -1,0 +1,28 @@
+"""Guarded fit: health-monitored chunked EM with automatic recovery.
+
+The chunked EM drivers dispatch one fused XLA program per chunk and only
+see the loglik trace on the host between dispatches — exactly the place a
+health monitor can live without touching the hot path.  This package
+supplies that monitor:
+
+- ``health``  — ``FitHealth`` / ``HealthEvent`` records attached to results.
+- ``guard``   — ``RobustPolicy`` (knobs), ``GuardControls`` (backend hooks),
+  ``guarded_run_em_chunked`` (the monitored loop ``estim.em.run_em_chunked``
+  delegates to when a monitor is passed), ``GuardFailure`` (carries the last
+  good params out for graceful degradation).
+- ``faults``  — deterministic fault injection for testing every recovery
+  path on the fake CPU mesh (NaN-poisoned chunks, dispatch exceptions,
+  non-PSD parameter corruption, forced freeze drift).
+"""
+
+from .health import FitHealth, HealthEvent, health_from_trace
+from .guard import (ChunkMonitor, GuardControls, GuardFailure, RobustPolicy,
+                    check_param_health, guarded_run_em_chunked, repair_params)
+from .faults import FaultInjector, InjectedDispatchError
+
+__all__ = [
+    "FitHealth", "HealthEvent", "health_from_trace",
+    "ChunkMonitor", "GuardControls", "GuardFailure", "RobustPolicy",
+    "check_param_health", "guarded_run_em_chunked", "repair_params",
+    "FaultInjector", "InjectedDispatchError",
+]

@@ -1,0 +1,109 @@
+"""Backend-specific ``GuardControls`` (the guard's escalation hooks).
+
+``TPUControls`` rebuilds the single-device fused chunk program
+(``estim.em.em_fit_scan``) under a new engine/precision; ``ShardedControls``
+drives the same escalations through a ``parallel.sharded.ShardedEM`` (whose
+``run_scan`` re-reads ``drv.cfg`` per dispatch, so swapping the config IS
+the rebuild — padding and device placement are handled by the driver's
+``params_device``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .guard import GuardControls
+
+__all__ = ["TPUControls", "ShardedControls"]
+
+
+class TPUControls(GuardControls):
+    """Escalation hooks for ``api.TPUBackend``'s chunked driver."""
+
+    def __init__(self, Yj, mj, cfg, em_fit_scan):
+        self.Yj = Yj
+        self.mj = mj
+        self.cfg = cfg
+        self.em_fit_scan = em_fit_scan
+
+    def params_device(self, p_np):
+        from ..ssm.params import SSMParams as JaxParams
+        return JaxParams.from_numpy(p_np, dtype=self.Yj.dtype)
+
+    def _scan(self):
+        Yj, mj, cfg, em = self.Yj, self.mj, self.cfg, self.em_fit_scan
+
+        def scan_fn(p, n):
+            p_new, lls, deltas = em(Yj, p, n, mask=mj, cfg=cfg)
+            return p_new, lls, (deltas if cfg.filter == "ss" else None)
+
+        return scan_fn
+
+    def rebuild(self, action: str, p_np):
+        import jax
+        import jax.numpy as jnp
+        if action == "remeasure_tau" and self.cfg.filter == "ss":
+            from ..ssm.steady import remeasure_tau
+            new_tau = remeasure_tau(p_np, self.cfg.tau)
+            if new_tau <= self.cfg.tau:
+                return None     # longer freeze horizon cannot help
+            self.cfg = dataclasses.replace(self.cfg, tau=new_tau)
+            return self._scan(), self.params_device(p_np), {
+                "ss_tau": new_tau}
+        if action == "fallback_info" and self.cfg.filter == "ss":
+            self.cfg = dataclasses.replace(self.cfg, filter="info")
+            return self._scan(), self.params_device(p_np), {"ss_tau": None}
+        if action == "loglik_f64":
+            if (not jax.config.jax_enable_x64
+                    or self.Yj.dtype == jnp.float64):
+                return None
+            from ..estim.em import noise_floor_for
+            self.Yj = self.Yj.astype(jnp.float64)
+            if self.mj is not None:
+                self.mj = self.mj.astype(jnp.float64)
+            nf = noise_floor_for(np.float64, self.Yj.size,
+                                 mult=self.cfg.noise_floor_mult)
+            return self._scan(), self.params_device(p_np), {
+                "noise_floor": nf}
+        return None
+
+
+class ShardedControls(GuardControls):
+    """Escalation hooks for ``api.ShardedBackend`` via its ``ShardedEM``."""
+
+    def __init__(self, drv):
+        self.drv = drv
+
+    def params_numpy(self, p):
+        return self.drv.params_numpy(p)
+
+    def params_device(self, p_np):
+        return self.drv.params_device(p_np)
+
+    def _scan(self):
+        drv = self.drv
+
+        def scan_fn(p, n):
+            return drv.run_scan(p, n)
+
+        return scan_fn
+
+    def rebuild(self, action: str, p_np):
+        drv = self.drv
+        if action == "remeasure_tau" and drv.cfg.filter == "ss":
+            from ..ssm.steady import remeasure_tau
+            new_tau = remeasure_tau(p_np, drv.cfg.tau)
+            if new_tau <= drv.cfg.tau:
+                return None
+            drv.cfg = dataclasses.replace(drv.cfg, tau=new_tau)
+            return self._scan(), drv.params_device(p_np), {
+                "ss_tau": new_tau}
+        if action == "fallback_info" and drv.cfg.filter == "ss":
+            drv.cfg = dataclasses.replace(drv.cfg, filter="info")
+            return self._scan(), drv.params_device(p_np), {"ss_tau": None}
+        # f64 loglik escalation is not offered under sharding: the panel,
+        # params and every shard_map program would need re-materializing in
+        # a second dtype — the info fallback is the sharded escape hatch.
+        return None

@@ -1,0 +1,96 @@
+"""Deterministic fault injection for the guarded EM loop.
+
+An injector wraps a chunk ``scan_fn`` (via ``RobustPolicy.wrap_scan``) and
+perturbs specific dispatches by CALL INDEX, so every recovery path is
+reproducible on the fake CPU mesh without real hardware faults:
+
+- ``nan_chunk(at)``           — poison the logliks of dispatch #at with NaN
+- ``dispatch_failure(at, count)`` — raise ``InjectedDispatchError`` for
+  ``count`` consecutive dispatches starting at #at (count=-1: forever)
+- ``nonpsd_params(at)``       — corrupt the returned Q to non-PSD
+- ``freeze_drift(at, count, delta)`` — force the reported ss freeze deltas
+  above threshold for ``count`` dispatches
+
+Call indices count EVERY dispatch the guard makes (including retries and
+replays), which is what makes one-shot faults recoverable: the retry is a
+new call index and passes clean.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+__all__ = ["InjectedDispatchError", "FaultInjector"]
+
+
+class InjectedDispatchError(RuntimeError):
+    """Stands in for an axon tunnel / PJRT dispatch failure."""
+
+
+class FaultInjector:
+    def __init__(self):
+        self.calls = 0
+        self.log: List[Tuple[int, str]] = []
+        self._faults: Dict[int, List[tuple]] = {}
+        self._persistent_fail_from = None
+
+    def _plan(self, at: int, fault: tuple) -> "FaultInjector":
+        self._faults.setdefault(int(at), []).append(fault)
+        return self
+
+    def nan_chunk(self, at: int) -> "FaultInjector":
+        return self._plan(at, ("nan",))
+
+    def dispatch_failure(self, at: int, count: int = 1) -> "FaultInjector":
+        if count < 0:
+            self._persistent_fail_from = int(at)
+            return self
+        for j in range(count):
+            self._plan(at + j, ("raise",))
+        return self
+
+    def nonpsd_params(self, at: int) -> "FaultInjector":
+        return self._plan(at, ("nonpsd",))
+
+    def freeze_drift(self, at: int, count: int = 1,
+                     delta: float = 1e-2) -> "FaultInjector":
+        for j in range(count):
+            self._plan(at + j, ("drift", delta))
+        return self
+
+    def wrap(self, scan_fn):
+        """The ``RobustPolicy.wrap_scan`` callable."""
+
+        def wrapped(p, n):
+            idx = self.calls
+            self.calls += 1
+            faults = list(self._faults.get(idx, ()))
+            if (self._persistent_fail_from is not None
+                    and idx >= self._persistent_fail_from):
+                faults.append(("raise",))
+            for f in faults:
+                if f[0] == "raise":
+                    self.log.append((idx, "raise"))
+                    raise InjectedDispatchError(
+                        f"injected dispatch failure at call {idx}")
+            p_new, lls, deltas = scan_fn(p, n)
+            for f in faults:
+                if f[0] == "nan":
+                    self.log.append((idx, "nan"))
+                    lls = np.full(np.shape(lls), np.nan)
+                elif f[0] == "nonpsd":
+                    self.log.append((idx, "nonpsd"))
+                    Qr = np.asarray(p_new.Q)
+                    Q = np.asarray(Qr, np.float64)
+                    Q = Q - 10.0 * np.eye(Q.shape[0])
+                    p_new = p_new._replace(Q=np.asarray(Q, Qr.dtype))
+                elif f[0] == "drift":
+                    self.log.append((idx, "drift"))
+                    deltas = np.full(
+                        np.shape(lls) if deltas is None else
+                        np.shape(deltas), float(f[1]))
+            return p_new, lls, deltas
+
+        return wrapped

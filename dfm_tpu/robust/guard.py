@@ -1,0 +1,457 @@
+"""Health-monitored chunked EM loop.
+
+``guarded_run_em_chunked`` mirrors ``estim.em.run_em_chunked`` exactly on
+the healthy path (same chunk replay, same stopping rule, same callback
+contract) and adds, strictly BETWEEN fused dispatches:
+
+- finite-loglik checks (the legacy ``em_progress`` treats NaN as
+  "continue" — silent NaN propagation), recorded always, with
+  restore-from-chunk-entry + bounded chunk retries when the policy opts
+  into ``recover_divergence=True``,
+- bounded retries + exponential backoff around the device dispatch itself
+  (axon tunnel errors / timeouts),
+- an escalation ladder driven by ``GuardControls``: re-measure ``tau`` /
+  fall back ``ss -> info`` when the steady-state freeze delta exceeds the
+  threshold (the correction ADVICE r5 finding #2 asked for, not a
+  warning), escalate the in-loop loglik to f64 when convergence stalls
+  inside the noise floor, and eigenvalue-clip + re-jitter on non-PSD
+  parameter pathologies.
+
+Nothing here runs per EM iteration and nothing touches the fused scan
+program: a clean fit executes the identical device workload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from ..backends.cpu_ref import SSMParams
+from .health import FitHealth, HealthEvent
+
+__all__ = ["RobustPolicy", "GuardControls", "ChunkMonitor", "GuardFailure",
+           "repair_params", "check_param_health", "guarded_run_em_chunked"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RobustPolicy:
+    """Knobs for the guarded loop (``fit(..., robust=RobustPolicy(...))``).
+
+    The defaults are tuned so a healthy fit behaves byte-for-byte like the
+    unguarded driver: no per-chunk parameter transfers
+    (``check_params="on_event"``), legacy stop semantics on divergence
+    (``recover_divergence=False``), and escalations only on observed
+    pathologies.
+    """
+
+    # Device-dispatch retry (tunnel errors, timeouts).
+    dispatch_retries: int = 3
+    backoff_base: float = 0.25          # seconds; doubles per attempt
+    backoff_factor: float = 2.0
+    retry_exceptions: tuple = (RuntimeError, OSError, TimeoutError,
+                               ConnectionError)   # XlaRuntimeError is a
+    #                                             # RuntimeError subclass
+    # Chunk-level recovery.
+    chunk_retries: int = 2              # NaN-chunk restore+retry budget
+    # False (default): legacy semantics — non-finite logliks sail through
+    # (recorded, not rewritten) and a diverged trace stops.  True:
+    # restore-from-chunk-entry + repair + retry on both.
+    recover_divergence: bool = False
+    # Steady-state freeze escalation (closes ADVICE #2).
+    freeze_threshold: float = 1e-4
+    freeze_action: str = "auto"         # auto | remeasure_tau
+    #                                   # | fallback_info | warn
+    # Stall escalation: this many consecutive chunks entirely inside the
+    # noise floor without meeting tol -> f64 in-loop loglik (if x64 is on).
+    stall_chunks: int = 2
+    escalate_f64: bool = True
+    # Parameter pathology checks.
+    psd_tol: float = 1e-10
+    r_floor: float = 1e-6
+    check_params: str = "on_event"      # on_event | always | never
+    # Terminal behaviour: "raise" propagates GuardFailure; "cpu" makes
+    # ``fit`` re-run from the last good params on the NumPy f64 oracle.
+    on_failure: str = "raise"
+    # Save the last good params here before declaring failure (resume seam).
+    checkpoint_path: Optional[str] = None
+    checkpoint_fingerprint: Optional[str] = None
+    iter_offset: int = 0                # checkpoint resume: iters already run
+    # Test seam: wraps the chunk scan_fn (fault injection lives here).
+    wrap_scan: Optional[Callable] = None
+
+
+class GuardControls:
+    """Backend hooks the guard escalates through.
+
+    The base class knows how to move an ``SSMParams`` pytree between
+    device and host; backends override ``rebuild`` to offer escalations.
+    ``rebuild(action, p_np)`` returns ``(scan_fn, p_device, updates)`` —
+    the new chunk program, the current params re-materialized for it, and
+    a dict that may update ``ss_tau`` / ``noise_floor`` — or ``None`` when
+    the action is unavailable (guard tries the next rung or records and
+    moves on).
+    """
+
+    def params_numpy(self, p) -> SSMParams:
+        return SSMParams(*(np.asarray(np.asarray(x), np.float64) for x in p))
+
+    def params_device(self, p_np: SSMParams):
+        return p_np
+
+    def rebuild(self, action: str, p_np: SSMParams):
+        return None
+
+
+@dataclasses.dataclass
+class ChunkMonitor:
+    """Bundle handed to ``run_em_chunked(..., monitor=...)``."""
+
+    policy: RobustPolicy
+    controls: GuardControls
+    health: FitHealth = dataclasses.field(default_factory=FitHealth)
+
+
+class GuardFailure(RuntimeError):
+    """All recovery exhausted.  Carries the last good (host) params and the
+    loglik trace so ``fit`` can degrade gracefully (``on_failure="cpu"``)."""
+
+    def __init__(self, msg: str, health: FitHealth,
+                 last_good: Optional[SSMParams], lls, p_iters: int):
+        super().__init__(msg)
+        self.health = health
+        self.last_good = last_good
+        self.lls = np.asarray(lls, np.float64)
+        self.p_iters = int(p_iters)
+
+
+def check_param_health(p_np: SSMParams, r_floor: float = 1e-6,
+                       psd_tol: float = 1e-10) -> list:
+    """Issues in a parameter pytree: nonfinite / nonpsd_{Q,P0} / r_floor."""
+    issues = []
+    leaves = (p_np.Lam, p_np.A, p_np.Q, p_np.R, p_np.mu0, p_np.P0)
+    if not all(np.all(np.isfinite(x)) for x in leaves):
+        issues.append("nonfinite")
+        return issues       # eigvalsh on NaN would raise
+    for name, M in (("Q", p_np.Q), ("P0", p_np.P0)):
+        if np.linalg.eigvalsh(0.5 * (M + M.T)).min() < -psd_tol:
+            issues.append(f"nonpsd_{name}")
+    if np.any(p_np.R <= r_floor * (1.0 + 1e-9)):
+        issues.append("r_floor")
+    return issues
+
+
+def repair_params(p_np: SSMParams, r_floor: float = 1e-6,
+                  jitter: float = 0.0) -> SSMParams:
+    """Project params back into the feasible set (host-side, f64).
+
+    Symmetrize + eigenvalue-clip Q and P0 to PSD (plus an optional jitter
+    ridge so a repeated Cholesky failure gets a progressively larger
+    re-jitter), floor R, and replace any non-finite entries with benign
+    identity-ish values.
+    """
+    def _psd(M, dim):
+        M = np.asarray(M, np.float64)
+        if not np.all(np.isfinite(M)):
+            return np.eye(dim)
+        M = 0.5 * (M + M.T)
+        w, V = np.linalg.eigh(M)
+        w = np.maximum(w, 0.0) + jitter
+        return (V * w) @ V.T
+
+    k = p_np.Q.shape[0]
+    Lam = np.asarray(p_np.Lam, np.float64)
+    Lam = np.where(np.isfinite(Lam), Lam, 0.0)
+    A = np.asarray(p_np.A, np.float64)
+    A = np.where(np.isfinite(A), A, 0.0)
+    R = np.asarray(p_np.R, np.float64)
+    R = np.where(np.isfinite(R), R, 1.0)
+    # Lift clear of the floor: exactly-at-floor entries still count as
+    # "pinned" in check_param_health.
+    R = np.maximum(R, 2.0 * r_floor)
+    mu0 = np.asarray(p_np.mu0, np.float64)
+    mu0 = np.where(np.isfinite(mu0), mu0, 0.0)
+    return SSMParams(Lam=Lam, A=A, Q=_psd(p_np.Q, k), R=R, mu0=mu0,
+                     P0=_psd(p_np.P0, k))
+
+
+def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
+                           noise_floor: float, callback=None,
+                           fused_chunk: int = 8, ss_tau=None,
+                           monitor: ChunkMonitor = None):
+    """Monitored twin of ``estim.em.run_em_chunked`` (same return tuple)."""
+    from ..estim.em import em_progress, warn_ss_delta
+
+    policy, controls, health = (monitor.policy, monitor.controls,
+                                monitor.health)
+    if policy.wrap_scan is not None:
+        scan_fn = policy.wrap_scan(scan_fn)
+
+    fused_chunk = max(1, int(fused_chunk))
+    pass_piter = getattr(callback, "wants_params_iter", False)
+    lls: list = []
+    converged = False
+    stop = False
+    target = 0
+    p = p0
+    it = 0
+    p_entry = p_entry_prev = p0
+    entry_it = entry_it_prev = 0
+    entry_floor = 0         # iteration of the last escalation: replay
+    #                       # cannot cross a scan_fn swap
+    chunk_idx = 0
+    stall_run = 0
+    done_actions: set = set()
+
+    def _fail(msg: str, cause=None):
+        try:
+            last_good = controls.params_numpy(p)
+        except Exception:
+            last_good = None
+        if policy.checkpoint_path and last_good is not None:
+            from ..utils.checkpoint import save_checkpoint
+            try:
+                save_checkpoint(policy.checkpoint_path, last_good,
+                                policy.iter_offset + it, lls,
+                                fingerprint=policy.checkpoint_fingerprint)
+            except Exception:
+                pass
+        err = GuardFailure(msg, health, last_good, lls, it)
+        if cause is not None:
+            raise err from cause
+        raise err
+
+    def _dispatch(fn, p_in, n):
+        """One chunk dispatch with bounded retry + exponential backoff.
+
+        The device->host transfers happen INSIDE the try: on the tunneled
+        device errors surface at the transfer, not the (async) dispatch.
+        """
+        delay = policy.backoff_base
+        attempt = 0
+        while True:
+            try:
+                p_out, chunk, deltas = fn(p_in, n)
+                chunk = np.asarray(chunk, np.float64)
+                if deltas is not None:
+                    deltas = np.asarray(deltas, np.float64)
+                return p_out, chunk, deltas
+            except policy.retry_exceptions as e:
+                if isinstance(e, GuardFailure):
+                    raise
+                health.n_dispatch_retries += 1
+                last = attempt >= policy.dispatch_retries
+                health.record(HealthEvent(
+                    chunk=chunk_idx, iteration=it, kind="dispatch_error",
+                    detail=f"{type(e).__name__}: {e}"[:200],
+                    action="abort" if last else "retried"))
+                if last:
+                    _fail(f"dispatch failed after "
+                          f"{policy.dispatch_retries} retries: {e}", e)
+                time.sleep(delay)
+                delay *= policy.backoff_factor
+                attempt += 1
+
+    def _apply_rebuild(action: str, reason_event: HealthEvent):
+        """Swap in an escalated chunk program; returns True on success."""
+        nonlocal scan_fn, p, ss_tau, noise_floor
+        nonlocal p_entry, p_entry_prev, entry_it, entry_it_prev, entry_floor
+        if action in done_actions:
+            return False
+        try:
+            p_np = controls.params_numpy(p)
+        except Exception:
+            return False
+        built = controls.rebuild(action, p_np)
+        if built is None:
+            return False
+        scan_fn, p, updates = built
+        if policy.wrap_scan is not None:
+            scan_fn = policy.wrap_scan(scan_fn)
+        if "ss_tau" in updates:
+            ss_tau = updates["ss_tau"]
+        if "noise_floor" in updates:
+            noise_floor = updates["noise_floor"]
+        done_actions.add(action)
+        health.escalate(action)
+        reason_event.action = action
+        # The new program starts a fresh replay window: stored entries
+        # belong to the old scan_fn.
+        p_entry = p_entry_prev = p
+        entry_it = entry_it_prev = it
+        entry_floor = it
+        return True
+
+    while it < max_iters and not stop:
+        n = min(fused_chunk, max_iters - it)
+        chunk = deltas = None
+        p_try = None
+        for attempt in range(policy.chunk_retries + 1):
+            p_try, chunk, deltas = _dispatch(scan_fn, p, n)
+            if np.all(np.isfinite(chunk)):
+                break
+            if not policy.recover_divergence:
+                # Legacy semantics (the default): ``em_progress`` treats
+                # NaN as "continue", so a poisoned fit sails through to a
+                # garbage loglik — pinned by tests/test_debug.py.  Record
+                # the pathology; don't rewrite the trajectory.
+                health.record(HealthEvent(
+                    chunk=chunk_idx, iteration=it, kind="nan_loglik",
+                    detail="non-finite loglik in chunk", action="none"))
+                break
+            ev = health.record(HealthEvent(
+                chunk=chunk_idx, iteration=it, kind="nan_loglik",
+                detail=f"non-finite loglik in chunk (attempt {attempt})",
+                action="restored"))
+            if attempt >= policy.chunk_retries:
+                if not _apply_rebuild("loglik_f64", ev):
+                    _fail("non-finite logliks persisted through "
+                          f"{policy.chunk_retries} chunk retries")
+                p_try, chunk, deltas = _dispatch(scan_fn, p, n)
+                if not np.all(np.isfinite(chunk)):
+                    _fail("non-finite logliks survived f64 escalation")
+                break
+            # Restore = do not advance past the chunk entry (p is the
+            # entry params); repair + re-jitter before retrying so a
+            # Cholesky-adjacent pathology doesn't reproduce the NaN.
+            p_np = controls.params_numpy(p)
+            issues = check_param_health(p_np, policy.r_floor, policy.psd_tol)
+            if issues:
+                health.record(HealthEvent(
+                    chunk=chunk_idx, iteration=it,
+                    kind=("nonfinite_params" if "nonfinite" in issues
+                          else "nonpsd"),
+                    detail=",".join(issues), action="repaired"))
+            p = controls.params_device(repair_params(
+                p_np, policy.r_floor, jitter=policy.psd_tol
+                * (10.0 ** attempt)))
+        p_entry_prev, entry_it_prev = p_entry, entry_it
+        p_entry, entry_it = p, it
+        p = p_try
+        consumed = n
+        chunk_escalated = False
+        for j, ll in enumerate(chunk):
+            lls.append(float(ll))
+            if callback is not None:
+                if pass_piter:
+                    callback(it + j, float(ll), p_entry,
+                             params_iter=entry_it)
+                else:
+                    callback(it + j, float(ll), p_entry)
+            if len(lls) >= 2 and lls[-2] - lls[-1] > noise_floor:
+                health.monotonicity_violations += 1
+            state = em_progress(lls, tol, noise_floor)
+            if state == "diverged" and policy.recover_divergence:
+                ev = health.record(HealthEvent(
+                    chunk=chunk_idx, iteration=it + j, kind="divergence",
+                    detail=f"drop {lls[-2] - lls[-1]:.3e}",
+                    action="restored"))
+                p = p_entry     # rebuild from the chunk entry, not the
+                #               # post-drop update
+                if _apply_rebuild("loglik_f64", ev):
+                    # Forget the divergent tail; continue from the chunk
+                    # entry with the escalated program.
+                    del lls[len(lls) - (j + 1):]
+                    consumed = 0
+                    chunk_escalated = True
+                    break
+                state = "diverged"      # escalation unavailable: legacy stop
+            if state != "continue":
+                converged = state == "converged"
+                if state == "diverged":
+                    health.record(HealthEvent(
+                        chunk=chunk_idx, iteration=it + j, kind="divergence",
+                        detail=f"drop {lls[-2] - lls[-1]:.3e}",
+                        action="stopped"))
+                target = len(lls) if converged else max(len(lls) - 2, 0)
+                target = max(target, entry_floor)
+                stop = True
+                consumed = j + 1
+                break
+        if chunk_escalated:
+            health.n_chunks += 1
+            chunk_idx += 1
+            continue        # it unchanged: redo the budget from the entry
+        # --- between-chunk health (host-side only) -----------------------
+        max_chunk_delta = 0.0
+        if deltas is not None and consumed:
+            max_chunk_delta = float(np.max(deltas[:consumed]))
+            health.max_ss_delta = max(health.max_ss_delta, max_chunk_delta)
+        it += n
+        health.n_chunks += 1
+        chunk_idx += 1
+        if stop:
+            break
+        # Freeze drift: correct, don't just warn (ADVICE #2).
+        if (max_chunk_delta > policy.freeze_threshold
+                and policy.freeze_action != "warn"):
+            ev = health.record(HealthEvent(
+                chunk=chunk_idx - 1, iteration=it, kind="freeze_drift",
+                detail=f"delta {max_chunk_delta:.3e} > "
+                       f"{policy.freeze_threshold:.0e}", action="warned"))
+            acted = False
+            if policy.freeze_action in ("auto", "remeasure_tau"):
+                acted = _apply_rebuild("remeasure_tau", ev)
+            if not acted and policy.freeze_action in ("auto",
+                                                      "fallback_info"):
+                acted = _apply_rebuild("fallback_info", ev)
+            if acted:
+                continue
+        # Stall: a whole chunk inside the noise floor without converging.
+        diffs = np.abs(np.diff(np.asarray(lls[-(n + 1):], np.float64)))
+        if len(diffs) and np.all(diffs <= max(noise_floor, 0.0)) and tol > 0:
+            stall_run += 1
+        else:
+            stall_run = 0
+        if stall_run >= policy.stall_chunks:
+            ev = health.record(HealthEvent(
+                chunk=chunk_idx - 1, iteration=it, kind="stall",
+                detail=f"{stall_run} chunks inside noise floor "
+                       f"{noise_floor:.3e}", action="none"))
+            if policy.escalate_f64 and _apply_rebuild("loglik_f64", ev):
+                stall_run = 0
+                continue
+            health.stalled = True
+            stall_run = 0
+        # Parameter pathology scan (costs one small transfer; off the
+        # healthy path unless check_params="always").
+        if (policy.check_params == "always"
+                or (policy.check_params == "on_event"
+                    and health.events
+                    and health.events[-1].chunk == chunk_idx - 1)):
+            p_np = controls.params_numpy(p)
+            issues = check_param_health(p_np, policy.r_floor,
+                                        policy.psd_tol)
+            if "r_floor" in issues:
+                health.r_floor_hits += 1
+            bad = [i for i in issues if i.startswith("nonpsd")
+                   or i == "nonfinite"]
+            if bad:
+                # Mutating the trajectory is opt-in: either the caller
+                # asked for continuous checking or enabled recovery.
+                repairing = (policy.recover_divergence
+                             or policy.check_params == "always")
+                health.record(HealthEvent(
+                    chunk=chunk_idx - 1, iteration=it,
+                    kind=("nonfinite_params" if "nonfinite" in bad
+                          else "nonpsd"),
+                    detail=",".join(bad),
+                    action="repaired" if repairing else "detected"))
+                if repairing:
+                    p = controls.params_device(repair_params(
+                        p_np, policy.r_floor, jitter=policy.psd_tol))
+    corrected = done_actions & {"remeasure_tau", "fallback_info"}
+    if ss_tau is not None and not corrected:
+        # No correction happened (policy "warn", or controls couldn't
+        # rebuild): preserve the legacy warning so drift is never silent.
+        warn_ss_delta(health.max_ss_delta, ss_tau)
+    p_iters = it
+    if stop and target != it:
+        base, base_it = ((p_entry, entry_it) if target >= entry_it
+                         else (p_entry_prev, entry_it_prev))
+        n_replay = max(target - base_it, 0)   # clamped at escalations
+        p = base if n_replay == 0 else _dispatch(scan_fn, base, n_replay)[0]
+        p_iters = base_it + n_replay
+    return p, np.asarray(lls), converged, p_iters

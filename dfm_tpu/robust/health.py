@@ -1,0 +1,112 @@
+"""Per-fit health records.
+
+A ``FitHealth`` is collected host-side by the guarded chunk loop (one
+update per fused chunk — never per iteration, so the device hot path is
+untouched) and attached to the fit result.  ``ok`` distinguishes "clean
+fit" from "fit that needed intervention"; the ``events`` list is the
+forensic trail.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+__all__ = ["HealthEvent", "FitHealth", "health_from_trace"]
+
+# Event kinds the guard emits:
+#   nan_loglik      non-finite loglik in a chunk
+#   divergence      loglik drop beyond the noise floor
+#   freeze_drift    ss freeze delta above the policy threshold
+#   stall           successive chunks wiggling inside the noise floor
+#   nonpsd          Q or P0 lost positive semi-definiteness
+#   r_floor         R entries pinned at the EM floor
+#   nonfinite_params  NaN/inf in the parameter pytree itself
+#   dispatch_error  device dispatch raised (tunnel error / timeout)
+
+
+@dataclasses.dataclass
+class HealthEvent:
+    """One observed pathology and what the guard did about it."""
+
+    chunk: int          # fused-chunk index (0-based)
+    iteration: int      # EM iteration count at the chunk entry
+    kind: str
+    detail: str = ""
+    action: str = "none"   # retried | restored | repaired | remeasure_tau
+    #                      # | fallback_info | loglik_f64 | stopped | abort
+
+    def __str__(self) -> str:
+        return (f"[chunk {self.chunk} it {self.iteration}] {self.kind}"
+                f" -> {self.action}" + (f" ({self.detail})" if self.detail
+                                        else ""))
+
+
+@dataclasses.dataclass
+class FitHealth:
+    """Aggregate health of one EM run (attached to ``FitResult.health``)."""
+
+    n_chunks: int = 0
+    n_dispatch_retries: int = 0
+    n_recoveries: int = 0
+    max_ss_delta: float = 0.0
+    monotonicity_violations: int = 0
+    r_floor_hits: int = 0
+    nonpsd_events: int = 0
+    stalled: bool = False
+    escalations: List[str] = dataclasses.field(default_factory=list)
+    events: List[HealthEvent] = dataclasses.field(default_factory=list)
+    fallback_backend: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        """True iff the fit needed no intervention of any kind."""
+        return (not self.events and not self.escalations
+                and self.fallback_backend is None and not self.stalled)
+
+    def record(self, event: HealthEvent) -> HealthEvent:
+        self.events.append(event)
+        if event.kind == "nonpsd":
+            self.nonpsd_events += 1
+        if event.action in ("restored", "repaired", "retried"):
+            self.n_recoveries += 1
+        return event
+
+    def escalate(self, action: str) -> None:
+        self.escalations.append(action)
+
+    def summary(self) -> str:
+        if self.ok:
+            return f"healthy ({self.n_chunks} chunks)"
+        bits = [f"{len(self.events)} events"]
+        if self.escalations:
+            bits.append("escalations: " + ",".join(self.escalations))
+        if self.fallback_backend:
+            bits.append(f"fell back to {self.fallback_backend}")
+        if self.stalled:
+            bits.append("stalled")
+        return "; ".join(bits)
+
+
+def health_from_trace(lls, noise_floor: float = 0.0,
+                      max_ss_delta: float = 0.0) -> FitHealth:
+    """Post-hoc health record from a loglik trace.
+
+    The family drivers (MF/TVL/SV) run their own fused loops without the
+    full chunk guard; this gives their results the same ``health`` surface
+    from the information the loop already has on host — finite-loglik and
+    monotonicity checks plus the ss freeze delta where the engine reports
+    one.  No device work.
+    """
+    import numpy as np
+    h = FitHealth()
+    a = np.asarray(lls, np.float64)
+    for i in np.flatnonzero(~np.isfinite(a))[:8]:
+        h.record(HealthEvent(chunk=-1, iteration=int(i), kind="nan_loglik",
+                             detail="non-finite loglik in trace"))
+    if a.size >= 2:
+        drops = a[:-1] - a[1:]
+        with np.errstate(invalid="ignore"):
+            h.monotonicity_violations = int(np.sum(drops > noise_floor))
+    h.max_ss_delta = float(max_ss_delta)
+    return h

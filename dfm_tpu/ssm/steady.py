@@ -49,7 +49,7 @@ from .kalman import rts_smoother
 from .params import SSMParams, FilterResult, SmootherResult
 
 __all__ = ["ss_filter", "ss_smoother", "ss_filter_smoother", "ss_from_stats",
-           "riccati_mixing_steps", "auto_tau", "DEFAULT_TAU"]
+           "riccati_mixing_steps", "auto_tau", "remeasure_tau", "DEFAULT_TAU"]
 
 DEFAULT_TAU = 96
 
@@ -90,6 +90,22 @@ def auto_tau(p, margin: float = 2.0, lo: int = 8, hi: int = 192) -> int:
         if b >= lo and tau <= b:
             return int(min(b, hi))
     return hi
+
+
+def remeasure_tau(p, current_tau: int, margin: float = 2.0,
+                  hi: int = 192) -> int:
+    """Re-size ``tau`` at the CURRENT params (not the entry params).
+
+    ``auto_tau`` is measured once at the warm start; EM can drift the
+    dynamics toward slower mixing until the freeze delta trips the runtime
+    diagnostic.  This re-measures the Riccati mixing time where the fit
+    actually is and returns a tau covering it — never smaller than
+    ``current_tau``, so a return value equal to ``current_tau`` means
+    "a longer freeze horizon cannot help; change engines instead"
+    (the guard then falls back ss -> info).
+    """
+    return max(int(current_tau),
+               auto_tau(p, margin=margin, lo=int(current_tau), hi=hi))
 
 
 def _affine_combine(earlier, later):

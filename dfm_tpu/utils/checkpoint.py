@@ -65,23 +65,47 @@ def save_checkpoint(path: str, params, it: int, logliks,
         raise
 
 
-def load_checkpoint(path: str, fingerprint: Optional[str] = None
+def load_checkpoint(path: str, fingerprint: Optional[str] = None,
+                    on_mismatch: str = "ignore"
                     ) -> Optional[Tuple[SSMParams, int, np.ndarray, bool]]:
     """Returns (params, completed_iters, logliks, converged) or None if
     absent, unreadable, or fingerprint-mismatched.  When a fingerprint is
     expected, a checkpoint WITHOUT one (pre-fingerprint file) is also
     rejected — accepting it would silently warm-start from possibly-foreign
-    params, the exact failure the fingerprint exists to prevent."""
+    params, the exact failure the fingerprint exists to prevent.
+
+    ``on_mismatch``: "ignore" returns None on a fingerprint mismatch —
+    ``fit`` uses it so foreign data cold-starts with the full iteration
+    budget; "raise" raises ``ValueError`` instead, for callers who need
+    pointing an existing checkpoint at CHANGED data to fail loudly rather
+    than refit from scratch and overwrite the old state."""
+    if on_mismatch not in ("ignore", "raise"):
+        raise ValueError(f"on_mismatch must be 'ignore' or 'raise'; "
+                         f"got {on_mismatch!r}")
     if not os.path.exists(path):
         return None
     try:
         with np.load(path) as z:
-            if fingerprint is not None:
-                if ("fingerprint" not in z
-                        or str(z["fingerprint"]) != fingerprint):
-                    return None
-            params = SSMParams(*(z[f] for f in _FIELDS))
-            converged = bool(z["converged"]) if "converged" in z else False
-            return params, int(z["iter"]), np.asarray(z["logliks"]), converged
+            matches = (fingerprint is None
+                       or ("fingerprint" in z
+                           and str(z["fingerprint"]) == fingerprint))
+            if matches:
+                params = SSMParams(*(z[f] for f in _FIELDS))
+                converged = bool(z["converged"]) if "converged" in z else False
+                out = (params, int(z["iter"]), np.asarray(z["logliks"]),
+                       converged)
+            else:
+                out = None
     except Exception:
-        return None
+        return None        # unreadable/corrupt file: caller starts fresh
+    if out is None and on_mismatch == "raise":
+        raise _fingerprint_error(path)
+    return out
+
+
+def _fingerprint_error(path: str) -> ValueError:
+    return ValueError(
+        f"checkpoint {path!r} was written for different data / mask / "
+        "model (fingerprint mismatch); resuming would either warm-start "
+        "from foreign params or silently overwrite the old run — delete "
+        "the file or use a different checkpoint_path")

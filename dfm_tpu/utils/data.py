@@ -48,6 +48,40 @@ def standardize(Y: np.ndarray, mask: Optional[np.ndarray] = None
     return Z, Standardizer(mean, scale)
 
 
+def validate_panel(Y: np.ndarray, mask: Optional[np.ndarray] = None,
+                   check_variance: bool = True) -> None:
+    """Reject panels that poison standardization/EM downstream.
+
+    Raises ``ValueError`` naming the offending column indices when a series
+    has NO observed entries (its mean/scale are undefined — the zero-fill
+    would fabricate data) or, with ``check_variance``, when an observed
+    series is constant (scale hits the 1e-12 floor and the standardized
+    column explodes to ~1e6-magnitude values that dominate the PCA init).
+    """
+    Y = np.asarray(Y, dtype=np.float64)
+    obs = np.isfinite(Y)
+    if mask is not None:
+        obs &= np.asarray(mask) > 0
+    counts = obs.sum(0)
+    dead = np.flatnonzero(counts == 0)
+    if dead.size:
+        raise ValueError(
+            f"column(s) {dead.tolist()} have no observed entries "
+            "(all-NaN / fully masked); drop them before fitting")
+    if not check_variance:
+        return
+    W = obs.astype(np.float64)
+    Yz = np.where(obs, Y, 0.0)
+    mean = Yz.sum(0) / np.maximum(counts, 1.0)
+    var = (W * (Yz - mean) ** 2).sum(0) / np.maximum(counts - 1.0, 1.0)
+    flat = np.flatnonzero((counts > 1) & (var < 1e-12))
+    if flat.size:
+        raise ValueError(
+            f"column(s) {flat.tolist()} have zero variance over their "
+            "observed entries; standardization would divide by ~0 — drop "
+            "or de-constant them before fitting")
+
+
 def build_mask(Y: np.ndarray, mask: Optional[np.ndarray] = None) -> np.ndarray:
     """{0,1} observation mask from explicit mask and/or NaN pattern."""
     obs = np.isfinite(np.asarray(Y, dtype=np.float64))

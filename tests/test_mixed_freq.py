@@ -171,3 +171,29 @@ def test_mf_pit_time_scan_matches_seq_f32():
         *args, dataclasses.replace(spec, time_scan="pit"), 4)
     np.testing.assert_allclose(np.asarray(lls_pit), np.asarray(lls_seq),
                                rtol=2e-4)
+
+
+def test_mf_loglik_eval_mask_none():
+    """Regression (ADVICE r5 finding #1): the fast compute-dtype path
+    crashed in ``asarray(None)`` on a fully-observed panel (mask=None).
+    Both paths must accept mask=None and agree with the masked all-ones
+    call exactly."""
+    from dfm_tpu.models.mixed_freq import mf_loglik_eval
+    rng = np.random.default_rng(41)
+    Y, _, _, _ = dgp.simulate_mixed_freq(10, 4, 60, 2, rng)
+    Y = np.nan_to_num(Y)            # fully observed: every entry is data
+    spec = MixedFreqSpec(n_monthly=10, n_quarterly=4, n_factors=2)
+    W = np.ones_like(Y)
+    p = mf_pca_init(Y, W, spec)
+    for precise in (True, False):
+        ll_none = mf_loglik_eval(Y, None, p, spec, precise=precise)
+        ll_ones = mf_loglik_eval(Y, W, p, spec, precise=precise)
+        assert np.isfinite(ll_none)
+        np.testing.assert_allclose(ll_none, ll_ones, rtol=1e-12)
+
+
+def test_mf_fit_attaches_health(mf_panel):
+    Y, mask, _, _ = mf_panel
+    spec = MixedFreqSpec(n_monthly=30, n_quarterly=8, n_factors=2)
+    res = mf_fit(Y, spec, mask=mask, max_iters=6, tol=0.0)
+    assert res.health is not None and res.health.ok

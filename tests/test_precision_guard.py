@@ -1,0 +1,137 @@
+"""Source-level invariant: every standalone fit driver forces true-f32
+matmuls (``jax.default_matmul_precision("highest")``).
+
+XLA's default f32 matmul precision rounds MXU inputs to bf16, which costs
+~1e-4 relative log-likelihood — far outside the 1e-5 oracle contract
+(docs/PERF.md item 2) and enough to fake EM divergences.  Each driver
+that owns its device dispatches must therefore open the precision context
+itself; a handful of functions intentionally DELEGATE that duty and are
+allowlisted below with the reason.  This test walks the AST of every
+``dfm_tpu`` source file so a new driver added without the guard (or a
+refactor that drops one) fails CI instead of silently shipping bf16
+logliks.
+"""
+
+import ast
+import pathlib
+
+import dfm_tpu
+
+PKG_ROOT = pathlib.Path(dfm_tpu.__file__).parent
+
+# Functions that are fit drivers by name but must NOT (or need not) carry
+# their own precision context.  Frozen: extending it requires justifying a
+# new delegation here.
+ALLOWLIST = {
+    # Delegates to the backend's _precision_ctx (the user-facing knob
+    # TPUBackend(matmul_precision=...) lives there).
+    "dfm_tpu.api.fit",
+    # Pure dispatcher onto the family drivers below.
+    "dfm_tpu.api._family_fit",
+    # Always invoked under the calling backend's context; its own ctx
+    # would be innermost and silently OVERRIDE
+    # TPUBackend(matmul_precision="default").
+    "dfm_tpu.estim.em.em_fit",
+    # NumPy f64 oracle: no XLA, no MXU, nothing to guard.
+    "dfm_tpu.backends.cpu_ref.em_fit",
+    # EM pre-fit runs through api.fit; every particle-filter dispatch runs
+    # through sv_filter / sharded_sv_filter (checked in MUST_GUARD).
+    "dfm_tpu.models.sv.sv_fit",
+}
+
+# Compute kernels the allowlist reasons lean on: these MUST contain the
+# context so the delegation story above stays true.
+MUST_GUARD_EXTRA = {
+    "dfm_tpu.models.sv.sv_filter",
+    "dfm_tpu.parallel.sharded_sv.sharded_sv_filter",
+}
+
+
+def _qualname(path: pathlib.Path, fn: str) -> str:
+    rel = path.relative_to(PKG_ROOT.parent).with_suffix("")
+    return ".".join(rel.parts) + "." + fn
+
+
+def _signature_defaults(fn: ast.FunctionDef) -> dict:
+    """arg name -> default constant value (positional + kw-only)."""
+    out = {}
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        if isinstance(d, ast.Constant):
+            out[a.arg] = d.value
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None and isinstance(d, ast.Constant):
+            out[a.arg] = d.value
+    return out
+
+
+def _has_precision_ctx(fn: ast.FunctionDef) -> bool:
+    """True if fn contains ``with ...default_matmul_precision(X)`` where X
+    is the literal "highest" or a parameter defaulting to "highest"."""
+    defaults = _signature_defaults(fn)
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            call = item.context_expr
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, (ast.Attribute, ast.Name))):
+                continue
+            name = (call.func.attr if isinstance(call.func, ast.Attribute)
+                    else call.func.id)
+            if name != "default_matmul_precision" or not call.args:
+                continue
+            arg = call.args[0]
+            if isinstance(arg, ast.Constant) and arg.value == "highest":
+                return True
+            if (isinstance(arg, ast.Name)
+                    and defaults.get(arg.id) == "highest"):
+                return True
+    return False
+
+
+def _module_functions():
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in tree.body:                 # module level only
+            if isinstance(node, ast.FunctionDef):
+                yield path, node
+
+
+def test_every_fit_driver_forces_highest_precision():
+    seen, missing = set(), []
+    for path, fn in _module_functions():
+        qual = _qualname(path, fn.name)
+        is_driver = fn.name == "fit" or fn.name.endswith("_fit")
+        if not is_driver and qual not in MUST_GUARD_EXTRA:
+            continue
+        seen.add(qual)
+        if qual in ALLOWLIST:
+            continue
+        if not _has_precision_ctx(fn):
+            missing.append(qual)
+    assert not missing, (
+        "fit drivers without a matmul_precision='highest' context "
+        f"(bf16-rounded MXU matmuls poison the loglik): {missing}")
+    # The audit actually saw the drivers it exists to protect (a rename
+    # must update this list, not silently skip the check).
+    expected = {
+        "dfm_tpu.models.mixed_freq.mf_fit",
+        "dfm_tpu.models.tv_loadings.tvl_fit",
+        "dfm_tpu.parallel.sharded.sharded_em_fit",
+        "dfm_tpu.parallel.sharded_mf.sharded_mf_fit",
+        "dfm_tpu.parallel.sharded_tvl.sharded_tvl_fit",
+    } | MUST_GUARD_EXTRA | ALLOWLIST
+    assert expected <= seen, sorted(expected - seen)
+
+
+def test_allowlist_is_frozen():
+    # The allowlist names real functions; a stale entry means the
+    # delegation story changed and this file must be revisited.
+    assert {q for q in ALLOWLIST} == {
+        "dfm_tpu.api.fit", "dfm_tpu.api._family_fit",
+        "dfm_tpu.estim.em.em_fit", "dfm_tpu.backends.cpu_ref.em_fit",
+        "dfm_tpu.models.sv.sv_fit"}
+    seen = {_qualname(p, f.name) for p, f in _module_functions()}
+    assert ALLOWLIST <= seen, sorted(ALLOWLIST - seen)

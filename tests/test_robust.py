@@ -1,0 +1,356 @@
+"""Guarded-fit tests (ISSUE: health-monitored EM with automatic recovery).
+
+Every recovery path is driven deterministically on the fake 8-device CPU
+mesh via ``robust.faults.FaultInjector`` (``RobustPolicy.wrap_scan``):
+NaN-poisoned chunks, transient and persistent dispatch failures, non-PSD
+parameter corruption, forced steady-state freeze drift.  The CPU NumPy
+backend is the f64 oracle throughout (conftest forces x64, so the TPU
+path is numerically exact too — clean guarded fits must MATCH unguarded
+ones, not just resemble them).
+"""
+
+import numpy as np
+import pytest
+
+from dfm_tpu import DynamicFactorModel, fit
+from dfm_tpu.api import ShardedBackend, TPUBackend
+from dfm_tpu.backends.cpu_ref import SSMParams
+from dfm_tpu.robust import (FaultInjector, FitHealth, GuardFailure,
+                            RobustPolicy, check_param_health,
+                            health_from_trace, repair_params)
+from dfm_tpu.utils import dgp
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(7)
+    p = dgp.dfm_params(N=20, k=2, rng=rng)
+    Y, _ = dgp.simulate(p, T=60, rng=rng)
+    return Y
+
+
+MODEL = DynamicFactorModel(n_factors=2, standardize=False)
+
+
+def quick_policy(inj=None, **kw):
+    """Test policy: no real sleeps, fault hook installed."""
+    kw.setdefault("backoff_base", 1e-4)
+    if inj is not None:
+        kw.setdefault("wrap_scan", inj.wrap)
+    return RobustPolicy(**kw)
+
+
+# ---------------------------------------------------------------- units --
+
+def test_health_ok_and_summary():
+    h = FitHealth(n_chunks=3)
+    assert h.ok and "healthy" in h.summary()
+    h.escalate("fallback_info")
+    assert not h.ok and "fallback_info" in h.summary()
+
+
+def test_health_from_trace_counts():
+    h = health_from_trace([-10.0, -9.0, np.nan, -8.0, -8.5], noise_floor=0.1)
+    assert [e.kind for e in h.events] == ["nan_loglik"]
+    assert h.monotonicity_violations == 1          # the 0.5 drop; NaN ignored
+    assert not h.ok
+
+
+def test_check_and_repair_params():
+    k, N = 3, 6
+    good = SSMParams(Lam=np.ones((N, k)), A=0.5 * np.eye(k), Q=np.eye(k),
+                     R=np.ones(N), mu0=np.zeros(k), P0=np.eye(k))
+    assert check_param_health(good) == []
+    bad = SSMParams(Lam=good.Lam, A=good.A, Q=np.eye(k) - 2.0,
+                    R=np.full(N, 1e-9), mu0=good.mu0, P0=good.P0)
+    issues = check_param_health(bad)
+    assert "nonpsd_Q" in issues and "r_floor" in issues
+    fixed = repair_params(bad, r_floor=1e-6, jitter=1e-8)
+    assert check_param_health(fixed) == []
+    nan = SSMParams(Lam=np.full((N, k), np.nan), A=good.A, Q=good.Q,
+                    R=good.R, mu0=good.mu0, P0=np.full((k, k), np.inf))
+    assert check_param_health(nan) == ["nonfinite"]
+    fixed = repair_params(nan)
+    assert check_param_health(fixed) == []
+
+
+def test_remeasure_tau_monotone(panel):
+    from dfm_tpu.ssm.steady import auto_tau, remeasure_tau
+    rng = np.random.default_rng(3)
+    p = dgp.dfm_params(N=20, k=2, rng=rng)
+    params = SSMParams(Lam=p.Lam, A=p.A, Q=p.Q, R=p.R, mu0=p.mu0, P0=p.P0)
+    tau0 = auto_tau(params)
+    assert remeasure_tau(params, tau0) >= tau0
+    # A near-unit-root transition mixes slower: tau must grow.
+    slow = SSMParams(Lam=p.Lam, A=0.999 * np.eye(2), Q=p.Q, R=p.R,
+                     mu0=p.mu0, P0=p.P0)
+    assert remeasure_tau(slow, 4) > 4
+
+
+def test_policy_resolution(panel):
+    with pytest.raises(TypeError, match="robust"):
+        fit(MODEL, panel, backend="tpu", max_iters=2, robust="yes")
+
+
+# ---------------------------------------------- clean-path equivalence --
+
+def test_guarded_matches_unguarded(panel):
+    r_off = fit(MODEL, panel, backend="tpu", max_iters=10, tol=0.0,
+                robust=False)
+    r_on = fit(MODEL, panel, backend="tpu", max_iters=10, tol=0.0,
+               robust=True)
+    np.testing.assert_array_equal(r_on.logliks, r_off.logliks)
+    np.testing.assert_array_equal(r_on.params.Lam, r_off.params.Lam)
+    assert r_off.health is None
+    assert r_on.health is not None and r_on.health.ok
+    assert r_on.health.n_chunks >= 1
+
+
+def test_guarded_default_on(panel):
+    r = fit(MODEL, panel, backend="tpu", max_iters=4, tol=0.0)
+    assert r.health is not None and r.health.ok
+
+
+# ------------------------------------------------------- fault recovery --
+
+def test_nan_chunk_recovers(panel):
+    b = TPUBackend(fused_chunk=2)
+    r_clean = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+                  robust=False)
+    inj = FaultInjector().nan_chunk(1)
+    r = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+            robust=quick_policy(inj, recover_divergence=True))
+    assert np.isfinite(r.logliks).all() and len(r.logliks) == 8
+    assert "nan_loglik" in [e.kind for e in r.health.events]
+    assert r.health.n_recoveries >= 1 and not r.health.ok
+    # Restore + (tiny-jitter) repair resumes from the chunk entry: the
+    # final loglik must land back on the clean trajectory.
+    np.testing.assert_allclose(r.logliks[-1], r_clean.logliks[-1],
+                               rtol=1e-6)
+
+
+def test_nan_chunk_default_records_only(panel):
+    # Default policy (recover_divergence=False): legacy semantics — the
+    # NaN logliks stay in the trace (em_progress treats NaN as
+    # "continue"; tests/test_debug.py pins the poisoned-fit behavior),
+    # but the pathology is on the health record.
+    b = TPUBackend(fused_chunk=2)
+    inj = FaultInjector().nan_chunk(1)
+    r = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+            robust=quick_policy(inj))
+    assert len(r.logliks) == 8
+    assert np.isnan(r.logliks[2:4]).all()       # dispatch #1 = iters 2-3
+    assert "nan_loglik" in [e.kind for e in r.health.events]
+    assert not r.health.ok
+
+
+def test_transient_dispatch_failure_retried(panel):
+    b = TPUBackend(fused_chunk=2)
+    r_clean = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+                  robust=False)
+    inj = FaultInjector().dispatch_failure(at=1, count=2)
+    r = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+            robust=quick_policy(inj))
+    # Retries re-dispatch the untouched params: exact reproduction.
+    np.testing.assert_array_equal(r.logliks, r_clean.logliks)
+    assert r.health.n_dispatch_retries == 2
+    assert [e.action for e in r.health.events
+            if e.kind == "dispatch_error"] == ["retried", "retried"]
+    assert r.backend == "tpu"
+
+
+def test_persistent_dispatch_failure_raises(panel):
+    inj = FaultInjector().dispatch_failure(at=1, count=-1)
+    with pytest.raises(GuardFailure, match="dispatch failed"):
+        fit(MODEL, panel, backend=TPUBackend(fused_chunk=2), max_iters=8,
+            tol=0.0, robust=quick_policy(inj, dispatch_retries=1))
+
+
+def test_persistent_dispatch_failure_cpu_fallback(panel):
+    b = TPUBackend(fused_chunk=2)
+    inj = FaultInjector().dispatch_failure(at=2, count=-1)
+    r = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+            robust=quick_policy(inj, dispatch_retries=1, on_failure="cpu"))
+    assert r.backend == "cpu"
+    assert r.health.fallback_backend == "cpu"
+    assert np.isfinite(r.logliks).all() and len(r.logliks) == 8
+    # The degraded run continues from the last good params: its trace is
+    # the uninterrupted f64-oracle trajectory.
+    r_cpu = fit(MODEL, panel, backend="cpu", max_iters=8, tol=0.0)
+    np.testing.assert_allclose(r.logliks, r_cpu.logliks, rtol=1e-6)
+    np.testing.assert_allclose(r.factors, r_cpu.factors, atol=1e-6)
+
+
+def test_nonpsd_params_repaired(panel):
+    b = TPUBackend(fused_chunk=2)
+    inj = FaultInjector().nonpsd_params(at=0)
+    r = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+            robust=quick_policy(inj, check_params="always",
+                                recover_divergence=True))
+    assert np.isfinite(r.logliks[-1])
+    assert r.health.nonpsd_events >= 1
+    assert any(e.action == "repaired" for e in r.health.events)
+
+
+# ----------------------------------------------- freeze-drift escalation --
+
+def test_freeze_drift_info_fallback(panel):
+    b = TPUBackend(filter="ss", fused_chunk=2)
+    inj = FaultInjector().freeze_drift(at=0, delta=1e-2)
+    r = fit(MODEL, panel, backend=b, max_iters=12, tol=0.0,
+            robust=quick_policy(inj, freeze_action="fallback_info"))
+    assert "fallback_info" in r.health.escalations
+    assert any(e.kind == "freeze_drift" for e in r.health.events)
+    # Acceptance: after the ss -> info fallback the final loglik matches
+    # the f64 oracle trajectory to the BASELINE accuracy bound.
+    r_cpu = fit(MODEL, panel, backend="cpu", max_iters=12, tol=0.0)
+    np.testing.assert_allclose(r.logliks[-1], r_cpu.logliks[-1], rtol=1e-5)
+
+
+def test_freeze_drift_warn_mode(panel):
+    # freeze_action="warn" preserves the legacy diagnostic verbatim.
+    b = TPUBackend(filter="ss", fused_chunk=2)
+    inj = FaultInjector().freeze_drift(at=1, delta=1e-2)
+    with pytest.warns(RuntimeWarning, match="freeze error"):
+        r = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+                robust=quick_policy(inj, freeze_action="warn"))
+    assert r.health.max_ss_delta >= 1e-2
+    assert not r.health.escalations
+
+
+# ----------------------------------------------------- sharded guarding --
+
+def test_sharded_guarded_matches_unguarded(panel):
+    b = ShardedBackend(fused_chunk=2)
+    r_off = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, robust=False)
+    r_on = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, robust=True)
+    np.testing.assert_array_equal(r_on.logliks, r_off.logliks)
+    assert r_on.health is not None and r_on.health.ok
+
+
+def test_sharded_freeze_drift_info_fallback(panel):
+    # Satellite: the freeze diagnostic propagates through the sharded
+    # chunked driver — and under the guard it CORRECTS (drv.cfg swap,
+    # params re-padded through ShardedEM.params_device).
+    b = ShardedBackend(filter="ss", fused_chunk=2)
+    inj = FaultInjector().freeze_drift(at=0, delta=1e-2)
+    r = fit(MODEL, panel, backend=b, max_iters=12, tol=0.0,
+            robust=quick_policy(inj, freeze_action="fallback_info"))
+    assert "fallback_info" in r.health.escalations
+    r_cpu = fit(MODEL, panel, backend="cpu", max_iters=12, tol=0.0)
+    np.testing.assert_allclose(r.logliks[-1], r_cpu.logliks[-1], rtol=1e-5)
+
+
+def test_sharded_freeze_drift_warn_mode(panel):
+    b = ShardedBackend(filter="ss", fused_chunk=2)
+    inj = FaultInjector().freeze_drift(at=1, delta=1e-2)
+    with pytest.warns(RuntimeWarning, match="freeze error"):
+        r = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+                robust=quick_policy(inj, freeze_action="warn"))
+    assert r.health.max_ss_delta >= 1e-2
+
+
+def test_sharded_dispatch_failure_cpu_fallback(panel):
+    b = ShardedBackend(fused_chunk=2)
+    inj = FaultInjector().dispatch_failure(at=2, count=-1)
+    r = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+            robust=quick_policy(inj, dispatch_retries=1, on_failure="cpu"))
+    assert r.backend == "cpu" and r.health.fallback_backend == "cpu"
+    r_cpu = fit(MODEL, panel, backend="cpu", max_iters=8, tol=0.0)
+    np.testing.assert_allclose(r.logliks, r_cpu.logliks, rtol=1e-6)
+
+
+# --------------------------------------------------- panel validation --
+
+def test_validate_all_nan_column(panel):
+    Y = panel.copy()
+    Y[:, 3] = np.nan
+    with pytest.raises(ValueError, match=r"\[3\].*no observed"):
+        fit(DynamicFactorModel(n_factors=2), Y, backend="cpu")
+
+
+def test_validate_zero_variance_column(panel):
+    Y = panel.copy()
+    Y[:, 5] = 2.5
+    Y[:, 11] = -1.0
+    with pytest.raises(ValueError, match=r"\[5, 11\].*zero variance"):
+        fit(DynamicFactorModel(n_factors=2), Y, backend="cpu")
+    # standardize=False skips the variance check (constant columns are
+    # legal inputs when no scaling happens).
+    r = fit(MODEL, Y, backend="cpu", max_iters=2)
+    assert np.isfinite(r.logliks).all()
+
+
+def test_validate_panel_direct():
+    from dfm_tpu.utils.data import validate_panel
+    Y = np.random.default_rng(0).normal(size=(30, 4))
+    validate_panel(Y)                      # clean: no raise
+    mask = np.ones_like(Y)
+    mask[:, 2] = 0.0
+    with pytest.raises(ValueError, match=r"\[2\]"):
+        validate_panel(Y, mask)
+
+
+# ------------------------------------------------------- checkpointing --
+
+def test_checkpoint_resume_reproduces_trajectory(tmp_path, panel):
+    ck = str(tmp_path / "em.npz")
+    m = DynamicFactorModel(n_factors=2)
+    r_full = fit(m, panel, backend="tpu", max_iters=12, tol=0.0)
+    r1 = fit(m, panel, backend="tpu", max_iters=6, tol=0.0,
+             checkpoint_path=ck, checkpoint_every=2)
+    assert len(r1.logliks) == 6
+    r2 = fit(m, panel, backend="tpu", max_iters=12, tol=0.0,
+             checkpoint_path=ck, checkpoint_every=2)
+    # Resume runs exactly the remaining budget and lands on the
+    # uninterrupted trajectory.
+    assert len(r2.logliks) == 6
+    np.testing.assert_allclose(r2.logliks, r_full.logliks[6:], rtol=1e-7)
+    np.testing.assert_allclose(r2.params.Lam, r_full.params.Lam, atol=1e-8)
+
+
+def test_checkpoint_exhausted_budget_is_stable(tmp_path, panel):
+    ck = str(tmp_path / "em.npz")
+    m = DynamicFactorModel(n_factors=2)
+    fit(m, panel, backend="tpu", max_iters=6, tol=0.0, checkpoint_path=ck)
+    from dfm_tpu.utils.checkpoint import load_checkpoint
+    before = load_checkpoint(ck)
+    r = fit(m, panel, backend="tpu", max_iters=6, tol=0.0,
+            checkpoint_path=ck)
+    after = load_checkpoint(ck)
+    # Re-running an exhausted budget returns the stored state untouched.
+    assert before[1] == after[1] == 6
+    np.testing.assert_array_equal(before[0].Lam, r.params.Lam)
+
+
+def test_checkpoint_fingerprint_mismatch_raises(tmp_path, panel):
+    ck = str(tmp_path / "em.npz")
+    m = DynamicFactorModel(n_factors=2)
+    fit(m, panel, backend="tpu", max_iters=4, tol=0.0, checkpoint_path=ck)
+    from dfm_tpu.utils.checkpoint import load_checkpoint
+    # The strict seam: a caller that must not proceed past foreign state.
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        load_checkpoint(ck, fingerprint="not-this-panel",
+                        on_mismatch="raise")
+    # fit() itself treats the mismatch as a cold start with the FULL
+    # budget (foreign data must never warm-start — test_select_eval.py
+    # pins the trajectory equality).
+    r = fit(m, panel + 1.0, backend="tpu", max_iters=4, tol=0.0,
+            checkpoint_path=ck)
+    assert r.n_iters == 4
+
+
+def test_checkpoint_guard_saves_last_good(tmp_path, panel):
+    # A failed guarded fit leaves a resumable checkpoint of the last good
+    # params even when the per-iteration cadence never fired.
+    ck = str(tmp_path / "em.npz")
+    m = DynamicFactorModel(n_factors=2)
+    inj = FaultInjector().dispatch_failure(at=2, count=-1)
+    with pytest.raises(GuardFailure):
+        fit(m, panel, backend=TPUBackend(fused_chunk=2), max_iters=8,
+            tol=0.0, checkpoint_path=ck, checkpoint_every=1000,
+            robust=quick_policy(inj, dispatch_retries=1))
+    from dfm_tpu.utils.checkpoint import load_checkpoint
+    state = load_checkpoint(ck)
+    assert state is not None and state[1] == 4     # two clean chunks of 2
+    assert np.isfinite(state[0].Lam).all()
