@@ -1,0 +1,547 @@
+#!/usr/bin/env python
+"""Profiling harness for the EM iteration and the filters (one script,
+subcommands — consolidates the former profile_em{,2,3}.py / profile_pit.py).
+
+  components  per-piece ms/iter of the steady-state EM iteration (the
+              measurement behind docs/PERF.md's roofline table)
+  slope       fixed-vs-marginal cost: time the fused scan at several
+              n_iters and fit a line; slope = true per-iteration device
+              cost, intercept = per-dispatch overhead
+  ablate      within-process ablation of the ss EM body (between-process
+              variance on this tunnel is +/-50%; within-process deltas
+              are stable — full - variant = that piece's marginal cost)
+  pit         sequential info-form vs associative-scan PIT filter vs ss
+              engine, one fused loglik pass across T (VERDICT r4 item 8)
+
+Run on the real chip: ``python -m bench.profile <subcommand>``.
+Shapes via DFM_BENCH_N/T/K (and DFM_BENCH_TAU/ITERS for ablate);
+``pit`` takes --N/--k/--Ts/--cpu flags instead (small-N long-T regime).
+All diagnostics go to stdout as tables — this is NOT the one-JSON-line
+bench contract (that is bench.py / bench/batched.py).
+"""
+
+import argparse
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _env_shapes():
+    N = int(os.environ.get("DFM_BENCH_N", 10_000))
+    T = int(os.environ.get("DFM_BENCH_T", 500))
+    k = int(os.environ.get("DFM_BENCH_K", 10))
+    return N, T, k
+
+
+def _panel(N, T, k, dtype):
+    """Standardized simulated panel + PCA init on device (f32)."""
+    import jax
+    import jax.numpy as jnp
+    from dfm_tpu.backends import cpu_ref
+    from dfm_tpu.utils import dgp
+    from dfm_tpu.ssm.params import SSMParams as JP
+
+    rng = np.random.default_rng(0)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y, _ = dgp.simulate(p_true, T, rng)
+    Y = (Y - Y.mean(0)) / Y.std(0)
+    p0 = cpu_ref.pca_init(Y, k)
+    Yj = jax.device_put(jnp.asarray(Y, dtype))
+    pj = JP.from_numpy(p0, dtype=dtype)
+    return rng, Y, p0, Yj, pj
+
+
+def _timed(fn, *args, reps=3):
+    """Warm-up (compile) + best-of-N; transfer is the only barrier on axon."""
+    import jax
+    np.asarray(jax.tree.leaves(fn(*args))[0])
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(jax.tree.leaves(fn(*args))[0])
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+# ---------------------------------------------------------------------------
+# components — per-piece ms/iter (formerly profile_em.py)
+# ---------------------------------------------------------------------------
+
+def cmd_components(args):
+    N, T, k = _env_shapes()
+    n_iters = int(os.environ.get("DFM_BENCH_ITERS", 150))
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dfm_tpu.estim.em import EMConfig, em_fit_scan
+    from dfm_tpu.ssm import steady
+    from dfm_tpu.ssm.info_filter import obs_stats, loglik_terms_local
+    from dfm_tpu.ops.scan import blocked_scan
+    from dfm_tpu.ssm.steady import riccati_mixing_steps
+
+    dtype = jnp.float32
+    rng, Y, p0, Yj, pj = _panel(N, T, k, dtype)
+    mix = riccati_mixing_steps(p0)
+    log(f"shape {N}x{T} k={k}; riccati mixing {mix} steps")
+
+    # Chain trick: eps = 0 * (scalar from prev iter) keeps a loop-carried
+    # data dependency so neither CSE nor LICM can collapse the scan body.
+    def chain(x, scalar):
+        return x * (1.0 + jnp.zeros((), x.dtype) * scalar.astype(x.dtype))
+
+    @partial(jax.jit, static_argnames=("n",))
+    def panel_scan(Yj, p, n):
+        def body(carry, _):
+            Lam, R = chain(p.Lam, carry), p.R
+            stats = obs_stats(Yj, Lam, R)
+            x_fake = stats.b @ jnp.linalg.inv(stats.C)        # (T, k)
+            quad_R, U = loglik_terms_local(Yj, Lam, R, x_fake, None)
+            S_yf = Yj.T @ x_fake
+            Ysq = jnp.einsum("ti,ti->i", Yj, Yj)
+            out = (jnp.sum(quad_R) + jnp.sum(U) + jnp.sum(S_yf)
+                   + jnp.sum(Ysq) + jnp.sum(stats.b)).astype(Yj.dtype)
+            return out, out
+        return lax.scan(body, jnp.zeros((), Yj.dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n", "tau"))
+    def cov_scan(p, C, n, tau):
+        def body(carry, _):
+            Cc = chain(C, carry)
+            Pp, Pf, M, ldG, delta = steady._cov_path(
+                Cc, p.A, p.Q, p.P0, tau, dtype)
+            out = (jnp.sum(Pp[-1]) + jnp.sum(Pf[-1]) + jnp.sum(M[-1])
+                   + jnp.sum(ldG) + delta)
+            return out, out
+        return lax.scan(body, jnp.zeros((), dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n",))
+    def means_scan(b, M_path, Pfilt, n):
+        def body(carry, _):
+            bb = chain(b, carry)
+            d = jnp.einsum("tkl,tl->tk", Pfilt[1:], bb[1:])
+            Mp, dp = blocked_scan(steady._affine_combine, (M_path[1:], d))
+            x_tail = jnp.einsum("tkl,l->tk", Mp, bb[0]) + dp
+            # reverse smoothed-mean-style scan
+            Jr, cr = blocked_scan(
+                lambda late, early: steady._affine_combine(late, early),
+                (M_path[1:], d), reverse=True)
+            out = jnp.sum(x_tail) + jnp.sum(Jr[0]) + jnp.sum(cr)
+            return out, out
+        return lax.scan(body, jnp.zeros((), b.dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n", "tau"))
+    def smcov_scan(p, C, n, tau):
+        # smoother covariance fixed point + front boundary, at fixed inputs
+        from dfm_tpu.ops.linalg import sym, psd_cholesky, chol_solve
+        Pp_ex, Pf_ex, M_ex, ldG_ex, _ = steady._cov_path(
+            C, p.A, p.Q, p.P0, tau, dtype)
+        Lp_ss = psd_cholesky(Pp_ex[-1])
+        J_ss = chol_solve(Lp_ss, p.A @ Pf_ex[-1]).T
+        Pp_ss, Pf_ss = Pp_ex[-1], Pf_ex[-1]
+
+        def body(carry, _):
+            Pf_c = chain(Pf_ss, carry)
+
+            def bstep_ss(Ps, _):
+                Ps_new = sym(Pf_c + J_ss @ (Ps - Pp_ss) @ J_ss.T)
+                return Ps_new, Ps_new
+
+            Ps_mid, rev = lax.scan(bstep_ss, Pf_c, None, length=tau)
+
+            def bstep_ex(Ps, inp):
+                P_f_t, P_p_next, J_t = inp
+                Ps_new = sym(P_f_t + J_t @ (Ps - P_p_next) @ J_t.T)
+                return Ps_new, Ps_new
+
+            Pp_next_ex = jnp.concatenate([Pp_ex[1:], Pp_ex[-1:]], axis=0)
+            Lp_ex = psd_cholesky(Pp_ex[1:])
+            APf_ex = jnp.einsum("ij,tjk->tik", p.A, Pf_ex[:-1])
+            J_ex = jnp.swapaxes(jax.vmap(chol_solve)(Lp_ex, APf_ex), -1, -2)
+            J_front = jnp.concatenate([J_ex, J_ss[None]], axis=0)
+            _, front = lax.scan(bstep_ex, Ps_mid,
+                                (Pf_ex, Pp_next_ex, J_front), reverse=True)
+            out = jnp.sum(rev[-1]) + jnp.sum(front[0])
+            return out, out
+        return lax.scan(body, jnp.zeros((), dtype), None, length=n)[1]
+
+    with jax.default_matmul_precision("highest"):
+        C0 = np.asarray((p0.Lam / p0.R[:, None]).T @ p0.Lam, np.float32)
+        Cj = jnp.asarray(C0)
+        b0 = jnp.asarray(rng.standard_normal((T, k)), dtype)
+        M0 = jnp.asarray(
+            np.broadcast_to(np.asarray(p0.A, np.float32) * 0.5, (T, k, k)))
+        Pf0 = jnp.asarray(np.broadcast_to(np.eye(k, dtype=np.float32) * 0.3,
+                                          (T, k, k)))
+
+        rows = []
+        t = _timed(panel_scan, Yj, pj, n_iters)
+        rows.append(("panel (3 MXU passes + k-alg)", "-", t))
+        t = _timed(means_scan, b0, M0, Pf0, n_iters)
+        rows.append(("means (2 blocked affine scans)", "-", t))
+        for tau in (16, 32, 64, 96):
+            t = _timed(cov_scan, pj, Cj, n_iters, tau)
+            rows.append(("cov path", tau, t))
+            t = _timed(smcov_scan, pj, Cj, n_iters, tau)
+            rows.append(("smoother cov (fp + front)", tau, t))
+            cfg = EMConfig(filter="ss", tau=tau)
+            t = _timed(lambda: em_fit_scan(Yj, pj, n_iters, cfg=cfg)[1])
+            rows.append(("FULL em_fit_scan", tau, t))
+
+    print(f"\n{'component':36s} {'tau':>4s} {'ms/iter':>9s}")
+    for name, tau, secs in rows:
+        print(f"{name:36s} {str(tau):>4s} {secs / n_iters * 1e3:9.3f}")
+
+
+# ---------------------------------------------------------------------------
+# slope — fixed vs marginal via line fit (formerly profile_em2.py)
+# ---------------------------------------------------------------------------
+
+def cmd_slope(args):
+    N, T, k = _env_shapes()
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dfm_tpu.estim.em import EMConfig, em_fit_scan
+    from dfm_tpu.ssm import steady
+    from dfm_tpu.ops.scan import blocked_scan
+
+    dtype = jnp.float32
+    rng, Y, p0, Yj, pj = _panel(N, T, k, dtype)
+
+    def chain(x, scalar):
+        return x * (1.0 + jnp.zeros((), x.dtype) * scalar.astype(x.dtype))
+
+    @partial(jax.jit, static_argnames=("n", "tau"))
+    def cov_scan(p, C, n, tau):
+        def body(carry, _):
+            Cc = chain(C, carry)
+            Pp, Pf, M, ldG, delta = steady._cov_path(
+                Cc, p.A, p.Q, p.P0, tau, dtype)
+            out = (jnp.sum(Pp[-1]) + jnp.sum(Pf[-1]) + jnp.sum(M[-1])
+                   + jnp.sum(ldG) + delta)
+            return out, out
+        return lax.scan(body, jnp.zeros((), dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n",))
+    def means_scan(b, M_path, Pfilt, n):
+        def body(carry, _):
+            bb = chain(b, carry)
+            d = jnp.einsum("tkl,tl->tk", Pfilt[1:], bb[1:])
+            Mp, dp = blocked_scan(steady._affine_combine, (M_path[1:], d))
+            x_tail = jnp.einsum("tkl,l->tk", Mp, bb[0]) + dp
+            Jr, cr = blocked_scan(
+                lambda late, early: steady._affine_combine(late, early),
+                (M_path[1:], d), reverse=True)
+            out = jnp.sum(x_tail) + jnp.sum(Jr[0]) + jnp.sum(cr)
+            return out, out
+        return lax.scan(body, jnp.zeros((), b.dtype), None, length=n)[1]
+
+    @partial(jax.jit, static_argnames=("n",))
+    def means_ascan(b, M_path, Pfilt, n):
+        def body(carry, _):
+            bb = chain(b, carry)
+            d = jnp.einsum("tkl,tl->tk", Pfilt[1:], bb[1:])
+            Mp, dp = lax.associative_scan(
+                lambda a, bb_: steady._affine_combine(a, bb_),
+                (M_path[1:], d))
+            x_tail = jnp.einsum("tkl,l->tk", Mp, bb[0]) + dp
+            Jr, cr = lax.associative_scan(
+                lambda a, bb_: steady._affine_combine(a, bb_),
+                (M_path[1:], d), reverse=True)
+            out = jnp.sum(x_tail) + jnp.sum(Jr[0]) + jnp.sum(cr)
+            return out, out
+        return lax.scan(body, jnp.zeros((), b.dtype), None, length=n)[1]
+
+    C0 = np.asarray((p0.Lam / p0.R[:, None]).T @ p0.Lam, np.float32)
+    Cj = jnp.asarray(C0)
+    b0 = jnp.asarray(rng.standard_normal((T, k)), dtype)
+    M0 = jnp.asarray(
+        np.broadcast_to(np.asarray(p0.A, np.float32) * 0.5, (T, k, k)))
+    Pf0 = jnp.asarray(np.broadcast_to(np.eye(k, dtype=np.float32) * 0.3,
+                                      (T, k, k)))
+
+    ns = (50, 150, 300, 600)
+    with jax.default_matmul_precision("highest"):
+        def slope(name, f):
+            ts = [_timed(f, n) for n in ns]
+            A = np.vstack([np.ones(len(ns)), np.asarray(ns)]).T
+            (fixed, marg), *_ = np.linalg.lstsq(A, np.asarray(ts),
+                                                rcond=None)
+            print(f"{name:34s} fixed {fixed * 1e3:7.1f} ms   "
+                  f"marginal {marg * 1e3:7.3f} ms/iter   "
+                  f"({[f'{t:.3f}' for t in ts]})")
+            return fixed, marg
+
+        slope("means", lambda n: means_scan(b0, M0, Pf0, n))
+        slope("means assoc", lambda n: means_ascan(b0, M0, Pf0, n))
+        for tau in (8, 16):
+            slope(f"cov tau={tau}",
+                  lambda n, tau=tau: cov_scan(pj, Cj, n, tau))
+        for tau in (8, 16):
+            cfg = EMConfig(filter="ss", tau=tau)
+            slope(f"FULL em tau={tau}",
+                  lambda n, cfg=cfg: em_fit_scan(Yj, pj, n, cfg=cfg)[1])
+
+
+# ---------------------------------------------------------------------------
+# ablate — within-process ablation of the ss EM body (formerly profile_em3)
+# ---------------------------------------------------------------------------
+
+def cmd_ablate(args):
+    N, T, k = _env_shapes()
+    tau = int(os.environ.get("DFM_BENCH_TAU", 8))
+    n_iters = int(os.environ.get("DFM_BENCH_ITERS", 300))
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dfm_tpu.estim.em import (EMConfig, moment_sums,
+                                  mstep_rows, mstep_dynamics_sums)
+    from dfm_tpu.ssm.params import SSMParams as JP, SmootherResult
+    from dfm_tpu.ssm.steady import _cov_path, _freeze, _affine_combine
+    from dfm_tpu.ssm.info_filter import (obs_stats, quad_local, u_from_stats,
+                                         loglik_from_terms)
+    from dfm_tpu.ops.linalg import sym, psd_cholesky, chol_solve
+    from dfm_tpu.ops.scan import blocked_scan
+
+    dtype = jnp.float32
+    rng, Y, p0, Yj, pj = _panel(N, T, k, dtype)
+
+    # Ablation switches (static): each removes ONE piece, replacing its
+    # output with a cheap same-shaped fake that keeps upstream alive.
+    PIECES = ("covpath", "fwdmeans", "smcov", "jpath", "revmeans",
+              "quad", "syf", "bpass", "moments")
+
+    def em_body(Y, p, cfg, skip: frozenset, Ysq):
+        T_, k_ = Y.shape[0], p.A.shape[0]
+        I_k = jnp.eye(k_, dtype=Y.dtype)
+        if "bpass" in skip:
+            G = p.Lam[:64] / p.R[:64, None]
+            b = Y[:, :64] @ G                       # 64-series stand-in
+            C = p.Lam.T @ (p.Lam / p.R[:, None])
+            from dfm_tpu.ssm.info_filter import ObsStats
+            from dfm_tpu.ops.precision import accum_dtype
+            acc = accum_dtype(Y.dtype)
+            stats = ObsStats(b, C, jnp.full((T_,), float(N), Y.dtype),
+                             jnp.full((T_,), 1.0).astype(acc))
+        else:
+            stats = obs_stats(Y, p.Lam, p.R)
+        C = stats.C
+
+        if "covpath" in skip:
+            P1 = sym(p.P0 * 0.5)
+            Pp_ex = jnp.broadcast_to(P1, (tau, k_, k_))
+            Pf_ex = jnp.broadcast_to(P1 * 0.3, (tau, k_, k_))
+            M_ex = jnp.broadcast_to(p.A * 0.5, (tau, k_, k_))
+            ldG_ex = jnp.ones((tau,), Y.dtype)
+            delta = jnp.zeros((), Y.dtype)
+        else:
+            Pp_ex, Pf_ex, M_ex, ldG_ex, delta = _cov_path(
+                C, p.A, p.Q, p.P0, tau, Y.dtype)
+        P_pred = _freeze(Pp_ex, T_, tau)
+        P_filt = _freeze(Pf_ex, T_, tau)
+        M_path = _freeze(M_ex, T_, tau)
+        logdetG = _freeze(ldG_ex, T_, tau)
+
+        b = stats.b
+        x0 = p.mu0 + Pf_ex[0] @ (b[0] - C @ p.mu0)
+        if "fwdmeans" in skip:
+            x_filt = jnp.einsum("tkl,tl->tk", P_filt, b)
+        else:
+            d = jnp.einsum("tkl,tl->tk", P_filt[1:], b[1:])
+            Mpref, dpref = blocked_scan(_affine_combine, (M_path[1:], d))
+            x_tail = jnp.einsum("tkl,l->tk", Mpref, x0) + dpref
+            x_filt = jnp.concatenate([x0[None], x_tail], axis=0)
+        x_pred = jnp.concatenate([p.mu0[None], x_filt[:-1] @ p.A.T], axis=0)
+
+        if "jpath" in skip:
+            J = jnp.broadcast_to(p.A * 0.4, (T_ - 1, k_, k_))
+            J_ss = p.A * 0.4
+        else:
+            Lp_ex = psd_cholesky(Pp_ex[1:])
+            APf_ex = jnp.einsum("ij,tjk->tik", p.A, Pf_ex[:-1])
+            J_ex = jnp.swapaxes(jax.vmap(chol_solve)(Lp_ex, APf_ex), -1, -2)
+            Lp_ss = psd_cholesky(Pp_ex[-1])
+            J_ss = chol_solve(Lp_ss, p.A @ Pf_ex[-1]).T
+            J = jnp.concatenate(
+                [J_ex, jnp.broadcast_to(J_ss, (T_ - tau, k_, k_))], axis=0)
+
+        Pp_ss, Pf_ss = Pp_ex[-1], Pf_ex[-1]
+        if "smcov" in skip:
+            P_sm = P_filt
+        else:
+            def bstep_ss(Ps, _):
+                Ps_new = sym(Pf_ss + J_ss @ (Ps - Pp_ss) @ J_ss.T)
+                return Ps_new, Ps_new
+
+            Ps_mid, Psm_end_rev = lax.scan(bstep_ss, Pf_ss, None, length=tau)
+            Psm_end = jnp.flip(Psm_end_rev, axis=0)
+
+            def bstep_ex(Ps, inp):
+                P_f_t, P_p_next, J_t = inp
+                Ps_new = sym(P_f_t + J_t @ (Ps - P_p_next) @ J_t.T)
+                return Ps_new, Ps_new
+
+            Pp_next_ex = jnp.concatenate([Pp_ex[1:], Pp_ex[-1:]], axis=0)
+            _, Psm_front_rev = lax.scan(
+                bstep_ex, Ps_mid, (Pf_ex, Pp_next_ex, J[:tau]), reverse=True)
+            n_mid = T_ - 1 - 2 * tau
+            P_sm = jnp.concatenate([
+                Psm_front_rev,
+                jnp.broadcast_to(Ps_mid, (n_mid, k_, k_)),
+                Psm_end,
+                Pf_ss[None],
+            ], axis=0)
+
+        if "revmeans" in skip:
+            x_sm = x_filt
+        else:
+            c = x_filt[:-1] - jnp.einsum("tkl,tl->tk", J, x_pred[1:])
+            Jr, cr = blocked_scan(
+                lambda late, early: _affine_combine(late, early),
+                (J, c), reverse=True)
+            x_head = jnp.einsum("tkl,l->tk", Jr, x_filt[-1]) + cr
+            x_sm = jnp.concatenate([x_head, x_filt[-1:]], axis=0)
+
+        P_lag_tail = jnp.einsum("tij,tkj->tik", P_sm[1:], J)
+        P_lag = jnp.concatenate([jnp.zeros((1, k_, k_), Y.dtype),
+                                 P_lag_tail], axis=0)
+        sm = SmootherResult(x_sm, P_sm, P_lag)
+
+        if "quad" in skip:
+            quad_R = stats.n
+        else:
+            quad_R, _ = quad_local(Y, p.Lam, p.R, x_pred, None)
+        ll = loglik_from_terms(stats, logdetG, P_filt, quad_R,
+                               u_from_stats(stats, x_pred))
+
+        # ----- M-step -----
+        if "moments" in skip:
+            S_ff = C * 0.1 + I_k * float(T_)
+            S_lag = S_cur = S_ff
+            S_cross = S_ff * 0.5
+        else:
+            S_ff, S_lag, S_cur, S_cross = moment_sums(sm)
+        if "syf" in skip:
+            Lam, R = p.Lam, p.R
+        else:
+            Lam, R = mstep_rows(Y, None, sm.x_sm, None, None, S_ff,
+                                1e-6, Ysq=Ysq)
+        A, Q, mu0, P0 = mstep_dynamics_sums(sm, S_lag, S_cur, S_cross,
+                                            p, EMConfig())
+        return JP(Lam, A, Q, R, mu0, P0), (ll, delta)
+
+    @partial(jax.jit, static_argnames=("skip", "n"))
+    def em_scan(Y, p, skip, n):
+        Ysq = jnp.einsum("ti,ti->i", Y, Y)
+
+        def body(p_c, _):
+            return em_body(Y, p_c, None, skip, Ysq)
+
+        return lax.scan(body, p, None, length=n)[1]
+
+    def timed(skip):
+        return _timed(lambda: em_scan(Yj, pj, skip, n_iters), reps=4)
+
+    with jax.default_matmul_precision("highest"):
+        full = timed(frozenset())
+        print(f"{'FULL replica':12s} {full / n_iters * 1e3:7.3f} ms/iter "
+              f"(tau={tau}, {n_iters} fused)")
+        for piece in PIECES:
+            t = timed(frozenset([piece]))
+            print(f"-{piece:11s} {t / n_iters * 1e3:7.3f} ms/iter   "
+                  f"piece costs {(full - t) / n_iters * 1e3:+7.3f}")
+        t = timed(frozenset(PIECES))
+        print(f"-ALL         {t / n_iters * 1e3:7.3f} ms/iter (skeleton)")
+        # real em_fit_scan for cross-check, same process
+        from dfm_tpu.estim.em import em_fit_scan
+        cfg = EMConfig(filter="ss", tau=tau)
+        t = _timed(lambda: em_fit_scan(Yj, pj, n_iters, cfg=cfg)[1], reps=4)
+        print(f"real em_fit_scan {t / n_iters * 1e3:7.3f} ms/iter")
+
+
+# ---------------------------------------------------------------------------
+# pit — sequential vs parallel-in-time filter (formerly profile_pit.py)
+# ---------------------------------------------------------------------------
+
+def cmd_pit(args):
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from dfm_tpu.backends import cpu_ref
+    from dfm_tpu.utils import dgp
+    from dfm_tpu.ssm.info_filter import info_filter
+    from dfm_tpu.ssm.parallel_filter import pit_filter
+    from dfm_tpu.ssm.steady import ss_filter
+    from dfm_tpu.ssm.params import SSMParams as JP
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+    dtype = jnp.float32 if dev.platform == "tpu" else jnp.float64
+
+    rng = np.random.default_rng(0)
+    N, k = args.N, args.k
+    p_true = dgp.dfm_params(N, k, rng)
+
+    @partial(jax.jit, static_argnames=("which",))
+    def ll(Y, p, which):
+        f = {"info": info_filter, "pit": pit_filter,
+             "ss": partial(ss_filter, tau=16)}[which]
+        return f(Y, p).loglik
+
+    print(f"{'T':>7s} {'info ms':>9s} {'pit ms':>9s} {'ss ms':>9s} "
+          f"{'pit speedup':>12s}")
+    with jax.default_matmul_precision("highest"):
+        for T in (int(t) for t in args.Ts.split(",")):
+            Y, _ = dgp.simulate(p_true, T, rng)
+            Y = (Y - Y.mean(0)) / Y.std(0)
+            Yj = jnp.asarray(Y, dtype)
+            pj = JP.from_numpy(cpu_ref.pca_init(Y, k), dtype=dtype)
+            ti = _timed(ll, Yj, pj, "info")
+            tp = _timed(ll, Yj, pj, "pit")
+            ts = _timed(ll, Yj, pj, "ss")
+            print(f"{T:7d} {ti * 1e3:9.1f} {tp * 1e3:9.1f} {ts * 1e3:9.1f} "
+                  f"{ti / tp:11.2f}x")
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m bench.profile",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("components", help="per-piece ms/iter of the ss EM body")
+    sub.add_parser("slope", help="fixed vs marginal cost via n_iters fit")
+    sub.add_parser("ablate", help="within-process ablation of the ss EM body")
+    p_pit = sub.add_parser("pit", help="sequential vs PIT filter across T")
+    p_pit.add_argument("--cpu", action="store_true")
+    p_pit.add_argument("--N", type=int, default=32)
+    p_pit.add_argument("--k", type=int, default=4)
+    p_pit.add_argument("--Ts", default="2048,8192,32768")
+    args = ap.parse_args(argv)
+    {"components": cmd_components, "slope": cmd_slope,
+     "ablate": cmd_ablate, "pit": cmd_pit}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()

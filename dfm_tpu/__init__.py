@@ -12,8 +12,10 @@ mesh).
 from .api import (DynamicFactorModel, FitResult, fit, forecast,
                   Backend, CPUBackend, TPUBackend, ShardedBackend,
                   register_backend, get_backend)
-from .estim.select import bai_ng_ic, select_n_factors, targeted_predictors
+from .estim.select import (bai_ng_ic, select_n_factors, select_n_factors_em,
+                           targeted_predictors)
 from .estim.evaluate import oos_evaluate
+from .estim.batched import DFMBatchSpec, BatchFitResult, fit_many
 
 __version__ = "0.1.0"
 
@@ -21,6 +23,8 @@ __all__ = [
     "DynamicFactorModel", "FitResult", "fit", "forecast",
     "Backend", "CPUBackend", "TPUBackend", "ShardedBackend",
     "register_backend", "get_backend",
-    "bai_ng_ic", "select_n_factors", "targeted_predictors", "oos_evaluate",
+    "bai_ng_ic", "select_n_factors", "select_n_factors_em",
+    "targeted_predictors", "oos_evaluate",
+    "DFMBatchSpec", "BatchFitResult", "fit_many",
     "__version__",
 ]

@@ -140,6 +140,11 @@ class CPUBackend(Backend):
         return np.asarray(sm.x_sm), np.asarray(sm.P_sm)
 
 
+# Host one-pass standardize gate: below this element count the two-pass
+# f64 path is effectively free; a module constant so tests can lower it.
+_ONEPASS_MIN_SIZE = 4_000_000
+
+
 def _resolve_policy(robust):
     """``robust`` knob -> RobustPolicy | None (None means unguarded)."""
     if not robust:
@@ -829,7 +834,20 @@ def fit(model,                     # DynamicFactorModel | family spec
         W = build_mask(Y, mask)
         any_missing = bool((W == 0).any())
         if model.standardize:
-            Y, std = standardize(Y, mask=W if any_missing else None)
+            if (not any_missing and checkpoint_path is None
+                    and Y.size >= _ONEPASS_MIN_SIZE):
+                # Large fully-observed panel on the host path: one fused
+                # mean/var pass emitting the backend's compute dtype
+                # directly (an f32 backend skips the f64 intermediate —
+                # PERF.md host-prep line).  Checkpointing keeps the f64
+                # path: the data fingerprint hashes the standardized bytes.
+                from .utils.data import standardize_onepass
+                bdt = getattr(b, "_dtype", None)
+                out_dt = np.dtype(str(bdt())) if bdt is not None \
+                    else np.float64
+                Y, std = standardize_onepass(Y, out_dtype=out_dt)
+            else:
+                Y, std = standardize(Y, mask=W if any_missing else None)
         Wm = W if any_missing else None
         # Fully observed: Y already has no NaNs and the where() would be an
         # identity — skip the 40 MB copy (panels are never mutated).

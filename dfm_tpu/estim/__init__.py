@@ -1,14 +1,17 @@
 """Estimation layer (SURVEY.md L2): EM, model selection, evaluation."""
 
 from .em import EMConfig, em_step, em_fit, em_fit_scan, run_em_loop
-from .select import (bai_ng_ic, select_n_factors, lasso_path,
-                     targeted_predictors)
+from .select import (bai_ng_ic, select_n_factors, select_n_factors_em,
+                     EMSelectResult, lasso_path, targeted_predictors)
 from .evaluate import oos_evaluate, OOSResult
+from .batched import DFMBatchSpec, BatchFitResult, fit_many
 from .diffusion import diffusion_index_forecast, DIForecast
 
 __all__ = [
     "EMConfig", "em_step", "em_fit", "em_fit_scan", "run_em_loop",
-    "bai_ng_ic", "select_n_factors", "lasso_path", "targeted_predictors",
+    "bai_ng_ic", "select_n_factors", "select_n_factors_em", "EMSelectResult",
+    "lasso_path", "targeted_predictors",
     "oos_evaluate", "OOSResult",
+    "DFMBatchSpec", "BatchFitResult", "fit_many",
     "diffusion_index_forecast", "DIForecast",
 ]

@@ -1,0 +1,673 @@
+"""Batched multi-fit EM engine: B independent problems in ONE fused program.
+
+The serving-shaped workloads (EM restarts, Bai-Ng k-grid refits, rolling-
+window OOS evaluation) are host loops of independent ``fit()`` calls today,
+so each fit pays the ~100 ms tunnel dispatch plus the small-problem op floor
+(docs/PERF.md: "next levers: batching").  This module stacks B same-shaped
+(T, N, k) problems along a leading batch axis and runs them through one
+jitted ``lax.scan`` over EM iterations — B fits per dispatch instead of B
+dispatches.
+
+Design constraints this file encodes:
+
+- Everything inside the time scan is (B, k, k)/(B, k)-shaped with k ~ 2-8:
+  exactly the shapes the toolchain's batched-linalg path punishes ~100x
+  (PERF.md item 6a), so the scan body uses the unrolled small-matrix forms
+  from ``ops.linalg`` (``chol_unrolled`` / ``matmul_vpu``) throughout.
+- No early exit from the fused scan: per-problem convergence is tracked
+  IN-CARRY (state 0 run / 1 converged / 2 diverged / 3 pad) and finished
+  problems freeze via ``jnp.where`` selects — same stopping semantics as
+  the host loop (``em.em_progress`` / ``run_em_chunked``), including the
+  divergence rule's roll-back to the params entering the pre-drop
+  iteration (kept as ``p_prev`` in the carry, no replay dispatch needed).
+- The host driver runs fused chunks and checks the (B,) state vector
+  between chunks (one small transfer — the only execution barrier this
+  device class has); dispatches go through the ``robust.guard`` retry seam
+  and per-problem ``FitHealth`` records are built from the traces.
+- Unmasked panels only: a per-problem mask would make C_t time-varying
+  ((B, T, k, k) carried through the scan) and the masked M-step needs the
+  (T, k, k) moment tensors — the host-loop path already covers that case.
+
+The batch members may differ by init (restarts), by data (windows), or by
+ACTIVE factor count (k-grid): problems with k_b < k_max are padded with
+inert trailing factors (Lam cols 0, A zero row/col, Q/P0 identity block,
+mu0 0) which EM preserves exactly — zero loading columns keep the inactive
+block out of the loglik and every update (the blockdiag Cholesky has exact
+zero cross terms), so the padded problem's trace equals the unpadded k_b
+problem's to fp-op-order tolerance.  Results are sliced back to k_b.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..backends import cpu_ref
+from ..ops.linalg import (UNROLL_K_MAX, chol_logdet, chol_solve,
+                          chol_solve_unrolled, chol_unrolled, default_jitter,
+                          matmul_vpu, matvec_vpu, psd_cholesky, sym)
+from ..ops.precision import accum_dtype, default_compute_dtype
+from ..robust.health import FitHealth, HealthEvent, health_from_trace
+from ..ssm.params import SSMParams
+from ..utils.data import Standardizer, standardize, validate_panel
+from .em import EMConfig, noise_floor_for
+
+__all__ = ["DFMBatchSpec", "BatchFitResult", "fit_many", "run_batched_em",
+           "stack_params", "unstack_params", "pad_params_to_k",
+           "slice_params_to_k", "batched_m_step"]
+
+_LOG2PI = 1.8378770664093453
+
+
+# ---------------------------------------------------------------------------
+# Small-matrix batched linalg (PERF.md item 6a shapes)
+# ---------------------------------------------------------------------------
+
+def _bT(M):
+    return jnp.swapaxes(M, -1, -2)
+
+
+def bchol(P, jitter=None):
+    """Batched PSD Cholesky: unrolled elementwise form for k <= UNROLL_K_MAX
+    (the (B, k, k) batched-linalg lowering costs ~100x its flops here),
+    ``psd_cholesky`` above it.  Matches ``psd_cholesky`` exactly: sym +
+    dtype-matched jitter, NaN on a negative pivot."""
+    if jitter is None:
+        jitter = default_jitter(P.dtype)
+    if P.shape[-1] <= UNROLL_K_MAX:
+        return chol_unrolled(sym(P), jitter)
+    return psd_cholesky(P, jitter)
+
+
+def bchol_solve(L, B):
+    if L.shape[-1] <= UNROLL_K_MAX:
+        return chol_solve_unrolled(L, B)
+    return chol_solve(L, B)
+
+
+def _bsolve_rows(S, V):
+    """Row-wise PSD solve: V (..., n, k) rows, S (..., k, k) -> X with
+    X[..., i, :] = S^{-1} V[..., i, :].  Used for Lam = (S_ff^{-1} S_yf')'
+    and A = (S_lag^{-1} S_cross')' — both are "solve against many rows".
+
+    For small k the factor L broadcasts (..., 1, k, k) against (..., n, k)
+    so the unrolled VEC path runs k^2 elementwise ops over (..., n) arrays
+    — NOT n Python-unrolled columns (the matrix path would generate n * k^2
+    ops for Lam's n = N rows)."""
+    if S.shape[-1] <= UNROLL_K_MAX:
+        L = bchol(S)
+        return chol_solve_unrolled(L[..., None, :, :], V)
+    return _bT(chol_solve(psd_cholesky(S), _bT(V)))
+
+
+# ---------------------------------------------------------------------------
+# Param stacking / k-grid padding
+# ---------------------------------------------------------------------------
+
+def stack_params(ps: Sequence, dtype=None) -> SSMParams:
+    """Stack per-problem params (cpu_ref or jax SSMParams, same shapes)
+    into one SSMParams pytree with a leading B axis on every leaf."""
+    fields = zip(*((p.Lam, p.A, p.Q, p.R, p.mu0, p.P0) for p in ps))
+    return SSMParams(*(jnp.stack([jnp.asarray(x, dtype) for x in xs])
+                       for xs in fields))
+
+
+def unstack_params(p: SSMParams) -> List["cpu_ref.SSMParams"]:
+    """Split a batched SSMParams into per-problem NumPy f64 params."""
+    leaves = [np.asarray(x, np.float64) for x in p]
+    B = leaves[0].shape[0]
+    return [cpu_ref.SSMParams(*(lf[b] for lf in leaves)) for b in range(B)]
+
+
+def pad_params_to_k(p: "cpu_ref.SSMParams", k_max: int) -> "cpu_ref.SSMParams":
+    """Pad a k-factor param set to k_max with INERT trailing factors.
+
+    Lam gets zero columns, A a zero row/col block, Q and P0 an identity
+    block, mu0 zeros — a state-space model whose trailing factors are
+    unit-variance white noise that loads on nothing.  EM preserves this
+    structure exactly (zero loadings keep the inactive block out of every
+    moment sum), so the padded fit IS the k-factor fit; slice back with
+    ``slice_params_to_k``."""
+    k = p.Lam.shape[1]
+    if k > k_max:
+        raise ValueError(f"params have k={k} > k_max={k_max}")
+    if k == k_max:
+        return p
+    m = k_max - k
+    N = p.Lam.shape[0]
+
+    def block(M, fill_eye):
+        out = np.eye(k_max, dtype=np.float64) if fill_eye else \
+            np.zeros((k_max, k_max))
+        out[:k, :k] = M
+        if fill_eye:
+            out[:k, k:] = 0.0
+            out[k:, :k] = 0.0
+        return out
+
+    return cpu_ref.SSMParams(
+        Lam=np.concatenate([np.asarray(p.Lam, np.float64),
+                            np.zeros((N, m))], axis=1),
+        A=block(p.A, fill_eye=False),
+        Q=block(p.Q, fill_eye=True),
+        R=np.asarray(p.R, np.float64),
+        mu0=np.concatenate([np.asarray(p.mu0, np.float64), np.zeros(m)]),
+        P0=block(p.P0, fill_eye=True))
+
+
+def slice_params_to_k(p: "cpu_ref.SSMParams", k: int) -> "cpu_ref.SSMParams":
+    """Drop the inert trailing factors: leading-k slice of every block."""
+    return cpu_ref.SSMParams(Lam=p.Lam[:, :k], A=p.A[:k, :k], Q=p.Q[:k, :k],
+                             R=p.R, mu0=p.mu0[:k], P0=p.P0[:k, :k])
+
+
+# ---------------------------------------------------------------------------
+# Batched information-form filter + RTS smoother (template: ssm.info_filter)
+# ---------------------------------------------------------------------------
+
+def _batched_obs_stats(Y, Lam, R):
+    """Per-problem k-dim observation reductions (unmasked): b (B, T, k),
+    C (B, k, k), ldR (B,).  The einsums are the only place N appears."""
+    acc = accum_dtype(Y.dtype)
+    Rinv = 1.0 / R
+    G = Lam * Rinv[..., None]                       # (B, N, k)
+    b = jnp.einsum("btn,bnk->btk", Y, G)
+    C = jnp.einsum("bnk,bnl->bkl", Lam, G)
+    ldR = jnp.sum(jnp.log(R).astype(acc), axis=-1)  # (B,)
+    return b, C, ldR
+
+
+def _batched_info_scan(b_seq, C, A, Q, mu0, P0):
+    """k x k info-form time scan over B problems at once: every op in the
+    body is an unrolled/VPU form over the (B,) batch (a batched (B, k, k)
+    cholesky or dot_general here would be the whole wall — PERF.md 6a).
+
+    b_seq is TIME-major (T, B, k); C/A/Q are static per problem (B, k, k).
+    Returns time-major (x_pred, P_pred, x_filt, P_filt, logdetG)."""
+    k = A.shape[-1]
+    I_k = jnp.eye(k, dtype=b_seq.dtype)
+
+    def step(carry, b_t):
+        x, P = carry                                # (B, k), (B, k, k)
+        Lp = bchol(P)
+        CL = matmul_vpu(C, Lp)
+        G = I_k + matmul_vpu(_bT(Lp), CL)           # >= I: no jitter needed
+        Lg = bchol(G, jitter=0.0)
+        P_f = sym(matmul_vpu(Lp, bchol_solve(Lg, _bT(Lp))))
+        u = b_t - matvec_vpu(C, x)
+        x_f = x + matvec_vpu(P_f, u)
+        x_n = matvec_vpu(A, x_f)
+        P_n = sym(matmul_vpu(matmul_vpu(A, P_f), _bT(A)) + Q)
+        return (x_n, P_n), (x, P, x_f, P_f, chol_logdet(Lg))
+
+    return lax.scan(step, (mu0, P0), b_seq)[1]
+
+
+def _batched_loglik(Y, p, b, C, ldR, x_pred, P_filt, logdetG):
+    """Per-problem loglik (B,), same cancellation-free assembly as
+    ``info_filter.loglik_from_terms``: residual-pass quad_R, U from stats,
+    U'P_f U in compute dtype, (T,)-sized pieces assembled in accum dtype."""
+    acc = accum_dtype(Y.dtype)
+    N = Y.shape[-1]
+    V = Y - jnp.einsum("btk,bnk->btn", x_pred, p.Lam)
+    quad_R = jnp.sum((V * (V / p.R[:, None, :])).astype(acc), axis=-1)
+    U = b - jnp.einsum("bkl,btl->btk", C, x_pred)   # C symmetric
+    upu = jnp.einsum("btk,btkl,btl->bt", U, P_filt, U)
+    lls = -0.5 * (float(N) * _LOG2PI + ldR[:, None]
+                  + logdetG.astype(acc) + quad_R - upu.astype(acc))
+    return jnp.sum(lls, axis=1)
+
+
+def _batched_filter(Y, p):
+    """Info-form filter over the batch: returns (loglik (B,), batch-major
+    (x_pred, P_pred, x_filt, P_filt) with shapes (B, T, ...))."""
+    b, C, ldR = _batched_obs_stats(Y, p.Lam, p.R)
+    outs = _batched_info_scan(jnp.moveaxis(b, 1, 0), C, p.A, p.Q,
+                              p.mu0, p.P0)
+    xp, Pp, xf, Pf, ldG = (jnp.moveaxis(o, 0, 1) for o in outs)
+    ll = _batched_loglik(Y, p, b, C, ldR, xp, Pf, ldG)
+    return ll, (xp, Pp, xf, Pf)
+
+
+def _batched_rts(xp, Pp, xf, Pf, A):
+    """Batched RTS smoother (inputs batch-major (B, T, ...)); mirrors
+    ``ssm.kalman.rts_smoother`` with the scan body in VPU forms.
+    Returns (x_sm (B, T, k), P_sm (B, T, k, k), P_lag (B, T, k, k))."""
+    B, T, k = xf.shape
+    Pp_next = Pp[:, 1:]
+    APf = jnp.einsum("bij,btjk->btik", A, Pf[:, :-1])
+    L = bchol(Pp_next)
+    J = _bT(bchol_solve(L, APf))                    # (B, T-1, k, k)
+
+    def step(carry, inp):
+        x_next, P_next = carry
+        x_f, P_f, x_p_next, P_p_next, J_t = inp
+        x_s = x_f + matvec_vpu(J_t, x_next - x_p_next)
+        P_s = sym(P_f + matmul_vpu(matmul_vpu(J_t, P_next - P_p_next),
+                                   _bT(J_t)))
+        return (x_s, P_s), (x_s, P_s)
+
+    tm = lambda a: jnp.moveaxis(a, 1, 0)            # batch-major -> time-major
+    seq = (tm(xf[:, :-1]), tm(Pf[:, :-1]), tm(xp[:, 1:]), tm(Pp_next), tm(J))
+    _, (xs, Ps) = lax.scan(step, (xf[:, -1], Pf[:, -1]), seq, reverse=True)
+    x_sm = jnp.concatenate([jnp.moveaxis(xs, 0, 1), xf[:, -1:]], axis=1)
+    P_sm = jnp.concatenate([jnp.moveaxis(Ps, 0, 1), Pf[:, -1:]], axis=1)
+    P_lag = jnp.concatenate(
+        [jnp.zeros((B, 1, k, k), xf.dtype),
+         jnp.einsum("btij,btkj->btik", P_sm[:, 1:], J)], axis=1)
+    return x_sm, P_sm, P_lag
+
+
+# ---------------------------------------------------------------------------
+# Batched M-step (closed forms of em._m_step, unmasked, per problem)
+# ---------------------------------------------------------------------------
+
+def batched_m_step(Y, x_sm, P_sm, P_lag, p: SSMParams, cfg: EMConfig, Ysq):
+    """Per-problem closed-form M-step from batched smoother moments.
+
+    Same algebra as ``em.moment_sums`` + ``mstep_rows`` +
+    ``mstep_dynamics_sums``; the k x k solves go through ``_bsolve_rows``
+    (unrolled) and the k x k products through ``matmul_vpu``."""
+    T = Y.shape[1]
+    S_ff = P_sm.sum(1) + jnp.einsum("bti,btj->bij", x_sm, x_sm)
+    last = P_sm[:, -1] + jnp.einsum("bi,bj->bij", x_sm[:, -1], x_sm[:, -1])
+    first = P_sm[:, 0] + jnp.einsum("bi,bj->bij", x_sm[:, 0], x_sm[:, 0])
+    S_lag, S_cur = S_ff - last, S_ff - first
+    S_cross = P_lag[:, 1:].sum(1) + jnp.einsum("bti,btj->bij",
+                                               x_sm[:, 1:], x_sm[:, :-1])
+    S_yf = jnp.einsum("btn,btk->bnk", Y, x_sm)      # (B, N, k)
+    Lam = _bsolve_rows(S_ff, S_yf)
+    R = jnp.maximum(
+        (Ysq - jnp.einsum("bnk,bnk->bn", Lam, S_yf)) / T, cfg.r_floor)
+    A, Q = p.A, p.Q
+    if cfg.estimate_A:
+        A = _bsolve_rows(S_lag, S_cross)
+        if cfg.estimate_Q:
+            Q = sym((S_cur - matmul_vpu(A, _bT(S_cross))) / (T - 1))
+    elif cfg.estimate_Q:
+        Q = sym((S_cur - matmul_vpu(A, _bT(S_cross))
+                 - matmul_vpu(S_cross, _bT(A))
+                 + matmul_vpu(matmul_vpu(A, S_lag), _bT(A))) / (T - 1))
+    mu0, P0 = p.mu0, p.P0
+    if cfg.estimate_init:
+        mu0, P0 = x_sm[:, 0], sym(P_sm[:, 0])
+    return SSMParams(Lam, A, Q, R, mu0, P0)
+
+
+# ---------------------------------------------------------------------------
+# Fused chunk: n EM iterations with in-carry per-problem convergence
+# ---------------------------------------------------------------------------
+
+# Per-problem progress states carried through the scan.
+RUNNING, CONVERGED, DIVERGED, PADDED = 0, 1, 2, 3
+
+
+def _bmask(m, x):
+    """Broadcast a (B,) bool against an arbitrary (B, ...) leaf."""
+    return m.reshape(m.shape + (1,) * (x.ndim - 1))
+
+
+def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int):
+    """n fused EM iterations over the batch.  Pure (jit/shard_map-able).
+
+    carry = (p, p_prev, ll_prev (B,), state (B,) int32, n_lls (B,) int32):
+    ``p`` embodies the updates so far, ``p_prev`` the params ENTERING the
+    previous active iteration (the divergence roll-back target), ``state``
+    the per-problem progress, ``n_lls`` the trace length (the host slices
+    each problem's loglik column to this).  Frozen problems still compute
+    (no early exit from a fused program) but their carry is held by
+    ``jnp.where`` selects — the decision logic reproduces ``em_progress``
+    exactly, including NaN -> continue."""
+    Ysq = jnp.einsum("btn,btn->bn", Y, Y)           # iteration-invariant
+
+    def body(c, _):
+        p, p_prev, ll_prev, state, n_lls = c
+        ll, (xp, Pp, xf, Pf) = _batched_filter(Y, p)
+        x_sm, P_sm, P_lag = _batched_rts(xp, Pp, xf, Pf, p.A)
+        p_new = batched_m_step(Y, x_sm, P_sm, P_lag, p, cfg, Ysq)
+
+        active = state == RUNNING
+        n_new = n_lls + active.astype(n_lls.dtype)
+        # em_progress on the device: rel-tol convergence, noise-floor
+        # divergence, plateau-drop convergence; <2 lls -> continue.
+        rel = (ll - ll_prev) / jnp.maximum(jnp.abs(ll_prev), 1e-12)
+        drop = ll_prev - ll
+        conv_rel = (tol > 0) & (jnp.abs(rel) < tol)
+        diverged = drop > noise_floor
+        conv_plateau = (drop > 0) & (tol > 0)
+        prog = jnp.where(conv_rel, CONVERGED,
+                         jnp.where(diverged, DIVERGED,
+                                   jnp.where(conv_plateau, CONVERGED,
+                                             RUNNING)))
+        prog = jnp.where(n_new < 2, RUNNING, prog).astype(state.dtype)
+        new_state = jnp.where(active, prog, state)
+
+        adv = active & (prog != DIVERGED)   # take this iteration's update
+        roll = active & (prog == DIVERGED)  # roll back to pre-drop entry
+        p_out = jax.tree_util.tree_map(
+            lambda new, prv, cur: jnp.where(
+                _bmask(adv, new), new,
+                jnp.where(_bmask(roll, cur), prv, cur)),
+            p_new, p_prev, p)
+        p_prev_out = jax.tree_util.tree_map(
+            lambda cur, prv: jnp.where(_bmask(active, cur), cur, prv),
+            p, p_prev)
+        ll_prev_out = jnp.where(active, ll, ll_prev)
+        return (p_out, p_prev_out, ll_prev_out, new_state, n_new), ll
+
+    return lax.scan(body, carry, None, length=n_iters)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_iters"))
+def _em_chunk_impl(Y, carry, tol, noise_floor, cfg, n_iters):
+    return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters)
+
+
+def _smooth_core(Y, p):
+    """Batched filter+smoother -> (x_sm (B, T, k), P_sm (B, T, k, k))."""
+    _, (xp, Pp, xf, Pf) = _batched_filter(Y, p)
+    x_sm, P_sm, _ = _batched_rts(xp, Pp, xf, Pf, p.A)
+    return x_sm, P_sm
+
+
+_smooth_impl = jax.jit(_smooth_core)
+
+
+# ---------------------------------------------------------------------------
+# Host chunk driver: dispatch retry + per-problem health
+# ---------------------------------------------------------------------------
+
+def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
+                   tol: float, fused_chunk: int = 8, policy=None,
+                   scan_impl=None, state0=None):
+    """Chunked host driver around the fused batched-EM program.
+
+    ``Y`` (B, T, N) and ``p0`` batched (device or host arrays).  Runs
+    ceil(max_iters / fused_chunk) dispatches at most, stopping as soon as
+    every problem's in-carry state leaves RUNNING.  ``policy`` (a
+    ``robust.RobustPolicy``) wraps each dispatch in the guard's retry/
+    backoff seam; dispatch events are recorded on EVERY problem's health
+    (one program serves them all).  ``scan_impl`` overrides the jitted
+    chunk program (the sharded driver passes its shard_map'd twin);
+    ``state0`` overrides the initial per-problem state vector (the sharded
+    driver marks its pad problems PADDED so they freeze from the start).
+
+    Returns (params (batched SSMParams), lls_list (per-problem trace
+    arrays), converged (B,) bool, p_iters (B,) int, healths (B,) list).
+    """
+    B, T, N = Y.shape
+    Yj = jnp.asarray(Y)
+    dt = Yj.dtype
+    acc = accum_dtype(dt)
+    nf = noise_floor_for(dt, T * N, mult=cfg.noise_floor_mult)
+    impl = scan_impl if scan_impl is not None else _em_chunk_impl
+    tol_j = jnp.asarray(tol, acc)
+    nf_j = jnp.asarray(nf, acc)
+    state = (jnp.zeros((B,), jnp.int32) if state0 is None
+             else jnp.asarray(state0, jnp.int32))
+    carry = (p0, p0, jnp.zeros((B,), acc), state, jnp.zeros((B,), jnp.int32))
+
+    traces: list = []
+    dispatch_events: list = []
+    n_chunks = 0
+    n_retries = 0
+    it = 0
+    while it < max_iters:
+        n = min(max(1, int(fused_chunk)), max_iters - it)
+        attempts = 1 + (policy.dispatch_retries if policy is not None else 0)
+        delay = policy.backoff_base if policy is not None else 0.0
+        for a in range(attempts):
+            try:
+                new_carry, lls = impl(Yj, carry, tol_j, nf_j, cfg, n)
+                # The small state transfer is the execution barrier on this
+                # device class (block_until_ready is a no-op on axon).
+                state_h = np.asarray(new_carry[3])
+                lls_h = np.asarray(lls, np.float64)
+                break
+            except (policy.retry_exceptions if policy is not None
+                    else ()) as e:
+                last = a == attempts - 1
+                dispatch_events.append(HealthEvent(
+                    chunk=n_chunks, iteration=it, kind="dispatch_error",
+                    detail=f"{type(e).__name__}: {e}"[:200],
+                    action="abort" if last else "retried"))
+                if last:
+                    raise
+                n_retries += 1
+                time.sleep(delay)
+                delay *= policy.backoff_factor
+        carry = new_carry
+        traces.append(lls_h)                        # (n, B)
+        n_chunks += 1
+        it += n
+        if (state_h != RUNNING).all():
+            break
+
+    p, _, _, state_f, n_lls = carry
+    state_h = np.asarray(state_f)
+    n_lls_h = np.asarray(n_lls)
+    all_lls = (np.concatenate(traces, axis=0) if traces
+               else np.zeros((0, B)))
+    lls_list = [all_lls[:n_lls_h[b], b] for b in range(B)]
+    converged = state_h == CONVERGED
+    p_iters = np.where(state_h == DIVERGED,
+                       np.maximum(n_lls_h - 2, 0), n_lls_h)
+    healths = []
+    for b in range(B):
+        h = health_from_trace(lls_list[b], noise_floor=nf)
+        h.n_chunks = n_chunks
+        h.n_dispatch_retries = n_retries
+        for ev in dispatch_events:
+            h.record(dataclasses.replace(ev))
+        healths.append(h)
+    return p, lls_list, converged, p_iters, healths
+
+
+# ---------------------------------------------------------------------------
+# Public API: DFMBatchSpec / fit_many / BatchFitResult
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DFMBatchSpec:
+    """B same-shaped DFM problems to fit in one fused program.
+
+    Y: (B, T, N) stacked panels (fully observed — see module docstring).
+    model: shared ``DynamicFactorModel`` (its ``n_factors`` is k_max).
+    inits: optional per-problem ``cpu_ref.SSMParams`` in STANDARDIZED
+        units (what ``FitResult.params`` holds), each with k_b factors —
+        padded to k_max internally.  None -> per-problem PCA warm start.
+    k_active: optional (B,) active factor counts for the k-grid workload;
+        None means every problem uses all ``model.n_factors`` factors.
+    origins: optional (B,) window-origin bookkeeping for rolling-window
+        specs (carried through to the result; not used by the fit).
+    """
+
+    Y: np.ndarray
+    model: object
+    inits: Optional[list] = None
+    k_active: Optional[np.ndarray] = None
+    origins: Optional[np.ndarray] = None
+
+    @classmethod
+    def restarts(cls, model, Y, n_restarts: int, seed: int = 0,
+                 jitter: float = 0.1) -> "DFMBatchSpec":
+        """One panel, B jittered inits: restart 0 is the exact PCA warm
+        start, the rest perturb it (multiplicative loading noise,
+        log-normal R noise) so EM explores distinct basins."""
+        Y = np.asarray(Y, np.float64)
+        Yz = Y
+        if model.standardize:
+            Yz, _ = standardize(Y)
+        p0 = cpu_ref.pca_init(Yz, model.n_factors,
+                              static=(model.dynamics == "static"))
+        rng = np.random.default_rng(seed)
+        inits = [p0]
+        for _ in range(n_restarts - 1):
+            inits.append(cpu_ref.SSMParams(
+                Lam=p0.Lam * (1.0 + jitter * rng.standard_normal(p0.Lam.shape)),
+                A=p0.A.copy(), Q=p0.Q.copy(),
+                R=p0.R * np.exp(jitter * rng.standard_normal(p0.R.shape)),
+                mu0=p0.mu0.copy(), P0=p0.P0.copy()))
+        return cls(Y=np.broadcast_to(Y, (n_restarts,) + Y.shape).copy(),
+                   model=model, inits=inits)
+
+    @classmethod
+    def k_grid(cls, Y, ks: Sequence[int], dynamics: str = "ar1",
+               standardize: bool = True) -> "DFMBatchSpec":
+        """One panel fit at each k in ``ks``, padded to k_max = max(ks)."""
+        from ..api import DynamicFactorModel
+        ks = np.asarray(sorted(ks), np.int64)
+        Y = np.asarray(Y, np.float64)
+        model = DynamicFactorModel(n_factors=int(ks.max()), dynamics=dynamics,
+                                   standardize=standardize)
+        return cls(Y=np.broadcast_to(Y, (len(ks),) + Y.shape).copy(),
+                   model=model, k_active=ks)
+
+    @classmethod
+    def rolling_windows(cls, model, Y, origins: Sequence[int],
+                        train_len: int) -> "DFMBatchSpec":
+        """Fixed-length training windows ending at each origin (the rolling
+        OOS evaluation workload): window w trains on Y[t0-train_len:t0]."""
+        Y = np.asarray(Y, np.float64)
+        origins = np.asarray(origins, np.int64)
+        if (origins < train_len).any() or (origins > Y.shape[0]).any():
+            raise ValueError("origins must lie in [train_len, T]")
+        stacked = np.stack([Y[t0 - train_len:t0] for t0 in origins])
+        return cls(Y=stacked, model=model, origins=origins)
+
+
+@dataclasses.dataclass
+class BatchFitResult:
+    """Per-problem results of a batched fit (NumPy, de-jaxed, unpadded)."""
+
+    params: list                  # per-problem cpu_ref.SSMParams (std units)
+    logliks: list                 # per-problem loglik trace arrays
+    converged: np.ndarray         # (B,) bool
+    n_iters: np.ndarray           # (B,) trace lengths
+    p_iters: np.ndarray           # (B,) EM updates the params embody
+    factors: list                 # per-problem (T, k_b) smoothed means
+    factor_cov: list              # per-problem (T, k_b, k_b)
+    standardizers: list           # per-problem Standardizer | None
+    health: list                  # per-problem robust.FitHealth
+    model: object
+    spec: DFMBatchSpec
+    backend: str
+
+    @property
+    def logliks_final(self) -> np.ndarray:
+        return np.array([t[-1] if len(t) else np.nan for t in self.logliks])
+
+    def best(self) -> int:
+        """Index of the problem with the highest final loglik (restarts)."""
+        return int(np.nanargmax(self.logliks_final))
+
+
+def fit_many(spec: DFMBatchSpec, backend: str = "tpu", max_iters: int = 50,
+             tol: float = 1e-6, dtype=None, fused_chunk: int = 8,
+             n_devices: Optional[int] = None, robust=True,
+             device_init: bool = False) -> BatchFitResult:
+    """Fit B independent DFM problems in ONE fused program per chunk.
+
+    The batched twin of ``api.fit`` for same-shaped, fully-observed
+    problems: standardize each panel (same host path as ``fit``), PCA warm
+    starts (or ``spec.inits``), then the fused info-form EM with in-carry
+    convergence and a final batched smooth — 2 + ceil(iters/fused_chunk)
+    dispatches total instead of ~that many PER problem.
+
+    backend: "tpu" (single-device fused batch) or "sharded" (batch axis
+    split across the mesh — see ``parallel.batched``).  ``robust`` as in
+    ``api.fit``: True/policy wraps dispatches in the retry seam.
+    ``device_init`` opts into the vmapped Gram-eigh PCA init on device
+    (``estim.init.pca_init_batched``; uniform-k specs only) — the NumPy
+    initializer stays canonical, same policy as ``TPUBackend``.
+    """
+    from ..api import _resolve_policy
+    Y = np.asarray(spec.Y, np.float64)
+    if Y.ndim != 3:
+        raise ValueError(f"spec.Y must be (B, T, N), got {Y.shape}")
+    if not np.isfinite(Y).all():
+        raise ValueError("batched fits require fully-observed panels "
+                         "(no NaN/mask support); use api.fit per problem")
+    B, T, N = Y.shape
+    model = spec.model
+    k_max = model.n_factors
+    if k_max > min(T, N):
+        raise ValueError(f"n_factors={k_max} exceeds min(T, N)={min(T, N)}")
+    k_act = (np.full((B,), k_max, np.int64) if spec.k_active is None
+             else np.asarray(spec.k_active, np.int64))
+    if len(k_act) != B:
+        raise ValueError("k_active length != B")
+    if (k_act < 1).any() or (k_act > k_max).any():
+        raise ValueError("k_active entries must lie in [1, n_factors]")
+    static = model.dynamics == "static"
+
+    # Host prep: the same standardize() call api.fit uses, per problem.
+    Yz = np.empty_like(Y)
+    stds: list = []
+    for b in range(B):
+        validate_panel(Y[b], check_variance=model.standardize)
+        if model.standardize:
+            Yz[b], s = standardize(Y[b])
+            stds.append(s)
+        else:
+            Yz[b] = Y[b]
+            stds.append(None)
+
+    # Per-problem inits (canonical host PCA unless provided), padded to
+    # k_max with inert factors.
+    if spec.inits is not None:
+        if len(spec.inits) != B:
+            raise ValueError("spec.inits length != B")
+        inits = [pad_params_to_k(p, k_max) for p in spec.inits]
+    elif device_init and (k_act == k_max).all():
+        from .init import pca_init_batched
+        dt0 = dtype or default_compute_dtype()
+        inits = pca_init_batched(Yz, k_max, static=static, dtype=dt0)
+    else:
+        inits = [pad_params_to_k(
+            cpu_ref.pca_init(Yz[b], int(k_act[b]), static=static), k_max)
+            for b in range(B)]
+
+    dt = dtype or default_compute_dtype()
+    cfg = EMConfig(estimate_A=model.estimate_A, estimate_Q=model.estimate_Q,
+                   estimate_init=model.estimate_init, filter="info")
+    policy = _resolve_policy(robust)
+    Yj = jnp.asarray(Yz, dt)
+    p0 = stack_params(inits, dt)
+
+    with jax.default_matmul_precision("highest"):
+        if backend == "sharded":
+            from ..parallel.batched import (batched_smooth_sharded,
+                                            run_batched_em_sharded)
+            p, lls_list, conv, p_iters, healths = run_batched_em_sharded(
+                Yj, p0, cfg, max_iters, tol, fused_chunk=fused_chunk,
+                n_devices=n_devices, policy=policy)
+            x_sm, P_sm = batched_smooth_sharded(Yj, p, n_devices=n_devices)
+        elif backend == "tpu":
+            p, lls_list, conv, p_iters, healths = run_batched_em(
+                Yj, p0, cfg, max_iters, tol, fused_chunk=fused_chunk,
+                policy=policy)
+            x_sm, P_sm = _smooth_impl(Yj, p)
+        else:
+            raise ValueError(f"unknown batched backend {backend!r} "
+                             "(use 'tpu' or 'sharded')")
+        x_h = np.asarray(x_sm, np.float64)
+        P_h = np.asarray(P_sm, np.float64)
+
+    params = [slice_params_to_k(pb, int(k_act[b]))
+              for b, pb in enumerate(unstack_params(p))]
+    factors = [x_h[b, :, :k_act[b]] for b in range(B)]
+    factor_cov = [P_h[b, :, :k_act[b], :k_act[b]] for b in range(B)]
+    return BatchFitResult(
+        params=params, logliks=lls_list, converged=np.asarray(conv),
+        n_iters=np.array([len(t) for t in lls_list]),
+        p_iters=np.asarray(p_iters), factors=factors,
+        factor_cov=factor_cov, standardizers=stds, health=healths,
+        model=model, spec=spec, backend=backend)

@@ -23,7 +23,7 @@ import numpy as np
 
 from ..backends import cpu_ref
 
-__all__ = ["pca_init_device", "standardize_device"]
+__all__ = ["pca_init_device", "pca_init_batched", "standardize_device"]
 
 
 @jax.jit
@@ -78,3 +78,29 @@ def pca_init_device(Y, k: int, static: bool = False,
     A, Q, mu0, P0 = cpu_ref.var_tail(np.asarray(F, np.float64), k, static)
     return cpu_ref.SSMParams(np.asarray(Lam, np.float64), A, Q,
                              np.asarray(R, np.float64), mu0, P0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _pca_parts_batched(Y, k: int):
+    """vmapped Gram-eigh PCA over stacked panels (B, T, N)."""
+    return jax.vmap(lambda y: _pca_parts(y, k))(Y)
+
+
+def pca_init_batched(Y, k: int, static: bool = False, dtype=jnp.float32):
+    """Device PCA warm starts for a STACK of same-shaped panels.
+
+    One fused program runs the B Gram-eigh decompositions (the batched init
+    of ``estim.batched.fit_many``; per-problem this is ``_pca_parts``
+    exactly), then the k-sized VAR tails run on host per problem — same
+    placement split as ``pca_init_device``.  Panels must be standardized
+    with no missing entries.  Returns a list of B host-dtype param sets.
+    """
+    Lam, F, R = _pca_parts_batched(jnp.asarray(Y, dtype), k)
+    Lam_h = np.asarray(Lam, np.float64)
+    F_h = np.asarray(F, np.float64)
+    R_h = np.asarray(R, np.float64)
+    out = []
+    for b in range(Lam_h.shape[0]):
+        A, Q, mu0, P0 = cpu_ref.var_tail(F_h[b], k, static)
+        out.append(cpu_ref.SSMParams(Lam_h[b], A, Q, R_h[b], mu0, P0))
+    return out

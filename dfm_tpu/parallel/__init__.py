@@ -5,6 +5,8 @@ reference's (nonexistent) multi-process story, per BASELINE.json:5."""
 from .mesh import SERIES_AXIS, make_mesh, pad_panel, unpad_rows
 from .sharded import (ShardedEM, sharded_em_step, sharded_em_scan,
                       sharded_em_fit, sharded_filter_smoother)
+from .batched import (BATCH_AXIS, make_batch_mesh, run_batched_em_sharded,
+                      batched_smooth_sharded)
 from .sharded_mf import sharded_mf_fit
 from .sharded_sv import sharded_sv_filter
 from .sharded_tvl import sharded_tvl_fit
@@ -14,4 +16,6 @@ __all__ = [
     "ShardedEM", "sharded_em_step", "sharded_em_scan", "sharded_em_fit",
     "sharded_filter_smoother", "sharded_mf_fit", "sharded_sv_filter",
     "sharded_tvl_fit",
+    "BATCH_AXIS", "make_batch_mesh", "run_batched_em_sharded",
+    "batched_smooth_sharded",
 ]

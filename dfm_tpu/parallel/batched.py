@@ -1,0 +1,112 @@
+"""Batch-axis sharding for the fused multi-fit EM engine.
+
+The batched problems of ``estim.batched`` are INDEPENDENT — no collective
+ever crosses problem boundaries — so sharding is embarrassingly simple: a
+1-D mesh over a ``"batch"`` axis, ``shard_map`` around the same pure chunk
+core the single-device path jits, and batch padding (copies of problem 0,
+frozen from the start via the PADDED carry state) when B is not a multiple
+of the device count.  Each device runs B/D full EM problems; the host
+driver, convergence logic, health records, and robust retry seam are all
+shared with ``estim.batched.run_batched_em`` via its ``scan_impl`` /
+``state0`` hooks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..estim.batched import (PADDED, _em_chunk_core, _smooth_core,
+                             run_batched_em)
+from .mesh import shard_map
+
+__all__ = ["BATCH_AXIS", "make_batch_mesh", "run_batched_em_sharded",
+           "batched_smooth_sharded"]
+
+BATCH_AXIS = "batch"
+
+
+def make_batch_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)} "
+                f"(hint: XLA_FLAGS=--xla_force_host_platform_device_count=K)")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (BATCH_AXIS,))
+
+
+def _pad_batch(Y, p0, n_shards: int):
+    """Pad the batch axis to a multiple of n_shards with copies of problem
+    0 (data AND params — a valid problem, so no NaN risk; the driver
+    freezes the pads via the PADDED state and the caller slices them off)."""
+    B = Y.shape[0]
+    n_pad = (-B) % n_shards
+    if n_pad == 0:
+        return Y, p0, 0
+    rep = lambda x: jnp.concatenate(
+        [x, jnp.repeat(x[:1], n_pad, axis=0)], axis=0)
+    return rep(Y), jax.tree_util.tree_map(rep, p0), n_pad
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_iters", "mesh"))
+def _sharded_chunk_impl(Y, carry, tol, noise_floor, cfg, n_iters, mesh):
+    """shard_map'd twin of ``estim.batched._em_chunk_impl``: the same pure
+    chunk core, batch axis split over the mesh, NO collectives (the
+    problems are independent; specs are pytree prefixes, so P("batch")
+    covers every SSMParams leaf)."""
+    Pb = P(BATCH_AXIS)
+    body = lambda Yb, c, t, nf: _em_chunk_core(Yb, c, t, nf, cfg, n_iters)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P()),
+        out_specs=((Pb, Pb, Pb, Pb, Pb), P(None, BATCH_AXIS)),
+    )(Y, carry, tol, noise_floor)
+
+
+def run_batched_em_sharded(Y, p0, cfg, max_iters: int, tol: float,
+                           fused_chunk: int = 8,
+                           n_devices: Optional[int] = None, policy=None):
+    """Sharded batched-EM driver: same contract as ``run_batched_em``
+    (params, per-problem traces, converged, p_iters, healths), with the
+    batch axis laid across the mesh so B also scales across chips."""
+    mesh = make_batch_mesh(n_devices)
+    D = mesh.devices.size
+    B = Y.shape[0]
+    Yp, pp, n_pad = _pad_batch(jnp.asarray(Y), p0, D)
+    state0 = np.concatenate([np.zeros(B, np.int32),
+                             np.full(n_pad, PADDED, np.int32)])
+    p, lls_list, conv, p_iters, healths = run_batched_em(
+        Yp, pp, cfg, max_iters, tol, fused_chunk=fused_chunk, policy=policy,
+        scan_impl=partial(_sharded_chunk_impl, mesh=mesh), state0=state0)
+    if n_pad:
+        p = jax.tree_util.tree_map(lambda x: x[:B], p)
+        lls_list, conv = lls_list[:B], conv[:B]
+        p_iters, healths = p_iters[:B], healths[:B]
+    return p, lls_list, conv, p_iters, healths
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _sharded_smooth_impl(Y, p, mesh):
+    Pb = P(BATCH_AXIS)
+    return shard_map(_smooth_core, mesh=mesh, in_specs=(Pb, Pb),
+                     out_specs=(Pb, Pb))(Y, p)
+
+
+def batched_smooth_sharded(Y, p, n_devices: Optional[int] = None):
+    """Batched filter+smoother with the batch axis across the mesh."""
+    mesh = make_batch_mesh(n_devices)
+    D = mesh.devices.size
+    Yp, pp, n_pad = _pad_batch(jnp.asarray(Y), p, D)
+    x_sm, P_sm = _sharded_smooth_impl(Yp, pp, mesh)
+    if n_pad:
+        B = Y.shape[0]
+        x_sm, P_sm = x_sm[:B], P_sm[:B]
+    return x_sm, P_sm

@@ -113,8 +113,8 @@ def _affine_combine(earlier, later):
 
     No longer on the hot path (the mean recursions use
     ``affine_const_prefix`` since the doubling change) but kept for the
-    ``bench/profile_em*`` ablation scripts, which decompose the old
-    blocked-scan formulation piece by piece.
+    ``bench.profile`` subcommands (components/slope/ablate), which
+    decompose the old blocked-scan formulation piece by piece.
     """
     Me, de = earlier
     Ml, dl = later
@@ -271,7 +271,12 @@ def ss_filter_smoother(Y: jax.Array, p: SSMParams, tau: int = DEFAULT_TAU,
     ``info_filter.quad_expanded`` for why this needs the f64 assembly).
     """
     T = Y.shape[0]
-    if mask is not None or T <= 2 * tau + 4:
+    # tau <= 0 (a caller computing its own horizon from short windows can
+    # land there) must not reach the ss path: a zero-length exact-tail scan
+    # and a freeze at the prior are both wrong.  It means "no steady-state
+    # horizon" — route to the exact pair, same as masked/short panels.
+    tau = int(tau)
+    if mask is not None or tau < 1 or T <= 2 * tau + 4:
         kf = info_filter(Y, p, mask=mask)
         return kf, rts_smoother(kf, p), jnp.zeros((), Y.dtype)
 

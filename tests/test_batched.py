@@ -1,0 +1,190 @@
+"""Batched multi-fit engine equivalence (estim.batched / parallel.batched).
+
+The operative contract: ``fit_many`` over B stacked problems must
+reproduce B independent ``fit()`` calls — loglik traces, params,
+factors, convergence states, and health — while running ONE fused
+program per chunk.  Verified here on the fake 8-device CPU mesh
+(conftest), x64-exact and f32-tolerance variants, including staggered
+mid-chunk convergence, the sharded batch axis with padding, the k-grid
+inert-factor padding, restarts, and the API layers built on top
+(``select_n_factors_em``, batched ``oos_evaluate``).
+"""
+
+import numpy as np
+import pytest
+
+from dfm_tpu.api import DynamicFactorModel, TPUBackend, fit
+from dfm_tpu.estim.batched import BatchFitResult, DFMBatchSpec, fit_many
+from dfm_tpu.estim.evaluate import oos_evaluate
+from dfm_tpu.estim.select import select_n_factors_em
+from dfm_tpu.utils import dgp
+
+
+def _panels(B, T, N, k, seed=0, noises=None):
+    """B independent factor panels with optional per-problem noise scale."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(B):
+        F = rng.standard_normal((T, k))
+        Lam = rng.standard_normal((N, k))
+        nz = 0.5 if noises is None else noises[b]
+        out.append(F @ Lam.T + nz * rng.standard_normal((T, N)))
+    return np.stack(out)
+
+
+def _single_fits(model, Y, dtype, **kw):
+    return [fit(model, Y[b],
+                backend=TPUBackend(dtype=dtype, filter="info"), **kw)
+            for b in range(Y.shape[0])]
+
+
+def _assert_matches(res: BatchFitResult, singles, rtol=1e-9, atol=1e-7,
+                    p_rtol=1e-7):
+    for b, single in enumerate(singles):
+        tb, ts = res.logliks[b], single.logliks
+        assert len(tb) == len(ts), (b, len(tb), len(ts))
+        np.testing.assert_allclose(tb, ts, rtol=rtol, atol=atol)
+        np.testing.assert_allclose(res.params[b].Lam, single.params.Lam,
+                                   rtol=p_rtol, atol=1e-8)
+        assert bool(res.converged[b]) == bool(single.converged)
+
+
+def test_fit_many_matches_looped_x64():
+    Y = _panels(3, 60, 12, 2, seed=0)
+    model = DynamicFactorModel(n_factors=2, dynamics="ar1")
+    res = fit_many(DFMBatchSpec(Y=Y, model=model),
+                   max_iters=120, tol=1e-4, dtype=np.float64)
+    singles = _single_fits(model, Y, np.float64, max_iters=120, tol=1e-4)
+    _assert_matches(res, singles)
+    for b, single in enumerate(singles):
+        np.testing.assert_allclose(res.factors[b], single.factors,
+                                   rtol=1e-4, atol=1e-8)
+        assert res.health[b].ok, res.health[b].summary()
+        assert res.p_iters[b] == single.n_iters
+
+
+def test_fit_many_staggered_midchunk_convergence():
+    """Problems converging at different iterations INSIDE a fused chunk
+    (fused_chunk=7 does not divide anyone's stopping point) must freeze
+    via the in-carry state without perturbing the still-running ones."""
+    Y = _panels(4, 80, 15, 2, seed=1, noises=[0.05, 0.5, 2.0, 5.0])
+    model = DynamicFactorModel(n_factors=2, dynamics="ar1")
+    res = fit_many(DFMBatchSpec(Y=Y, model=model), max_iters=100,
+                   tol=1e-5, dtype=np.float64, fused_chunk=7)
+    singles = _single_fits(model, Y, np.float64, max_iters=100, tol=1e-5)
+    _assert_matches(res, singles, p_rtol=1e-6)
+    # The point of the test: they must NOT all stop at the same iteration.
+    assert len(set(res.n_iters.tolist())) > 1
+
+
+def test_fit_many_f32_fixed_iters():
+    """f32 variant at tol=0 (fixed iteration count — the convergence
+    decision itself is f32-noise-sensitive, the trajectory is not)."""
+    Y = _panels(3, 60, 12, 2, seed=2)
+    model = DynamicFactorModel(n_factors=2, dynamics="ar1")
+    res = fit_many(DFMBatchSpec(Y=Y, model=model),
+                   max_iters=10, tol=0.0, dtype=np.float32)
+    singles = _single_fits(model, Y, np.float32, max_iters=10, tol=0.0)
+    for b, single in enumerate(singles):
+        tb, ts = res.logliks[b], single.logliks
+        assert len(tb) == len(ts) == 10
+        # Same math, different reduction order: f32 rounding only.
+        np.testing.assert_allclose(tb, ts, rtol=2e-3, atol=0.5)
+        np.testing.assert_allclose(res.params[b].Lam, single.params.Lam,
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_fit_many_sharded_matches_single_device():
+    """Batch axis across the fake 8-device mesh, B=5 (not a multiple of
+    the mesh size — exercises the PADDED problems) must be bit-compatible
+    with the single-device batched path."""
+    Y = _panels(5, 60, 12, 2, seed=3, noises=[0.3, 0.7, 1.1, 1.5, 1.9])
+    model = DynamicFactorModel(n_factors=2, dynamics="ar1")
+    spec = DFMBatchSpec(Y=Y, model=model)
+    r1 = fit_many(spec, backend="tpu", max_iters=40, tol=1e-5,
+                  dtype=np.float64)
+    r2 = fit_many(spec, backend="sharded", max_iters=40, tol=1e-5,
+                  dtype=np.float64)
+    for b in range(5):
+        assert len(r1.logliks[b]) == len(r2.logliks[b])
+        np.testing.assert_allclose(r1.logliks[b], r2.logliks[b],
+                                   rtol=1e-10, atol=1e-8)
+        np.testing.assert_allclose(r1.params[b].Lam, r2.params[b].Lam,
+                                   rtol=1e-8, atol=1e-10)
+        np.testing.assert_allclose(r1.factors[b], r2.factors[b],
+                                   rtol=1e-8, atol=1e-10)
+        assert bool(r1.converged[b]) == bool(r2.converged[b])
+
+
+def test_k_grid_padding_matches_individual_fits():
+    """Inert-factor padding to k_max must leave each problem's EM
+    trajectory exactly what an unpadded fit at its own k produces."""
+    rng = np.random.default_rng(4)
+    F = rng.standard_normal((70, 3))
+    Lam = rng.standard_normal((14, 3))
+    Y = F @ Lam.T + 0.4 * rng.standard_normal((70, 14))
+    ks = [1, 3]
+    spec = DFMBatchSpec.k_grid(Y, ks=ks, dynamics="ar1")
+    res = fit_many(spec, max_iters=12, tol=0.0, dtype=np.float64)
+    for b, k in enumerate(ks):
+        model_k = DynamicFactorModel(n_factors=k, dynamics="ar1")
+        single = fit(model_k, Y,
+                     backend=TPUBackend(dtype=np.float64, filter="info"),
+                     max_iters=12, tol=0.0)
+        np.testing.assert_allclose(res.logliks[b], single.logliks,
+                                   rtol=1e-8, atol=1e-6)
+        assert res.params[b].Lam.shape == (14, k)
+        np.testing.assert_allclose(res.params[b].Lam, single.params.Lam,
+                                   rtol=1e-6, atol=1e-8)
+
+
+def test_restarts_best_and_exact_first_init():
+    rng = np.random.default_rng(5)
+    F = rng.standard_normal((60, 2))
+    Lam = rng.standard_normal((12, 2))
+    Y = F @ Lam.T + 0.5 * rng.standard_normal((60, 12))
+    model = DynamicFactorModel(n_factors=2, dynamics="ar1")
+    spec = DFMBatchSpec.restarts(model, Y, 4, seed=1)
+    res = fit_many(spec, max_iters=10, tol=0.0, dtype=np.float64)
+    finals = res.logliks_final
+    assert np.isfinite(finals).all()
+    assert res.best() == int(np.argmax(finals))
+    # Restart 0 is the unjittered PCA init — identical to a plain fit.
+    single = fit(model, Y,
+                 backend=TPUBackend(dtype=np.float64, filter="info"),
+                 max_iters=10, tol=0.0)
+    np.testing.assert_allclose(res.logliks[0], single.logliks,
+                               rtol=1e-9, atol=1e-7)
+
+
+def test_select_n_factors_em_recovers_true_k():
+    rng = np.random.default_rng(6)
+    p_true = dgp.dfm_params(16, 3, rng, noise_scale=0.3)
+    Y, _ = dgp.simulate(p_true, 90, rng)
+    sel = select_n_factors_em(Y, ks=[1, 2, 3, 4], max_iters=15,
+                              dtype=np.float64)
+    assert sel.k_best == 3
+    assert list(sel.ks) == [1, 2, 3, 4]
+    # loglik must be non-decreasing in k (nested models, same panel)
+    assert np.all(np.diff(sel.logliks) > -1e-6 * np.abs(sel.logliks[:-1]))
+
+
+def test_oos_warm_start_and_batched_engine():
+    rng = np.random.default_rng(7)
+    F = rng.standard_normal((90, 2))
+    Lam = rng.standard_normal((16, 2))
+    Y = F @ Lam.T + 0.4 * rng.standard_normal((90, 16))
+    model = DynamicFactorModel(n_factors=2)
+    cold = oos_evaluate(model, Y, n_windows=4, max_iters=8,
+                        warm_start=False)
+    warm = oos_evaluate(model, Y, n_windows=4, max_iters=8,
+                        warm_start=True)
+    bat = oos_evaluate(model, Y, n_windows=4, max_iters=8,
+                       engine="batched", backend="tpu")
+    for r in (cold, warm, bat):
+        assert np.isfinite(r.rel_rmse).all()
+        assert r.rel_rmse.shape == (16,)
+    # Warm starts change the trajectory but not validity: both must land
+    # in the same ballpark on a well-specified panel.
+    assert abs(warm.rel_rmse.mean() - cold.rel_rmse.mean()) < 0.25
+    assert abs(bat.rel_rmse.mean() - cold.rel_rmse.mean()) < 0.25

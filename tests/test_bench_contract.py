@@ -88,6 +88,30 @@ def _run_driver_style(code):
         timeout=900)  # > the 600s inner dryrun subprocess timeout
 
 
+def test_batched_bench_prints_one_json_line():
+    """bench.batched must keep the bench contract: exactly ONE JSON line
+    on stdout (diagnostics on stderr), smoke-sized via DFM_BENCH_*."""
+    import json
+    import os
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _driver_env()
+    env.update({"JAX_PLATFORMS": "cpu", "DFM_BENCH_B": "1,2",
+                "DFM_BENCH_N": "10", "DFM_BENCH_T": "30",
+                "DFM_BENCH_K": "2", "DFM_BENCH_ITERS": "3"})
+    proc = subprocess.run(
+        [sys.executable, "-m", "bench.batched"], cwd=repo, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    out = json.loads(lines[0])
+    assert out["unit"] == "iters/sec"
+    assert out["value"] > 0
+    assert set(out["sweep"]) == {"1", "2"}
+
+
 def test_dryrun_multichip_driver_context():
     """The VERDICT r1 failure: plain import + dryrun, no conftest, no env.
 

@@ -1,0 +1,169 @@
+"""Edge-case coverage for shapes the batched engine produces (short rolling
+windows) plus the one-pass host standardize.
+
+- ``ops.scan.affine_const_prefix``: sequence lengths that are not powers of
+  two (the doubling loop's padding logic), n = 0 and n = 1.
+- ``ssm.steady``: tau >= T (must fall back to the exact pair), tau <= 0
+  (means "no ss horizon" — exact pair, never a zero-length scan), T == 1,
+  and ``auto_tau`` staying inside its [lo, hi] bucket range.
+- ``utils.data.standardize_onepass``: equivalence with the two-pass f64
+  path, direct f32 emission, and the ``api.fit`` gate.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import dfm_tpu.api as api
+from dfm_tpu.api import DynamicFactorModel, fit
+from dfm_tpu.ops.scan import affine_const_prefix
+from dfm_tpu.ssm.info_filter import info_filter
+from dfm_tpu.ssm.kalman import rts_smoother
+from dfm_tpu.ssm.params import SSMParams
+from dfm_tpu.ssm.steady import auto_tau, ss_filter_smoother
+from dfm_tpu.utils import dgp
+from dfm_tpu.utils.data import standardize, standardize_onepass
+
+
+# ---------------------------------------------------------------------------
+# affine_const_prefix
+# ---------------------------------------------------------------------------
+
+def _naive_affine(M, d, x0):
+    xs, x = [], x0
+    for t in range(d.shape[0]):
+        x = M @ x + d[t]
+        xs.append(x.copy())
+    return (np.stack(xs) if xs
+            else np.zeros((0,) + x0.shape, dtype=x0.dtype))
+
+
+@pytest.mark.parametrize("n", [0, 1, 2, 3, 5, 12, 17])
+def test_affine_const_prefix_matches_naive(n):
+    """Lengths straddling/between powers of two — the doubling rounds must
+    window correctly when n + 1 is not a power of two (and n = 0 must
+    return an empty stack, n = 1 a single exact step)."""
+    rng = np.random.default_rng(5)
+    k = 3
+    M = 0.5 * rng.standard_normal((k, k)) / np.sqrt(k)   # contraction
+    d = rng.standard_normal((n, k))
+    x0 = rng.standard_normal(k)
+    out = np.asarray(affine_const_prefix(
+        jnp.asarray(M), jnp.asarray(d), jnp.asarray(x0)))
+    ref = _naive_affine(M, d, x0)
+    assert out.shape == (n, k)
+    np.testing.assert_allclose(out, ref, rtol=1e-10, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# steady-state fallbacks and auto_tau
+# ---------------------------------------------------------------------------
+
+def _exact_pair(Yj, pj):
+    kf = info_filter(Yj, pj)
+    return kf, rts_smoother(kf, pj)
+
+
+@pytest.mark.parametrize("tau", [0, -3, 50, 200])
+def test_ss_fallback_degenerate_tau_and_short_T(tau):
+    """tau <= 0 and tau >= T (T <= 2 tau + 4) must route to the exact
+    sequential pair bit-for-bit — no frozen-at-the-prior approximation."""
+    rng = np.random.default_rng(6)
+    p = dgp.dfm_params(8, 2, rng)
+    Y, _ = dgp.simulate(p, 50, rng)
+    pj = SSMParams.from_numpy(p, jnp.float64)
+    Yj = jnp.asarray(Y, jnp.float64)
+    kf, sm, delta = ss_filter_smoother(Yj, pj, tau=tau)
+    kfe, sme = _exact_pair(Yj, pj)
+    assert float(delta) == 0.0
+    np.testing.assert_allclose(float(kf.loglik), float(kfe.loglik),
+                               rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(sm.x_sm), np.asarray(sme.x_sm),
+                               rtol=1e-10, atol=1e-12)
+
+
+def test_ss_single_step_panel():
+    """T == 1: the shortest window the rolling evaluator can produce."""
+    rng = np.random.default_rng(7)
+    p = dgp.dfm_params(6, 2, rng)
+    Y, _ = dgp.simulate(p, 1, rng)
+    pj = SSMParams.from_numpy(p, jnp.float64)
+    Yj = jnp.asarray(Y, jnp.float64)
+    kf, sm, delta = ss_filter_smoother(Yj, pj, tau=8)
+    kfe, sme = _exact_pair(Yj, pj)
+    assert sm.x_sm.shape == (1, 2) and sm.P_sm.shape == (1, 2, 2)
+    np.testing.assert_allclose(float(kf.loglik), float(kfe.loglik),
+                               rtol=1e-12)
+
+
+def test_ss_non_power_of_two_T():
+    """The ss path itself (not the fallback) at a T where T - tau is not a
+    power of two — exercises the doubling windows inside the engine."""
+    rng = np.random.default_rng(8)
+    p = dgp.dfm_params(10, 2, rng, spectral_radius=0.6)
+    Y, _ = dgp.simulate(p, 137, rng)
+    pj = SSMParams.from_numpy(p, jnp.float64)
+    Yj = jnp.asarray(Y, jnp.float64)
+    kf, sm, _ = ss_filter_smoother(Yj, pj, tau=48)
+    kfe, sme = _exact_pair(Yj, pj)
+    np.testing.assert_allclose(float(kf.loglik), float(kfe.loglik),
+                               rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(sm.x_sm), np.asarray(sme.x_sm),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_auto_tau_stays_in_bucket_range():
+    rng = np.random.default_rng(9)
+    fast = dgp.dfm_params(10, 2, rng, spectral_radius=0.3)
+    slow = dgp.dfm_params(10, 2, rng, spectral_radius=0.98)
+    lo, hi = 8, 192
+    t_fast = auto_tau(fast, lo=lo, hi=hi)
+    t_slow = auto_tau(slow, lo=lo, hi=hi)
+    for t in (t_fast, t_slow):
+        assert lo <= t <= hi
+    assert t_fast <= t_slow
+
+
+# ---------------------------------------------------------------------------
+# one-pass standardize
+# ---------------------------------------------------------------------------
+
+def test_standardize_onepass_matches_two_pass_f64():
+    rng = np.random.default_rng(10)
+    Y = rng.standard_normal((300, 40)) * 3.0 + 7.0
+    Z1, s1 = standardize(Y)
+    Z2, s2 = standardize_onepass(Y)
+    assert Z2.dtype == np.float64
+    np.testing.assert_allclose(Z1, Z2, rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(s1.mean, s2.mean, rtol=1e-12)
+    np.testing.assert_allclose(s1.scale, s2.scale, rtol=1e-12)
+
+
+def test_standardize_onepass_emits_f32_directly():
+    rng = np.random.default_rng(11)
+    Y = rng.standard_normal((200, 30)) * 2.0 - 4.0
+    Z64, _ = standardize(Y)
+    Z32, s32 = standardize_onepass(Y, out_dtype=np.float32)
+    assert Z32.dtype == np.float32
+    # Stats still accumulate in f64; only the output write is f32.
+    assert s32.mean.dtype == np.float64
+    np.testing.assert_allclose(Z32, Z64.astype(np.float32),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_fit_onepass_gate_equivalence(monkeypatch):
+    """Lower the size gate so fit() takes the one-pass path and check the
+    fit is unchanged vs the two-pass route."""
+    rng = np.random.default_rng(12)
+    p = dgp.dfm_params(15, 2, rng, noise_scale=0.5)
+    Y, _ = dgp.simulate(p, 80, rng)
+    Y = Y + 3.0                       # nonzero mean so standardize matters
+    model = DynamicFactorModel(n_factors=2)
+    monkeypatch.setattr(api, "_ONEPASS_MIN_SIZE", 0)
+    r1 = fit(model, Y, backend="cpu", max_iters=8)
+    monkeypatch.setattr(api, "_ONEPASS_MIN_SIZE", 10 ** 12)
+    r2 = fit(model, Y, backend="cpu", max_iters=8)
+    np.testing.assert_allclose(r1.logliks, r2.logliks, rtol=1e-8)
+    np.testing.assert_allclose(r1.params.Lam, r2.params.Lam,
+                               rtol=1e-7, atol=1e-9)
