@@ -51,6 +51,7 @@ def main():
     from dfm_tpu.utils import dgp
     from dfm_tpu.estim.em import EMConfig, em_fit_scan
     from dfm_tpu.estim.batched import run_batched_em, stack_params
+    from dfm_tpu.obs.trace import Tracer, activate, current_tracer
     from dfm_tpu.ssm.params import SSMParams as JP
 
     dev = jax.devices()[0]
@@ -92,8 +93,16 @@ def main():
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
+    # Telemetry: DFM_TRACE=<path> seeds the ambient file tracer; without it
+    # a fresh in-memory one still counts dispatches/recompiles for the JSON
+    # line.  Both benched drivers (run_batched_em, em_fit_scan) carry their
+    # own dispatch spans, so activation alone instruments everything.
+    tracer = current_tracer()
+    if tracer is None:
+        tracer = Tracer()
+
     sweep = {}
-    with jax.default_matmul_precision("highest"):
+    with activate(tracer), jax.default_matmul_precision("highest"):
         # Looped driver: one fused em_fit_scan program per problem (same
         # compiled program for every b — identical shapes), B dispatches.
         def run_looped(B, n):
@@ -137,6 +146,11 @@ def main():
                 "speedup_vs_looped": round(t_l / t_b, 2),
             }
 
+    ts = tracer.summary()
+    log(f"telemetry: {ts['dispatches']} dispatches, "
+        f"{ts['recompiles']} recompiles"
+        + (f" -> {tracer.path}" if tracer.path else ""))
+
     head = sweep[str(B_max)]
     print(json.dumps({
         "metric": (f"batched_em_agg_iters_per_sec_B{B_max}_"
@@ -150,6 +164,10 @@ def main():
         "n_iters": n_iters,
         "shape": {"N": N, "T": T, "k": k, "dynamics": dynamics},
         "sweep": sweep,
+        # Per-B fused lengths are distinct programs: recompiles > 0 is
+        # the expected, truthful count for a sweep (obs/trace.py).
+        "dispatches": ts["dispatches"],
+        "recompiles": ts["recompiles"],
     }))
 
 

@@ -22,6 +22,7 @@ from typing import Callable, Dict, Optional, Type, Union
 import numpy as np
 
 from .backends import cpu_ref
+from .obs.trace import activate, current_tracer, fit_tracer, shape_key
 from .utils.data import Standardizer, build_mask, standardize
 
 __all__ = [
@@ -75,6 +76,10 @@ class FitResult:
     history: list                      # per-iter dicts {iter, loglik, secs}
     health: Optional[object] = None    # robust.FitHealth from guarded runs
     #                                  # (None: CPU oracle / unguarded path)
+    telemetry: Optional[dict] = None   # obs.report.summarize() of this
+    #                                  # fit's trace (fit(telemetry=...)
+    #                                  # only; None when telemetry is off
+    #                                  # or ambient via DFM_TRACE)
 
     @property
     def loglik(self) -> float:
@@ -367,8 +372,16 @@ class TPUBackend(Backend):
             from .ssm.kalman import kalman_filter
             from .ssm.info_filter import info_filter, smooth_jit
             ff = kalman_filter if cfg.filter == "dense" else info_filter
-            x_sm, P_sm = smooth_jit(Yj, mj if mj is not None else Yj, p, ff,
-                                    mask is not None)
+            tr = current_tracer()
+            if tr is None:
+                x_sm, P_sm = smooth_jit(Yj, mj if mj is not None else Yj, p,
+                                        ff, mask is not None)
+            else:
+                # Async dispatch: the transfer (and its span) happens when
+                # smooth() consumes the cache.
+                with tr.dispatch("smooth", shape_key(Yj, cfg.filter)):
+                    x_sm, P_sm = smooth_jit(Yj, mj if mj is not None else Yj,
+                                            p, ff, mask is not None)
             self._smooth_cache = (Y, mask, pn, x_sm, P_sm)
         return pn, np.asarray(lls), converged, p_iters
 
@@ -389,6 +402,13 @@ class TPUBackend(Backend):
         def scan_fn(p, n):
             p_new, lls, deltas = em_fit_scan(Yj, p, n, mask=mj, cfg=cfg)
             return p_new, lls, (deltas if cfg.filter == "ss" else None)
+
+        # Telemetry identity for the shared driver's dispatch spans; the
+        # sharded backend hands a pre-tagged em_fit_scan whose attrs win.
+        scan_fn.trace_name = getattr(em_fit_scan, "trace_name", "em_chunk")
+        scan_fn.trace_key = getattr(em_fit_scan, "trace_key",
+                                    shape_key(Yj, cfg.filter))
+        scan_fn.trace_engine = getattr(em_fit_scan, "trace_engine", "tpu_em")
 
         monitor = None
         # checkify debug mode is a diagnostic: its located errors must
@@ -423,8 +443,17 @@ class TPUBackend(Backend):
         self._smooth_cache = None
         if (cache is not None and cache[0] is Y and cache[1] is mask
                 and cache[2] is params):
-            return (np.asarray(cache[3], np.float64),
-                    np.asarray(cache[4], np.float64))
+            tr = current_tracer()
+            if tr is None:
+                return (np.asarray(cache[3], np.float64),
+                        np.asarray(cache[4], np.float64))
+            t0 = time.perf_counter()
+            x_sm = np.asarray(cache[3], np.float64)
+            P_sm = np.asarray(cache[4], np.float64)
+            tr.emit("transfer", t=t0, direction="d2h", what="factors",
+                    dur=time.perf_counter() - t0,
+                    bytes=int(x_sm.nbytes + P_sm.nbytes))
+            return x_sm, P_sm
         import jax.numpy as jnp
         from .ssm.kalman import kalman_filter
         from .ssm.info_filter import info_filter, smooth_jit
@@ -438,10 +467,17 @@ class TPUBackend(Backend):
               "ss": info_filter, "pit": info_filter}[
                   self._filter_for(Y.shape[1])]
         pj = JaxParams.from_numpy(params, dtype=dt)
+        tr = current_tracer()
         with self._precision_ctx():
             if mj is None:
                 mj = Yj  # dead placeholder (body ignores it) — no extra op
-            x_sm, P_sm = smooth_jit(Yj, mj, pj, ff, mask is not None)
+            if tr is None:
+                x_sm, P_sm = smooth_jit(Yj, mj, pj, ff, mask is not None)
+            else:
+                with tr.dispatch("smooth", shape_key(Yj), barrier=True):
+                    x_sm, P_sm = smooth_jit(Yj, mj, pj, ff, mask is not None)
+                    x_sm = np.asarray(x_sm, np.float64)
+                    P_sm = np.asarray(P_sm, np.float64)
         return np.asarray(x_sm, np.float64), np.asarray(P_sm, np.float64)
 
 
@@ -567,6 +603,10 @@ class ShardedBackend(TPUBackend):
 
             def scan_fn(Yj, p, n, mask=None, cfg=None):
                 return drv.run_scan(p, n)
+
+            scan_fn.trace_name = "sharded_em_chunk"
+            scan_fn.trace_key = drv._trace_key()
+            scan_fn.trace_engine = "sharded_em"
 
             controls = None
             if _resolve_policy(self.robust) is not None:
@@ -758,7 +798,8 @@ def fit(model,                     # DynamicFactorModel | family spec
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 10,
         debug: bool = False,
-        robust=None):
+        robust=None,
+        telemetry=None):
     """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
 
     ``model`` may also be a family spec — ``MixedFreqSpec``, ``TVLSpec``,
@@ -792,7 +833,40 @@ def fit(model,                     # DynamicFactorModel | family spec
         exhausted (e.g. persistent device dispatch failures) re-runs from
         the last good params on the NumPy f64 oracle instead of raising;
         ``FitResult.health`` records everything the guard saw/did.
+    telemetry : observability for THIS fit (see ``dfm_tpu.obs``): ``None``
+        inherits the ambient tracer (the ``DFM_TRACE=<path>`` env var),
+        ``False`` forces telemetry hard-off, ``True`` records in memory
+        and attaches the summary dict as ``FitResult.telemetry``, a path
+        string writes a JSONL trace there (and attaches the summary), and
+        an ``obs.Tracer`` instance is used as-is (the caller keeps
+        ownership and must close it).  With telemetry off the dispatch
+        path does zero extra work — no events, no clock reads, no host
+        syncs.  Family fits are traced too, but only ``FitResult`` carries
+        the summary attribute.
     """
+    tracer, owned = fit_tracer(telemetry)
+    t0 = time.perf_counter()
+    try:
+        with activate(tracer):
+            res = _fit_impl(model, Y, mask, backend, max_iters, tol, init,
+                            callback, checkpoint_path, checkpoint_every,
+                            debug, robust)
+            if tracer is not None and isinstance(res, FitResult):
+                tracer.emit("fit", t=t0, engine=res.backend,
+                            shape=shape_key(Y), n_iters=res.n_iters,
+                            converged=bool(res.converged),
+                            wall=time.perf_counter() - t0)
+    finally:
+        if owned:
+            tracer.close()
+    if (tracer is not None and telemetry not in (None, False)
+            and isinstance(res, FitResult)):
+        res.telemetry = tracer.summary()
+    return res
+
+
+def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
+              checkpoint_path, checkpoint_every, debug, robust):
     family = _family_fit(model, Y, mask, backend, max_iters, tol, init,
                          callback, checkpoint_path, debug)
     if family is not None:

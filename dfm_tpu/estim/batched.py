@@ -50,6 +50,7 @@ import numpy as np
 from jax import lax
 
 from ..backends import cpu_ref
+from ..obs.trace import current_tracer, shape_key
 from ..ops.linalg import (UNROLL_K_MAX, chol_logdet, chol_solve,
                           chol_solve_unrolled, chol_unrolled, default_jitter,
                           matmul_vpu, matvec_vpu, psd_cholesky, sym)
@@ -307,6 +308,8 @@ def batched_m_step(Y, x_sm, P_sm, P_lag, p: SSMParams, cfg: EMConfig, Ysq):
 
 # Per-problem progress states carried through the scan.
 RUNNING, CONVERGED, DIVERGED, PADDED = 0, 1, 2, 3
+STATE_NAMES = {RUNNING: "running", CONVERGED: "converged",
+               DIVERGED: "diverged", PADDED: "padded"}
 
 
 def _bmask(m, x):
@@ -414,6 +417,12 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
              else jnp.asarray(state0, jnp.int32))
     carry = (p0, p0, jnp.zeros((B,), acc), state, jnp.zeros((B,), jnp.int32))
 
+    tr = current_tracer()
+    prog = getattr(impl, "trace_name", "batched_em_chunk")
+    prog_key = getattr(impl, "trace_key", "")
+    engine = getattr(impl, "trace_engine", "batched_em")
+    state_prev_h = np.asarray(state) if tr is not None else None
+
     traces: list = []
     dispatch_events: list = []
     n_chunks = 0
@@ -425,19 +434,36 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
         delay = policy.backoff_base if policy is not None else 0.0
         for a in range(attempts):
             try:
-                new_carry, lls = impl(Yj, carry, tol_j, nf_j, cfg, n)
-                # The small state transfer is the execution barrier on this
-                # device class (block_until_ready is a no-op on axon).
-                state_h = np.asarray(new_carry[3])
-                lls_h = np.asarray(lls, np.float64)
+                if tr is None:
+                    new_carry, lls = impl(Yj, carry, tol_j, nf_j, cfg, n)
+                    # The small state transfer is the execution barrier on
+                    # this device class (block_until_ready is a no-op on
+                    # axon).
+                    state_h = np.asarray(new_carry[3])
+                    lls_h = np.asarray(lls, np.float64)
+                else:
+                    with tr.dispatch(prog,
+                                     shape_key(Yj, prog_key, f"iters{n}"),
+                                     barrier=True, n_iters=n, attempt=a):
+                        new_carry, lls = impl(Yj, carry, tol_j, nf_j, cfg, n)
+                        state_h = np.asarray(new_carry[3])
+                        lls_h = np.asarray(lls, np.float64)
                 break
             except (policy.retry_exceptions if policy is not None
                     else ()) as e:
                 last = a == attempts - 1
-                dispatch_events.append(HealthEvent(
+                ev = HealthEvent(
                     chunk=n_chunks, iteration=it, kind="dispatch_error",
                     detail=f"{type(e).__name__}: {e}"[:200],
-                    action="abort" if last else "retried"))
+                    action="abort" if last else "retried",
+                    t=time.perf_counter(), engine=engine)
+                dispatch_events.append(ev)
+                if tr is not None:
+                    # Emitted once here; the per-problem health fan-out
+                    # below replays with emit=False.
+                    tr.emit("health", t=ev.t, event=ev.kind, chunk=ev.chunk,
+                            iteration=ev.iteration, action=ev.action,
+                            detail=ev.detail, engine=ev.engine)
                 if last:
                     raise
                 n_retries += 1
@@ -447,6 +473,20 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
         traces.append(lls_h)                        # (n, B)
         n_chunks += 1
         it += n
+        if tr is not None:
+            # Per-problem state transitions (freezes) computed from the
+            # already-transferred state vector — no extra device traffic.
+            n_lls_h = np.asarray(new_carry[4])
+            for b in np.flatnonzero(state_h != state_prev_h):
+                tr.emit("freeze", engine=engine, problem=int(b),
+                        state=STATE_NAMES.get(int(state_h[b]), "?"),
+                        chunk=n_chunks - 1, iteration=int(n_lls_h[b]))
+            tr.emit("chunk", engine=engine, iter0=it - n, n=int(n),
+                    noise_floor=float(nf),
+                    running=int((state_h == RUNNING).sum()),
+                    converged=int((state_h == CONVERGED).sum()),
+                    diverged=int((state_h == DIVERGED).sum()))
+            state_prev_h = state_h
         if (state_h != RUNNING).all():
             break
 
@@ -461,11 +501,11 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                        np.maximum(n_lls_h - 2, 0), n_lls_h)
     healths = []
     for b in range(B):
-        h = health_from_trace(lls_list[b], noise_floor=nf)
+        h = health_from_trace(lls_list[b], noise_floor=nf, engine=engine)
         h.n_chunks = n_chunks
         h.n_dispatch_retries = n_retries
         for ev in dispatch_events:
-            h.record(dataclasses.replace(ev))
+            h.record(dataclasses.replace(ev), emit=False)
         healths.append(h)
     return p, lls_list, converged, p_iters, healths
 
@@ -649,17 +689,30 @@ def fit_many(spec: DFMBatchSpec, backend: str = "tpu", max_iters: int = 50,
             p, lls_list, conv, p_iters, healths = run_batched_em_sharded(
                 Yj, p0, cfg, max_iters, tol, fused_chunk=fused_chunk,
                 n_devices=n_devices, policy=policy)
-            x_sm, P_sm = batched_smooth_sharded(Yj, p, n_devices=n_devices)
+
+            def _smooth():
+                return batched_smooth_sharded(Yj, p, n_devices=n_devices)
         elif backend == "tpu":
             p, lls_list, conv, p_iters, healths = run_batched_em(
                 Yj, p0, cfg, max_iters, tol, fused_chunk=fused_chunk,
                 policy=policy)
-            x_sm, P_sm = _smooth_impl(Yj, p)
+
+            def _smooth():
+                return _smooth_impl(Yj, p)
         else:
             raise ValueError(f"unknown batched backend {backend!r} "
                              "(use 'tpu' or 'sharded')")
-        x_h = np.asarray(x_sm, np.float64)
-        P_h = np.asarray(P_sm, np.float64)
+        tr = current_tracer()
+        if tr is None:
+            x_sm, P_sm = _smooth()
+            x_h = np.asarray(x_sm, np.float64)
+            P_h = np.asarray(P_sm, np.float64)
+        else:
+            with tr.dispatch("batched_smooth", shape_key(Yj, backend),
+                             barrier=True):
+                x_sm, P_sm = _smooth()
+                x_h = np.asarray(x_sm, np.float64)
+                P_h = np.asarray(P_sm, np.float64)
 
     params = [slice_params_to_k(pb, int(k_act[b]))
               for b, pb in enumerate(unstack_params(p))]

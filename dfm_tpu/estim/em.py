@@ -16,6 +16,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..obs.trace import current_tracer, shape_key
 from ..ops.linalg import sym, solve_psd
 from ..ssm.kalman import kalman_filter, rts_smoother
 from ..ssm.info_filter import info_filter
@@ -245,7 +246,11 @@ def em_step(Y, p: SSMParams, mask=None, cfg: EMConfig = EMConfig()):
         err, out = _em_step_checked_impl(Y, mask, p, cfg, mask is not None)
         err.throw()
         return out
-    return _em_step_impl(Y, mask, p, cfg, mask is not None)
+    tr = current_tracer()
+    if tr is None:
+        return _em_step_impl(Y, mask, p, cfg, mask is not None)
+    with tr.dispatch("em_step", shape_key(Y, cfg.filter)):
+        return _em_step_impl(Y, mask, p, cfg, mask is not None)
 
 
 def em_progress(lls, tol: float, noise_floor: float = 0.0) -> str:
@@ -357,6 +362,10 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
     import numpy as np
     fused_chunk = max(1, int(fused_chunk))   # 0/negative would never advance
     pass_piter = getattr(callback, "wants_params_iter", False)
+    tr = current_tracer()
+    prog = getattr(scan_fn, "trace_name", "em_chunk")
+    prog_key = getattr(scan_fn, "trace_key", "")
+    engine = getattr(scan_fn, "trace_engine", prog)
     lls: list = []
     converged = False
     stop = False
@@ -370,8 +379,25 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         n = min(fused_chunk, max_iters - it)
         p_entry_prev, entry_it_prev = p_entry, entry_it
         p_entry, entry_it = p, it
-        p, chunk, deltas = scan_fn(p, n)
-        chunk = np.asarray(chunk, np.float64)
+        if tr is None:
+            p, chunk, deltas = scan_fn(p, n)
+            chunk = np.asarray(chunk, np.float64)
+        else:
+            # The np.asarray transfer is the execution barrier (CLAUDE.md:
+            # block_until_ready is a no-op on axon), so the span wall time
+            # is true chunk execution + tunnel latency.  A distinct fused
+            # length n is a distinct XLA program -> part of the shape key.
+            with tr.dispatch(prog, shape_key(prog_key, f"iters{n}"),
+                             barrier=True, n_iters=n):
+                p, chunk, deltas = scan_fn(p, n)
+                chunk = np.asarray(chunk, np.float64)
+            drops = np.diff(chunk)
+            tr.emit("chunk", engine=engine, iter0=it, n=int(n),
+                    lls=[float(x) for x in chunk],
+                    noise_floor=float(noise_floor),
+                    max_drop=float(-drops.min()) if drops.size else 0.0,
+                    below_floor=bool(drops.size == 0
+                                     or np.abs(drops).max() < noise_floor))
         consumed = n
         for j, ll in enumerate(chunk):
             lls.append(float(ll))
@@ -409,7 +435,14 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         base, base_it = ((p_entry, entry_it) if target >= entry_it
                          else (p_entry_prev, entry_it_prev))
         n_replay = target - base_it
-        p = base if n_replay == 0 else scan_fn(base, n_replay)[0]
+        if n_replay == 0:
+            p = base
+        elif tr is None:
+            p = scan_fn(base, n_replay)[0]
+        else:
+            with tr.dispatch(prog, shape_key(prog_key, f"iters{n_replay}"),
+                             n_iters=n_replay, replay=True):
+                p = scan_fn(base, n_replay)[0]
         p_iters = target
     # (a stop with target == it needs nothing: the chunk end already
     # embodies exactly `target` updates and p_iters == it == target)
@@ -516,4 +549,14 @@ def em_fit_scan(Y, p0: SSMParams, n_iters: int, mask=None,
                                              mask is not None, n_iters)
         err.throw()
         return out
-    return _em_fit_scan_impl(Y, mask, p0, cfg, mask is not None, n_iters)
+    tr = current_tracer()
+    if tr is None:
+        return _em_fit_scan_impl(Y, mask, p0, cfg, mask is not None, n_iters)
+    # When called from a chunk driver this span is suppressed (the driver's
+    # barrier'd span owns the launch); direct callers (bench, dryrun) get
+    # the async-dispatch record here.
+    key = shape_key(Y, cfg.filter, f"iters{n_iters}")
+    tr.maybe_cost("em_fit_scan", key, _em_fit_scan_impl,
+                  Y, mask, p0, cfg, mask is not None, n_iters)
+    with tr.dispatch("em_fit_scan", key, n_iters=n_iters):
+        return _em_fit_scan_impl(Y, mask, p0, cfg, mask is not None, n_iters)

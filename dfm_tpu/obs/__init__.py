@@ -1,0 +1,24 @@
+"""Telemetry: dispatch/compile tracing, convergence telemetry, cost reports.
+
+Zero-overhead when off: every instrumentation site is gated on
+``current_tracer() is None``.  Activate with ``fit(telemetry=...)`` or
+``DFM_TRACE=<path>``; summarize with ``python -m dfm_tpu.obs.report``.
+"""
+
+from .cost import (RecompileDetector, global_detector, program_cost,
+                   reset_global_detector)
+from .trace import Tracer, activate, current_tracer, fit_tracer, shape_key
+
+
+def summarize(events_or_path):
+    """Aggregate an event stream (lazy import: keeps ``python -m
+    dfm_tpu.obs.report`` from double-importing its own module via the
+    package, and the package import free of report's argparse)."""
+    from .report import summarize as _summarize
+    return _summarize(events_or_path)
+
+__all__ = [
+    "Tracer", "activate", "current_tracer", "fit_tracer", "shape_key",
+    "RecompileDetector", "global_detector", "reset_global_detector",
+    "program_cost", "summarize",
+]

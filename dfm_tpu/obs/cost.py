@@ -1,0 +1,117 @@
+"""Static program cost capture + recompile detection.
+
+Two observability primitives that need nothing from the hot path:
+
+- ``program_cost``: XLA's own static cost model for a jitted program at a
+  concrete arg signature, via ``jitted.lower(...).compile()`` then
+  ``cost_analysis()`` / ``memory_analysis()``.  Flops and bytes are what
+  the COMPILER thinks the program costs — the roofline numerator the
+  measured dispatch wall times (``obs.trace``) divide against.  Opt-in
+  (``Tracer(capture_costs=True)`` / ``DFM_TRACE_COST=1``): the
+  lower+compile pass is itself a compile-scale cost.
+
+- ``RecompileDetector``: flags when the same LOGICAL program (by name)
+  is dispatched under a second distinct shape key.  On a tunneled device
+  every compile is seconds of wall time, so shape churn — a panel
+  re-padded to a new length, a chunk tail of a different fused length, a
+  dtype flip — silently erases the dispatch-amortization the chunked
+  drivers exist for.  The detector is PROCESS-local (module singleton),
+  mirroring XLA's own process-level executable cache: a program+key pair
+  compiled once in this process never recompiles, so a repeated
+  same-shape fit must show zero first-calls and zero recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Set
+
+__all__ = ["RecompileDetector", "global_detector", "reset_global_detector",
+           "program_cost"]
+
+
+class RecompileDetector:
+    """Tracks (program, shape_key) pairs across dispatches.
+
+    ``note`` classifies each dispatch:
+      "new"       first time this program is seen at all
+      "cached"    this exact (program, key) pair has dispatched before
+      "recompile" a NEW key for a program that already compiled under a
+                  different one — the shape-churn signal
+    """
+
+    def __init__(self):
+        self._keys: Dict[str, Set[str]] = {}
+
+    def note(self, program: str, key: str) -> str:
+        seen = self._keys.setdefault(program, set())
+        if key in seen:
+            return "cached"
+        seen.add(key)
+        return "recompile" if len(seen) > 1 else "new"
+
+    def keys_for(self, program: str) -> Set[str]:
+        return set(self._keys.get(program, ()))
+
+
+_GLOBAL = RecompileDetector()
+
+
+def global_detector() -> RecompileDetector:
+    """The process-local detector (default for every ``Tracer``)."""
+    return _GLOBAL
+
+
+def reset_global_detector() -> None:
+    """Forget all seen programs (test seam; XLA's cache is NOT cleared, so
+    first-call wall times after a reset are not compile proxies)."""
+    global _GLOBAL
+    _GLOBAL = RecompileDetector()
+
+
+def _mem_stats(compiled) -> dict:
+    try:
+        m = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if m is None:
+        return {}
+    out = {}
+    for name, field in (("argument_bytes", "argument_size_in_bytes"),
+                        ("output_bytes", "output_size_in_bytes"),
+                        ("temp_bytes", "temp_size_in_bytes"),
+                        ("code_bytes", "generated_code_size_in_bytes")):
+        v = getattr(m, field, None)
+        if v is not None:
+            out[name] = int(v)
+    return out
+
+
+def program_cost(jitted, *args, **kwargs) -> Optional[dict]:
+    """Static cost of ``jitted`` at this arg signature, or None.
+
+    Returns ``{"flops": float, "bytes_accessed": float, "transcendentals":
+    float, "argument_bytes": int, ...}`` with whatever XLA reports
+    (``cost_analysis`` returns a per-computation list on some toolchains
+    and a flat dict on others; both are handled).  Never raises: a
+    backend without a cost model yields None.
+    """
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+    except Exception:
+        return None
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        ca = None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    out = {}
+    if isinstance(ca, dict):
+        for name, field in (("flops", "flops"),
+                            ("bytes_accessed", "bytes accessed"),
+                            ("transcendentals", "transcendentals")):
+            v = ca.get(field)
+            if v is not None:
+                out[name] = float(v)
+    out.update(_mem_stats(compiled))
+    return out or None

@@ -1,0 +1,234 @@
+"""Offline trace summary: ``python -m dfm_tpu.obs.report trace.jsonl``.
+
+Pure Python (no jax import) so the report runs instantly anywhere — on the
+operator's laptop against a trace scp'd off the bench host, or in the round
+driver between runs.  ``summarize`` is also what ``Tracer.summary()`` and
+``FitResult.telemetry`` delegate to, so the offline CLI and the in-process
+summary can never drift.
+
+What it computes from the event stream (schema: ``obs/trace.py``):
+- dispatch histogram per program, first-call vs steady wall times (the
+  first-call minus steady-state gap is the only compile-time proxy the
+  axon tunnel exposes), recompile events
+- amortized tunnel latency: barrier'd dispatch wall / fused iterations —
+  comparable against the sustained two-point rate in docs/PERF.md
+- the convergence curve: per-chunk logliks, deltas vs the noise floor
+- per-problem freezes (batched engine) and health events
+- static flops/bytes per program when cost capture was on
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Union
+
+__all__ = ["load", "summarize", "main"]
+
+
+def load(path: str) -> List[dict]:
+    events = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i + 1}: invalid JSONL: {e}") from e
+    return events
+
+
+def _stats(xs: List[float]) -> dict:
+    if not xs:
+        return {}
+    xs = sorted(xs)
+    n = len(xs)
+    return {"n": n, "min": xs[0], "max": xs[-1],
+            "mean": sum(xs) / n, "p50": xs[n // 2]}
+
+
+def summarize(events_or_path: Union[str, List[dict]]) -> dict:
+    """Aggregate an event stream (list of dicts, or a JSONL path)."""
+    events = (load(events_or_path) if isinstance(events_or_path, str)
+              else list(events_or_path))
+
+    disp = [e for e in events if e.get("kind") == "dispatch"]
+    by_prog: dict = {}
+    for e in disp:
+        p = by_prog.setdefault(e.get("program", "?"), {
+            "dispatches": 0, "first_calls": 0, "recompiles": 0, "errors": 0,
+            "keys": set(), "first_durs": [], "steady_durs": [],
+            "barrier_durs": [], "fused_iters": 0})
+        p["dispatches"] += 1
+        p["keys"].add(e.get("key", ""))
+        if e.get("error"):
+            p["errors"] += 1
+        first = bool(e.get("first_call"))
+        p["first_calls"] += first
+        p["recompiles"] += bool(e.get("recompile"))
+        dur = e.get("dur")
+        if dur is not None:
+            (p["first_durs"] if first else p["steady_durs"]).append(dur)
+            if e.get("barrier"):
+                p["barrier_durs"].append(dur)
+                p["fused_iters"] += int(e.get("n_iters") or 1)
+
+    programs = {}
+    for name, p in sorted(by_prog.items()):
+        entry = {"dispatches": p["dispatches"],
+                 "first_calls": p["first_calls"],
+                 "recompiles": p["recompiles"],
+                 "shape_keys": sorted(p["keys"])}
+        if p["errors"]:
+            entry["errors"] = p["errors"]
+        if p["first_durs"]:
+            entry["first_call_s"] = _stats(p["first_durs"])
+        if p["steady_durs"]:
+            entry["steady_s"] = _stats(p["steady_durs"])
+        # Compile proxy: how much slower the first call ran than steady state.
+        if p["first_durs"] and p["steady_durs"]:
+            entry["compile_proxy_s"] = (max(p["first_durs"])
+                                        - _stats(p["steady_durs"])["p50"])
+        if p["fused_iters"]:
+            entry["amortized_ms_per_iter"] = (
+                1e3 * sum(p["barrier_durs"]) / p["fused_iters"])
+        programs[name] = entry
+
+    chunks = [e for e in events if e.get("kind") == "chunk"]
+    convergence = None
+    if chunks:
+        lls: List[float] = []
+        for c in chunks:
+            lls.extend(float(x) for x in c.get("lls", []))
+        deltas = [lls[i + 1] - lls[i] for i in range(len(lls) - 1)]
+        nf = next((c.get("noise_floor") for c in chunks
+                   if c.get("noise_floor") is not None), None)
+        convergence = {"n_chunks": len(chunks), "n_iters": len(lls),
+                       "loglik_first": lls[0] if lls else None,
+                       "loglik_last": lls[-1] if lls else None,
+                       "deltas": deltas, "noise_floor": nf,
+                       "below_floor": sum(1 for c in chunks
+                                          if c.get("below_floor"))}
+        if nf is not None and deltas:
+            convergence["deltas_below_floor"] = sum(
+                1 for d in deltas if abs(d) < nf)
+
+    freezes = [e for e in events if e.get("kind") == "freeze"]
+    health = [e for e in events if e.get("kind") == "health"]
+    costs = {e.get("program", "?"): {k: v for k, v in e.items()
+                                     if k not in ("t", "kind", "program")}
+             for e in events if e.get("kind") == "cost"}
+    fits = [{k: v for k, v in e.items() if k != "kind"}
+            for e in events if e.get("kind") == "fit"]
+
+    out = {
+        "n_events": len(events),
+        "dispatches": len(disp),
+        "first_calls": sum(1 for e in disp if e.get("first_call")),
+        "recompiles": sum(1 for e in disp if e.get("recompile")),
+        "dispatch_errors": sum(1 for e in disp if e.get("error")),
+        "programs": programs,
+    }
+    walls = [e["dur"] for e in disp
+             if e.get("dur") is not None and e.get("barrier")]
+    if walls:
+        out["barrier_dispatch_s"] = _stats(walls)
+        fused = sum(int(e.get("n_iters") or 1) for e in disp
+                    if e.get("barrier"))
+        out["amortized_ms_per_iter"] = 1e3 * sum(walls) / max(fused, 1)
+    if convergence is not None:
+        out["convergence"] = convergence
+    if freezes:
+        out["freezes"] = [{k: v for k, v in e.items() if k != "kind"}
+                          for e in freezes]
+    if health:
+        out["health_events"] = len(health)
+        out["health_kinds"] = sorted({e.get("event", e.get("name", "?"))
+                                      for e in health})
+    if costs:
+        out["costs"] = costs
+    if fits:
+        out["fits"] = fits
+    return out
+
+
+def _fmt_s(x: float) -> str:
+    return f"{1e3 * x:.1f}ms" if x < 1 else f"{x:.2f}s"
+
+
+def _print_text(s: dict) -> None:
+    print(f"events: {s['n_events']}   dispatches: {s['dispatches']} "
+          f"(first-call {s['first_calls']}, recompile {s['recompiles']}, "
+          f"errors {s['dispatch_errors']})")
+    if "amortized_ms_per_iter" in s:
+        print(f"amortized tunnel latency: "
+              f"{s['amortized_ms_per_iter']:.2f} ms/iter "
+              f"(barrier'd wall / fused iters)")
+    for name, p in s.get("programs", {}).items():
+        line = (f"  {name}: {p['dispatches']} dispatch"
+                f"{'es' if p['dispatches'] != 1 else ''}, "
+                f"{len(p['shape_keys'])} shape key"
+                f"{'s' if len(p['shape_keys']) != 1 else ''}")
+        if p.get("recompiles"):
+            line += f", {p['recompiles']} RECOMPILE"
+        if "compile_proxy_s" in p:
+            line += f", compile~{_fmt_s(max(p['compile_proxy_s'], 0.0))}"
+        if "steady_s" in p:
+            line += f", steady p50 {_fmt_s(p['steady_s']['p50'])}"
+        if "amortized_ms_per_iter" in p:
+            line += f", {p['amortized_ms_per_iter']:.2f} ms/iter"
+        if p.get("errors"):
+            line += f", {p['errors']} error{'s' if p['errors'] != 1 else ''}"
+        print(line)
+    c = s.get("convergence")
+    if c and c.get("loglik_first") is None:
+        # Batched chunk events carry state counts, not a loglik curve.
+        print(f"convergence: {c['n_chunks']} chunks (batched: per-problem "
+              f"curves live in the freeze/chunk events)")
+    elif c:
+        print(f"convergence: {c['n_iters']} iters in {c['n_chunks']} chunks, "
+              f"loglik {c['loglik_first']:.6g} -> {c['loglik_last']:.6g}")
+        if c.get("noise_floor") is not None:
+            print(f"  noise floor {c['noise_floor']:.3g}; "
+                  f"{c.get('deltas_below_floor', 0)}/{len(c['deltas'])} "
+                  f"deltas below floor")
+    if s.get("freezes"):
+        for f in s["freezes"]:
+            print(f"  freeze: problem {f.get('problem')} -> "
+                  f"{f.get('state')} (chunk {f.get('chunk')}, "
+                  f"iter {f.get('iteration')})")
+    if "health_events" in s:
+        print(f"health: {s['health_events']} events "
+              f"({', '.join(s['health_kinds'])})")
+    for name, c in s.get("costs", {}).items():
+        bits = [f"{k}={v:.3g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in c.items() if k != "key"]
+        print(f"  cost {name}: {' '.join(bits)}")
+    for f in s.get("fits", []):
+        bits = [f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in f.items() if k != "t"]
+        print(f"  fit: {' '.join(bits)}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dfm_tpu.obs.report",
+        description="Summarize a DFM_TRACE JSONL trace.")
+    ap.add_argument("trace", help="path to a trace.jsonl file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    args = ap.parse_args(argv)
+    s = summarize(args.trace)
+    if args.json:
+        json.dump(s, sys.stdout, indent=2, default=str)
+        print()
+    else:
+        _print_text(s)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,273 @@
+"""Process-local structured tracing for dispatch/compile/convergence events.
+
+The performance contract of this framework lives on events nothing records:
+each program launch through the axon tunnel costs ~60-100 ms, a silent
+recompile from shape churn costs seconds, and convergence decisions ride on
+f32 loglik deltas vs ``noise_floor_for``.  The ``Tracer`` turns those into a
+structured event stream — an in-memory list and, optionally, a JSONL file
+(one event per line, flushed eagerly so a crashed fit still leaves a trace).
+
+Event schema (every event):
+    ``t``     monotonic ``time.perf_counter()`` seconds (NOT wall clock —
+              only deltas within one trace are meaningful)
+    ``kind``  one of:
+      ``fit``       one per ``api.fit`` call: engine, N/T/k, wall, n_iters
+      ``dispatch``  one per program launch: ``program`` (logical name),
+                    ``key`` (shape signature), ``dur`` (seconds to return —
+                    with ``barrier=true`` this includes the device→host
+                    transfer, i.e. true execution wall; otherwise it is
+                    async-dispatch overhead only), ``first_call`` (first
+                    launch of this program+key in the process: wall time is
+                    the compile proxy — the tunnel exposes no other),
+                    ``recompile`` (same program, second distinct key),
+                    optional ``n_iters``, ``error``
+      ``transfer``  explicit device→host or host→device movement
+      ``chunk``     per fused-EM chunk: engine, iter range, logliks, deltas
+                    vs the noise floor
+      ``freeze``    batched engine per-problem state transition
+                    (converged/diverged)
+      ``health``    a ``robust.health.HealthEvent``, timestamped
+      ``cost``      static XLA cost model for a program (opt-in)
+      ``span``      generic timed region (``name``, ``dur``)
+
+Activation: ``fit(telemetry=...)`` pushes a tracer for the duration of the
+fit; ``DFM_TRACE=<path>`` makes a process-ambient file tracer that
+instrumented code picks up when no explicit tracer is active.  With neither,
+``current_tracer()`` is None and every instrumentation site reduces to one
+``is None`` check — no event objects, no clock reads, no host syncs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import IO, List, Optional, Union
+
+from .cost import RecompileDetector, global_detector
+
+__all__ = ["Tracer", "current_tracer", "activate", "fit_tracer",
+           "shape_key"]
+
+
+def _json_default(o):
+    # numpy scalars/arrays and anything else non-JSON: best-effort coercion.
+    for attr in ("item", "tolist"):
+        f = getattr(o, attr, None)
+        if f is not None:
+            try:
+                return f()
+            except Exception:
+                break
+    return repr(o)
+
+
+def shape_key(*parts) -> str:
+    """Canonical shape-signature string for dispatch/cost events.
+
+    Accepts ints, strings, dtypes, arrays (contributes ``NxTx..xdtype``).
+    Include every static argument that forces a distinct executable —
+    notably ``n_iters`` of a fused chunk: a tail chunk of a different
+    length IS a new program to XLA, and should show up as a recompile.
+    """
+    toks = []
+    for p in parts:
+        shp = getattr(p, "shape", None)
+        if shp is not None:
+            dt = getattr(p, "dtype", "")
+            toks.append("x".join(str(d) for d in shp) + (f"x{dt}" if dt else ""))
+        else:
+            toks.append(str(p))
+    return "/".join(toks)
+
+
+class Tracer:
+    """Collects events in memory and (optionally) appends them to a JSONL file.
+
+    Parameters
+    ----------
+    path:
+        JSONL output file, or None for in-memory only.
+    capture_costs:
+        Capture static XLA program costs (``obs.cost.program_cost``) at
+        instrumented lower points.  Defaults to ``DFM_TRACE_COST=1``.
+        Off by default: lower+compile is itself compile-scale work.
+    detector:
+        Recompile detector; defaults to the process-local singleton so
+        "first_call" / "recompile" reflect the process's real compile
+        cache, not this tracer's lifetime.  Tests inject a fresh one.
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 capture_costs: Optional[bool] = None,
+                 detector: Optional[RecompileDetector] = None):
+        self.path = path
+        self.events: List[dict] = []
+        self.capture_costs = (os.environ.get("DFM_TRACE_COST") == "1"
+                              if capture_costs is None else capture_costs)
+        self._detector = detector if detector is not None else global_detector()
+        self._fh: Optional[IO[str]] = None
+        self._lock = threading.Lock()
+        self._depth = 0          # dispatch-span reentrancy (see dispatch())
+        self._costed = set()     # (program, key) pairs already cost-captured
+        if path:
+            self._fh = open(path, "a", encoding="utf-8")
+
+    # -- event sinks -----------------------------------------------------
+
+    def emit(self, kind: str, *, t: Optional[float] = None, **payload) -> dict:
+        ev = {"t": time.perf_counter() if t is None else t, "kind": kind}
+        ev.update(payload)
+        with self._lock:
+            self.events.append(ev)
+            if self._fh is not None:
+                self._fh.write(json.dumps(ev, default=_json_default) + "\n")
+                self._fh.flush()
+        return ev
+
+    @contextmanager
+    def dispatch(self, program: str, key: str, *, barrier: bool = False,
+                 n_iters: Optional[int] = None, **payload):
+        """Span around one program launch (plus its result transfer when the
+        caller transfers inside the block — pass ``barrier=True`` then, so
+        the report can tell true execution wall from async-launch overhead).
+
+        Reentrancy: the OUTERMOST active dispatch span owns the record.
+        Driver loops (``run_em_chunked``, the guard's ``_dispatch``, the
+        batched engine) wrap the low-level callables, which carry their own
+        spans for direct use (bench, dryrun) — suppressing nested spans
+        keeps each physical launch counted exactly once.
+        """
+        if self._depth > 0:
+            yield None
+            return
+        self._depth += 1
+        status = self._detector.note(program, key)
+        t0 = time.perf_counter()
+        err = None
+        try:
+            yield None
+        except BaseException as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._depth -= 1
+            ev = {"program": program, "key": key,
+                  "dur": time.perf_counter() - t0, "barrier": bool(barrier),
+                  "first_call": status != "cached",
+                  "recompile": status == "recompile"}
+            if n_iters is not None:
+                ev["n_iters"] = int(n_iters)
+            if err is not None:
+                ev["error"] = err
+            ev.update(payload)
+            self.emit("dispatch", t=t0, **ev)
+
+    @contextmanager
+    def span(self, name: str, **payload):
+        t0 = time.perf_counter()
+        try:
+            yield None
+        finally:
+            self.emit("span", t=t0, name=name,
+                      dur=time.perf_counter() - t0, **payload)
+
+    def maybe_cost(self, program: str, key: str, jitted, *args, **kwargs):
+        """Capture the static cost of ``jitted`` at this signature, once per
+        (program, key), when cost capture is on.  Never raises."""
+        if not self.capture_costs or (program, key) in self._costed:
+            return
+        self._costed.add((program, key))
+        from .cost import program_cost
+        c = program_cost(jitted, *args, **kwargs)
+        if c:
+            self.emit("cost", program=program, key=key, **c)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def summary(self) -> dict:
+        from .report import summarize
+        return summarize(self.events)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# -- activation ----------------------------------------------------------
+#
+# A thread-local stack of active tracers; instrumented code asks
+# current_tracer() and does nothing when it returns None.  The bottom of the
+# stack is lazily seeded from DFM_TRACE so `DFM_TRACE=t.jsonl python
+# bench.py` traces without code changes.  Pushing None masks the ambient
+# tracer (fit(telemetry=False)).
+
+_tls = threading.local()
+_ENV_SENTINEL = object()
+_env_tracer: Union[object, None, Tracer] = _ENV_SENTINEL
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _ambient() -> Optional[Tracer]:
+    global _env_tracer
+    if _env_tracer is _ENV_SENTINEL:
+        path = os.environ.get("DFM_TRACE")
+        _env_tracer = Tracer(path) if path else None
+    return _env_tracer
+
+
+def current_tracer() -> Optional[Tracer]:
+    """The active tracer, or None (the zero-overhead answer)."""
+    st = _stack()
+    if st:
+        return st[-1]
+    return _ambient()
+
+
+@contextmanager
+def activate(tracer: Optional[Tracer]):
+    """Make ``tracer`` current for the block; ``activate(None)`` suppresses
+    any ambient DFM_TRACE tracer (telemetry hard-off)."""
+    st = _stack()
+    st.append(tracer)
+    try:
+        yield tracer
+    finally:
+        st.pop()
+
+
+def fit_tracer(telemetry) -> tuple:
+    """Resolve ``fit(telemetry=...)`` to ``(tracer, owned)``.
+
+    - None: inherit whatever is current (possibly DFM_TRACE); not owned.
+    - False: telemetry hard-off (tracer None pushed over ambient).
+    - True: fresh in-memory tracer; owned (summary attached to the result).
+    - str / PathLike: fresh file tracer; owned (closed after the fit).
+    - Tracer: use as-is; not owned (caller controls lifetime/close).
+    """
+    if telemetry is None:
+        return current_tracer(), False
+    if telemetry is False:
+        return None, False
+    if telemetry is True:
+        return Tracer(), True
+    if isinstance(telemetry, Tracer):
+        return telemetry, False
+    return Tracer(os.fspath(telemetry)), True

@@ -83,9 +83,17 @@ def run_batched_em_sharded(Y, p0, cfg, max_iters: int, tol: float,
     Yp, pp, n_pad = _pad_batch(jnp.asarray(Y), p0, D)
     state0 = np.concatenate([np.zeros(B, np.int32),
                              np.full(n_pad, PADDED, np.int32)])
+    impl = partial(_sharded_chunk_impl, mesh=mesh)
+    # Telemetry identity for the shared driver's dispatch spans: the
+    # sharded twin is a DIFFERENT logical program (its own compile cache
+    # entry per device count), so it gets its own name and a key carrying
+    # the mesh size.
+    impl.trace_name = "sharded_batched_em_chunk"
+    impl.trace_key = f"mesh{D}"
+    impl.trace_engine = "sharded_batched_em"
     p, lls_list, conv, p_iters, healths = run_batched_em(
         Yp, pp, cfg, max_iters, tol, fused_chunk=fused_chunk, policy=policy,
-        scan_impl=partial(_sharded_chunk_impl, mesh=mesh), state0=state0)
+        scan_impl=impl, state0=state0)
     if n_pad:
         p = jax.tree_util.tree_map(lambda x: x[:B], p)
         lls_list, conv = lls_list[:B], conv[:B]

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import dataclasses
 
+from ..obs.trace import current_tracer, shape_key
 from ..estim.em import (EMConfig, moments, moment_sums, mstep_rows,
                         mstep_dynamics, mstep_dynamics_sums, run_em_loop)
 from ..ssm.info_filter import (ObsStats, obs_stats, info_scan, quad_expanded,
@@ -305,6 +306,11 @@ class ShardedEM:
             cfg = dataclasses.replace(cfg, filter="info")
         self.cfg = cfg
         self.Y = Y_dev if use_dev else jnp.asarray(Yp, dtype)
+        tr = current_tracer()
+        if tr is not None and not use_dev:
+            tr.emit("transfer", direction="h2d", what="panel",
+                    key=shape_key(self.Y),
+                    bytes=int(self.Y.size * self.Y.dtype.itemsize))
         self.mask = jnp.asarray(Wp, dtype) if self.has_mask else None
         self.gate = (jnp.asarray(
             np.concatenate([np.ones(Y.shape[1]), np.zeros(self.n_pad)]),
@@ -326,7 +332,12 @@ class ShardedEM:
             err.throw()
             self.p, ll, self.last_delta = out
             return ll
-        self.p, ll, self.last_delta = _sharded_em_step_impl(*args)
+        tr = current_tracer()
+        if tr is None:
+            self.p, ll, self.last_delta = _sharded_em_step_impl(*args)
+            return ll
+        with tr.dispatch("sharded_em_step", self._trace_key()):
+            self.p, ll, self.last_delta = _sharded_em_step_impl(*args)
         return ll
 
     def run_scan(self, p: SSMParams, n_iters: int):
@@ -342,13 +353,30 @@ class ShardedEM:
             err, out = _sharded_em_scan_checked_impl(*args)
             err.throw()
             return out
-        return _sharded_em_scan_impl(*args)
+        tr = current_tracer()
+        if tr is None:
+            return _sharded_em_scan_impl(*args)
+        # Suppressed when a chunk driver's barrier'd span is already open;
+        # direct callers (dryrun) get the async-dispatch record.
+        with tr.dispatch("sharded_em_chunk",
+                         shape_key(self._trace_key(), f"iters{n_iters}"),
+                         n_iters=n_iters):
+            return _sharded_em_scan_impl(*args)
+
+    def _trace_key(self) -> str:
+        return shape_key(self.Y, self.cfg.filter,
+                         f"mesh{self.mesh.devices.size}")
 
     def smooth(self):
-        x_sm, P_sm, ll = _sharded_smooth_impl(
-            self.Y, self.mask, self.gate, self.p, self.mesh, self.has_mask,
-            self.has_gate)
-        return x_sm, P_sm, ll
+        tr = current_tracer()
+        if tr is None:
+            return _sharded_smooth_impl(
+                self.Y, self.mask, self.gate, self.p, self.mesh,
+                self.has_mask, self.has_gate)
+        with tr.dispatch("sharded_smooth", self._trace_key()):
+            return _sharded_smooth_impl(
+                self.Y, self.mask, self.gate, self.p, self.mesh,
+                self.has_mask, self.has_gate)
 
     def params_numpy(self, p: Optional[SSMParams] = None):
         """Unpadded float64 copy of ``p`` (default: current params)."""

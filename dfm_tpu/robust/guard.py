@@ -183,9 +183,16 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                            monitor: ChunkMonitor = None):
     """Monitored twin of ``estim.em.run_em_chunked`` (same return tuple)."""
     from ..estim.em import em_progress, warn_ss_delta
+    from ..obs.trace import current_tracer, shape_key
 
     policy, controls, health = (monitor.policy, monitor.controls,
                                 monitor.health)
+    tr = current_tracer()
+    prog = getattr(scan_fn, "trace_name", "em_chunk")
+    prog_key = getattr(scan_fn, "trace_key", "")
+    engine = getattr(scan_fn, "trace_engine", prog)
+    if not health.engine:
+        health.engine = engine
     if policy.wrap_scan is not None:
         scan_fn = policy.wrap_scan(scan_fn)
 
@@ -233,10 +240,24 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         attempt = 0
         while True:
             try:
-                p_out, chunk, deltas = fn(p_in, n)
-                chunk = np.asarray(chunk, np.float64)
-                if deltas is not None:
-                    deltas = np.asarray(deltas, np.float64)
+                if tr is None:
+                    p_out, chunk, deltas = fn(p_in, n)
+                    chunk = np.asarray(chunk, np.float64)
+                    if deltas is not None:
+                        deltas = np.asarray(deltas, np.float64)
+                else:
+                    # Failed attempts each leave a dispatch event with an
+                    # ``error`` field; the transfers inside the span make
+                    # its wall time the true execution barrier.
+                    with tr.dispatch(
+                            getattr(fn, "trace_name", prog),
+                            shape_key(getattr(fn, "trace_key", prog_key),
+                                      f"iters{n}"),
+                            barrier=True, n_iters=n, attempt=attempt):
+                        p_out, chunk, deltas = fn(p_in, n)
+                        chunk = np.asarray(chunk, np.float64)
+                        if deltas is not None:
+                            deltas = np.asarray(deltas, np.float64)
                 return p_out, chunk, deltas
             except policy.retry_exceptions as e:
                 if isinstance(e, GuardFailure):
@@ -327,6 +348,14 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
             p = controls.params_device(repair_params(
                 p_np, policy.r_floor, jitter=policy.psd_tol
                 * (10.0 ** attempt)))
+        if tr is not None and chunk is not None:
+            drops = np.diff(chunk)
+            tr.emit("chunk", engine=engine, iter0=it, n=int(n),
+                    lls=[float(x) for x in chunk],
+                    noise_floor=float(noise_floor),
+                    max_drop=float(-drops.min()) if drops.size else 0.0,
+                    below_floor=bool(drops.size == 0
+                                     or np.abs(drops).max() < noise_floor))
         p_entry_prev, entry_it_prev = p_entry, entry_it
         p_entry, entry_it = p, it
         p = p_try

@@ -10,6 +10,7 @@ forensic trail.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import List, Optional
 
 __all__ = ["HealthEvent", "FitHealth", "health_from_trace"]
@@ -35,9 +36,13 @@ class HealthEvent:
     detail: str = ""
     action: str = "none"   # retried | restored | repaired | remeasure_tau
     #                      # | fallback_info | loglik_f64 | stopped | abort
+    t: float = 0.0      # time.perf_counter() at record time (0 = unstamped);
+    #                   # monotonic, comparable to obs.trace event times
+    engine: str = ""    # emitting engine ("tpu_em", "batched_em", ...)
 
     def __str__(self) -> str:
-        return (f"[chunk {self.chunk} it {self.iteration}] {self.kind}"
+        eng = f" {self.engine}" if self.engine else ""
+        return (f"[chunk {self.chunk} it {self.iteration}]{eng} {self.kind}"
                 f" -> {self.action}" + (f" ({self.detail})" if self.detail
                                         else ""))
 
@@ -57,6 +62,7 @@ class FitHealth:
     escalations: List[str] = dataclasses.field(default_factory=list)
     events: List[HealthEvent] = dataclasses.field(default_factory=list)
     fallback_backend: Optional[str] = None
+    engine: str = ""    # default engine name stamped onto recorded events
 
     @property
     def ok(self) -> bool:
@@ -64,12 +70,29 @@ class FitHealth:
         return (not self.events and not self.escalations
                 and self.fallback_backend is None and not self.stalled)
 
-    def record(self, event: HealthEvent) -> HealthEvent:
+    def record(self, event: HealthEvent, emit: bool = True) -> HealthEvent:
+        """Record ``event`` (stamping time/engine) and, when a tracer is
+        active and ``emit`` is true, mirror it into the telemetry stream.
+        ``emit=False`` is for replaying an already-emitted event into
+        additional health records (the batched engine fans dispatch events
+        out to every problem's health)."""
+        if event.t == 0.0:
+            event.t = time.perf_counter()
+        if not event.engine:
+            event.engine = self.engine
         self.events.append(event)
         if event.kind == "nonpsd":
             self.nonpsd_events += 1
         if event.action in ("restored", "repaired", "retried"):
             self.n_recoveries += 1
+        if emit:
+            from ..obs.trace import current_tracer
+            tr = current_tracer()
+            if tr is not None:
+                tr.emit("health", t=event.t, event=event.kind,
+                        chunk=event.chunk, iteration=event.iteration,
+                        action=event.action, detail=event.detail,
+                        engine=event.engine)
         return event
 
     def escalate(self, action: str) -> None:
@@ -89,7 +112,8 @@ class FitHealth:
 
 
 def health_from_trace(lls, noise_floor: float = 0.0,
-                      max_ss_delta: float = 0.0) -> FitHealth:
+                      max_ss_delta: float = 0.0,
+                      engine: str = "") -> FitHealth:
     """Post-hoc health record from a loglik trace.
 
     The family drivers (MF/TVL/SV) run their own fused loops without the
@@ -99,7 +123,7 @@ def health_from_trace(lls, noise_floor: float = 0.0,
     one.  No device work.
     """
     import numpy as np
-    h = FitHealth()
+    h = FitHealth(engine=engine)
     a = np.asarray(lls, np.float64)
     for i in np.flatnonzero(~np.isfinite(a))[:8]:
         h.record(HealthEvent(chunk=-1, iteration=int(i), kind="nan_loglik",

@@ -88,17 +88,21 @@ def _run_driver_style(code):
         timeout=900)  # > the 600s inner dryrun subprocess timeout
 
 
-def test_batched_bench_prints_one_json_line():
+def test_batched_bench_prints_one_json_line(tmp_path):
     """bench.batched must keep the bench contract: exactly ONE JSON line
-    on stdout (diagnostics on stderr), smoke-sized via DFM_BENCH_*."""
+    on stdout (diagnostics on stderr), smoke-sized via DFM_BENCH_* — with
+    DFM_TRACE set, the trace goes to the FILE and the JSON line gains
+    telemetry counts that agree with it."""
     import json
     import os
     import subprocess
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace = tmp_path / "bench_batched.jsonl"
     env = _driver_env()
     env.update({"JAX_PLATFORMS": "cpu", "DFM_BENCH_B": "1,2",
                 "DFM_BENCH_N": "10", "DFM_BENCH_T": "30",
-                "DFM_BENCH_K": "2", "DFM_BENCH_ITERS": "3"})
+                "DFM_BENCH_K": "2", "DFM_BENCH_ITERS": "3",
+                "DFM_TRACE": str(trace)})
     proc = subprocess.run(
         [sys.executable, "-m", "bench.batched"], cwd=repo, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -110,6 +114,46 @@ def test_batched_bench_prints_one_json_line():
     assert out["unit"] == "iters/sec"
     assert out["value"] > 0
     assert set(out["sweep"]) == {"1", "2"}
+    # Telemetry fields (ISSUE 3 satellite): counts in the JSON line must
+    # reproduce from the JSONL trace the run left behind.
+    assert out["dispatches"] > 0
+    assert out["recompiles"] >= 0
+    events = [json.loads(ln) for ln in
+              trace.read_text().splitlines() if ln.strip()]
+    n_disp = sum(1 for e in events if e.get("kind") == "dispatch")
+    assert n_disp == out["dispatches"]
+
+
+def test_headline_bench_prints_one_json_line_with_telemetry(tmp_path):
+    """Smoke-size bench.py keeps the one-JSON-line contract and reports
+    dispatch/recompile counts that agree with the DFM_TRACE file."""
+    import json
+    import os
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace = tmp_path / "bench_headline.jsonl"
+    env = _driver_env()
+    env.update({"JAX_PLATFORMS": "cpu", "DFM_BENCH_N": "20",
+                "DFM_BENCH_T": "30", "DFM_BENCH_K": "2",
+                "DFM_BENCH_ITERS": "3", "DFM_BENCH_CPU_TIMING_ITERS": "1",
+                "DFM_BENCH_CPU_CHECK_ITERS": "3", "DFM_TRACE": str(trace)})
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=repo, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    out = json.loads(lines[0])
+    assert out["unit"] == "iters/sec"
+    # Two fused lengths per label make >= 1 recompile unavoidable — the
+    # field exists to catch UNEXPECTED churn in longitudinal runs.
+    assert out["dispatches"] > 0
+    assert out["recompiles"] >= 1
+    events = [json.loads(ln) for ln in
+              trace.read_text().splitlines() if ln.strip()]
+    n_disp = sum(1 for e in events if e.get("kind") == "dispatch")
+    assert n_disp == out["dispatches"]
 
 
 def test_dryrun_multichip_driver_context():

@@ -1,0 +1,108 @@
+"""Source-level invariant: timing/telemetry code uses monotonic clocks and
+real execution barriers.
+
+Two environment facts this audit encodes (CLAUDE.md "hard-won"):
+
+- ``jax.block_until_ready`` is a NO-OP on the axon PJRT plugin (returns in
+  0.1 ms while the program is still running).  Any timing or telemetry code
+  that "waits" with it measures dispatch overhead, not execution: the only
+  reliable barrier is a device->host transfer (``np.asarray``).
+- ``time.time()`` is a wall clock: NTP steps and slews make deltas lie.
+  Durations and event timestamps must come from ``time.perf_counter()``
+  (the ``obs.trace`` event schema is defined in those terms).
+
+Scope: the ``dfm_tpu`` package and the bench tree (``bench.py`` +
+``bench/``) — everything that times programs or emits telemetry.
+``__graft_entry__.py`` is deliberately OUT of scope: its two
+``block_until_ready`` calls gate correctness checks on the fake CPU mesh
+(where the call works) and time nothing.
+
+Same mechanism as ``test_precision_guard``: walk the AST so a violation
+fails CI instead of silently shipping bogus numbers.
+"""
+
+import ast
+import pathlib
+
+import dfm_tpu
+
+PKG_ROOT = pathlib.Path(dfm_tpu.__file__).parent
+REPO_ROOT = PKG_ROOT.parent
+
+# relpath -> (max occurrences, reason).  Frozen: a new entry needs a reason
+# that is genuinely not a duration/telemetry use.
+TIME_TIME_ALLOWLIST = {
+    # Unix timestamp stamped into the BENCH_ALL.json artifact
+    # ("recorded_unix") — a wall-clock *date*, not a duration.
+    "bench/all.py": (1, "recorded_unix artifact timestamp"),
+}
+
+
+def _scoped_files():
+    files = sorted(PKG_ROOT.rglob("*.py"))
+    files += [REPO_ROOT / "bench.py"]
+    files += sorted((REPO_ROOT / "bench").rglob("*.py"))
+    return files
+
+
+def _rel(path: pathlib.Path) -> str:
+    return str(path.relative_to(REPO_ROOT))
+
+
+def _is_time_time_call(node: ast.AST) -> bool:
+    """Matches ``time.time()`` and bare ``time()`` (from-imports)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return (f.attr == "time" and isinstance(f.value, ast.Name)
+                and f.value.id == "time")
+    return isinstance(f, ast.Name) and f.id == "time"
+
+
+def test_no_wall_clock_in_timing_paths():
+    hits = {}
+    for path in _scoped_files():
+        tree = ast.parse(path.read_text(), filename=str(path))
+        lines = [n.lineno for n in ast.walk(tree) if _is_time_time_call(n)]
+        if lines:
+            hits[_rel(path)] = lines
+    bad = {}
+    for rel, lines in hits.items():
+        cap, _reason = TIME_TIME_ALLOWLIST.get(rel, (0, ""))
+        if len(lines) > cap:
+            bad[rel] = lines
+    assert not bad, (
+        "time.time() in timing/telemetry scope (wall clocks lie across NTP "
+        f"steps; use time.perf_counter): {bad}")
+
+
+def test_no_block_until_ready_in_timing_paths():
+    bad = {}
+    for path in _scoped_files():
+        tree = ast.parse(path.read_text(), filename=str(path))
+        lines = [n.lineno for n in ast.walk(tree)
+                 if (isinstance(n, ast.Attribute)
+                     and n.attr == "block_until_ready")
+                 or (isinstance(n, ast.Name)
+                     and n.id == "block_until_ready")]
+        if lines:
+            bad[_rel(path)] = lines
+    assert not bad, (
+        "block_until_ready in timing/telemetry scope (a no-op barrier on "
+        f"the axon plugin; use a device->host transfer): {bad}")
+
+
+def test_audit_scope_saw_the_timing_modules():
+    # A path refactor must update this audit, not silently skip it.
+    rels = {_rel(p) for p in _scoped_files()}
+    expected = {"dfm_tpu/obs/trace.py", "dfm_tpu/obs/report.py",
+                "dfm_tpu/estim/em.py", "dfm_tpu/robust/guard.py",
+                "bench.py", "bench/all.py", "bench/batched.py"}
+    assert expected <= rels, sorted(expected - rels)
+
+
+def test_allowlist_is_not_stale():
+    rels = {_rel(p) for p in _scoped_files()}
+    assert set(TIME_TIME_ALLOWLIST) <= rels, (
+        "allowlist names files the audit no longer sees")

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# One-command observability check: run a smoke-size traced fit under
+# DFM_TRACE and summarize the trace with the report CLI.  The quick way to
+# answer "how many programs did a fit dispatch, did anything recompile,
+# and what did the convergence curve do" without touching the real chip.
+#
+# Usage (from the repo root):
+#   tools/trace_summary.sh [trace_path]          # default /tmp/dfm_trace.jsonl
+#   DFM_TRACE_COST=1 tools/trace_summary.sh      # add static flops/bytes
+#
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time; export
+# JAX_PLATFORMS= (empty) to trace the default backend instead.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRACE="${1:-/tmp/dfm_trace.jsonl}"
+rm -f "$TRACE"
+
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" DFM_TRACE="$TRACE" python - <<'PY'
+import numpy as np
+from dfm_tpu.api import DynamicFactorModel, TPUBackend, fit
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(0)
+p_true = dgp.dfm_params(30, 2, rng)
+Y, _ = dgp.simulate(p_true, 80, rng)
+Y = (Y - Y.mean(0)) / Y.std(0)
+r = fit(DynamicFactorModel(n_factors=2), Y,
+        backend=TPUBackend(filter="info"), max_iters=24, tol=1e-6)
+print(f"smoke fit: {r.n_iters} iters, converged={bool(r.converged)}, "
+      f"loglik={float(r.logliks[-1]):.4f}")
+PY
+
+echo "--- trace summary ($TRACE) ---"
+python -m dfm_tpu.obs.report "$TRACE"
