@@ -311,7 +311,8 @@ def main():
         + (f" -> {tracer.path}" if tracer.path else ""))
 
     value = 1.0 / tpu_secs
-    print(json.dumps({
+    from dfm_tpu.obs.store import new_run_id
+    payload = {
         # Round 5 renamed the metric: `value` is now the SUSTAINED device
         # rate (two-point slope — the dispatch-free figure the CPU baseline
         # is actually comparable to); the r1-r4 dispatch-inclusive total/n
@@ -345,7 +346,30 @@ def main():
         # and truthful (see obs/trace.py shape_key).
         "dispatches": ts["dispatches"],
         "recompiles": ts["recompiles"],
-    }))
+        # Registry identity: obs.regress addresses this exact run by id.
+        "run_id": new_run_id(),
+    }
+    print(json.dumps(payload))
+    _record_run(payload, dev)
+
+
+def _record_run(payload, dev):
+    """Append this run to the perf-observatory registry (obs.store).
+    Default dir .dfm_runs/; DFM_RUNS overrides, DFM_RUNS="" disables.
+    Diagnostics only ever go to stderr — the one-JSON-line stdout
+    contract stays intact."""
+    from dfm_tpu.obs import store as obs_store
+    d = obs_store.runs_dir()
+    if d is None:
+        return
+    try:
+        rec = obs_store.record_from_bench_json(
+            payload, device=f"{dev.platform} ({dev.device_kind})")
+        obs_store.RunStore(d).append(rec)
+        log(f"run {payload['run_id']} recorded in {d}/ "
+            "(diff: python -m dfm_tpu.obs.regress)")
+    except Exception as e:  # registry failure must not fail the bench
+        log(f"WARNING: run registry append failed: {e}")
 
 
 if __name__ == "__main__":

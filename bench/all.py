@@ -120,6 +120,30 @@ def main(argv=None):
                                     "vs_cpu", "vs_cpu_sustained", "error")}
                       for k, v in results.items()}))
     print(f"wrote {args.out}", file=sys.stderr)
+    _record_runs(out)
+
+
+def _record_runs(out):
+    """Append one registry record per swept config (obs.store; default
+    .dfm_runs/, DFM_RUNS overrides, DFM_RUNS="" disables)."""
+    from dfm_tpu.obs import store as obs_store
+    d = obs_store.runs_dir()
+    if d is None:
+        return
+    try:
+        store = obs_store.RunStore(d)
+        n = 0
+        for name, res in out["results"].items():
+            rec = obs_store.record_from_bench_all_entry(
+                name, res, device=out["device"],
+                t_unix=out["recorded_unix"])
+            if rec is not None:
+                store.append(rec)
+                n += 1
+        if n:
+            print(f"recorded {n} run(s) in {d}/", file=sys.stderr)
+    except Exception as e:  # registry failure must not fail the sweep
+        print(f"WARNING: run registry append failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -151,8 +151,9 @@ def main():
         f"{ts['recompiles']} recompiles"
         + (f" -> {tracer.path}" if tracer.path else ""))
 
+    from dfm_tpu.obs.store import new_run_id
     head = sweep[str(B_max)]
-    print(json.dumps({
+    payload = {
         "metric": (f"batched_em_agg_iters_per_sec_B{B_max}_"
                    f"{N}x{T}_k{k}_{dynamics}"),
         "value": head["agg_iters_per_sec"],
@@ -168,7 +169,27 @@ def main():
         # the expected, truthful count for a sweep (obs/trace.py).
         "dispatches": ts["dispatches"],
         "recompiles": ts["recompiles"],
-    }))
+        "run_id": new_run_id(),
+    }
+    print(json.dumps(payload))
+    _record_run(payload, dev)
+
+
+def _record_run(payload, dev):
+    """Append this run to the perf-observatory registry (obs.store);
+    stderr-only diagnostics, same contract as bench.py."""
+    from dfm_tpu.obs import store as obs_store
+    d = obs_store.runs_dir()
+    if d is None:
+        return
+    try:
+        rec = obs_store.record_from_bench_json(
+            payload, device=f"{dev.platform} ({dev.device_kind})",
+            kind="bench_batched")
+        obs_store.RunStore(d).append(rec)
+        log(f"run {payload['run_id']} recorded in {d}/")
+    except Exception as e:  # registry failure must not fail the bench
+        log(f"WARNING: run registry append failed: {e}")
 
 
 if __name__ == "__main__":

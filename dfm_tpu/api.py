@@ -220,6 +220,9 @@ class TPUBackend(Backend):
         self.robust = robust
         self._last_health = None
         self._guard_checkpoint = None
+        # Transient per-fit live-progress hook (fit(progress=...) sets and
+        # restores it); also switches the chunk program to the metrics twin.
+        self._progress = None
         # PCA warm start on device (estim.init) — saves the ~1.2 s host SVD
         # at 10k series.  "auto" (default) switches it on when the panel is
         # large enough that the host SVD dominates the fit's fixed cost
@@ -399,7 +402,19 @@ class TPUBackend(Backend):
         """
         from .estim.em import noise_floor_for, run_em_chunked
 
+        progress = getattr(self, "_progress", None)
+        # Metrics ride along only when someone is listening (the progress
+        # hook): the default chunk program stays byte-identical to the
+        # metrics-free PR 3 path (telemetry alone must not change it —
+        # pinned by tests/test_obs.py bit-identity).
+        with_metrics = progress is not None
+
         def scan_fn(p, n):
+            if with_metrics:
+                p_new, lls, deltas, metrics = em_fit_scan(
+                    Yj, p, n, mask=mj, cfg=cfg, with_metrics=True)
+                return (p_new, lls,
+                        (deltas if cfg.filter == "ss" else None), metrics)
             p_new, lls, deltas = em_fit_scan(Yj, p, n, mask=mj, cfg=cfg)
             return p_new, lls, (deltas if cfg.filter == "ss" else None)
 
@@ -431,7 +446,7 @@ class TPUBackend(Backend):
             noise_floor_for(Yj.dtype, Yj.size, mult=cfg.noise_floor_mult),
             callback, self.fused_chunk,
             ss_tau=cfg.tau if cfg.filter == "ss" else None,
-            monitor=monitor)
+            monitor=monitor, progress=progress)
 
     def smooth(self, Y, mask, params):
         # fit() calls smooth right after run_em with the exact (Y, mask,
@@ -601,8 +616,8 @@ class ShardedBackend(TPUBackend):
             drv = ShardedEM(Y, p0, mask=mask, mesh=self._mesh(),
                             dtype=self._dtype(), cfg=cfg, Y_dev=Y_dev)
 
-            def scan_fn(Yj, p, n, mask=None, cfg=None):
-                return drv.run_scan(p, n)
+            def scan_fn(Yj, p, n, mask=None, cfg=None, with_metrics=False):
+                return drv.run_scan(p, n, with_metrics=with_metrics)
 
             scan_fn.trace_name = "sharded_em_chunk"
             scan_fn.trace_key = drv._trace_key()
@@ -799,7 +814,8 @@ def fit(model,                     # DynamicFactorModel | family spec
         checkpoint_every: int = 10,
         debug: bool = False,
         robust=None,
-        telemetry=None):
+        telemetry=None,
+        progress: Optional[Callable] = None):
     """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
 
     ``model`` may also be a family spec — ``MixedFreqSpec``, ``TVLSpec``,
@@ -843,6 +859,16 @@ def fit(model,                     # DynamicFactorModel | family spec
         path does zero extra work — no events, no clock reads, no host
         syncs.  Family fits are traced too, but only ``FitResult`` carries
         the summary attribute.
+    progress : live per-chunk progress hook (fused-chunk JAX backends
+        only): ``progress(info)`` fires once per dispatched chunk with
+        {chunk, iter, total, loglik, delta, dparam, elapsed_s, eta_s,
+        metrics, stopped, converged} — ``eta_s`` is the amortized-wall
+        estimate over the remaining budget, ``metrics`` the (n, 3)
+        device-side per-iteration array [loglik, in-chunk delta, max
+        param-update norm] the chunk program accumulated (zero extra
+        dispatches; see ``estim.em``).  With ``progress=None`` the
+        metrics code never runs and the device program is byte-identical
+        to the metrics-free path.
     """
     tracer, owned = fit_tracer(telemetry)
     t0 = time.perf_counter()
@@ -850,7 +876,7 @@ def fit(model,                     # DynamicFactorModel | family spec
         with activate(tracer):
             res = _fit_impl(model, Y, mask, backend, max_iters, tol, init,
                             callback, checkpoint_path, checkpoint_every,
-                            debug, robust)
+                            debug, robust, progress)
             if tracer is not None and isinstance(res, FitResult):
                 tracer.emit("fit", t=t0, engine=res.backend,
                             shape=shape_key(Y), n_iters=res.n_iters,
@@ -862,14 +888,55 @@ def fit(model,                     # DynamicFactorModel | family spec
     if (tracer is not None and telemetry not in (None, False)
             and isinstance(res, FitResult)):
         res.telemetry = tracer.summary()
+    if tracer is not None and isinstance(res, FitResult):
+        # Perf observatory: a traced fit appends a RunRecord when (and
+        # only when) DFM_RUNS is explicitly set — see obs/store.py.
+        _maybe_record_fit_run(res, Y, time.perf_counter() - t0)
     return res
 
 
+def _maybe_record_fit_run(res: "FitResult", Y, wall: float) -> None:
+    from .obs.store import RunStore, device_kind, make_record, runs_dir
+    d = runs_dir(ambient_only=True)
+    if d is None:
+        return
+    try:
+        import jax
+        dev = str(jax.devices()[0].platform)
+    except Exception:
+        dev = None
+    config = {"fit": type(res.model).__name__, "backend": res.backend,
+              "n_factors": getattr(res.model, "n_factors", None),
+              "T": int(Y.shape[0]), "N": int(Y.shape[1]),
+              "device": device_kind(dev)}
+    metrics = {"wall_s": wall}
+    if wall > 0:
+        metrics["fit_iters_per_sec"] = res.n_iters / wall
+    tele = res.telemetry or {}
+    try:
+        RunStore(d).append(make_record(
+            "fit", config, metrics, device=dev, loglik=res.loglik,
+            convergence=[float(x) for x in res.logliks],
+            dispatches=tele.get("dispatches"),
+            recompiles=tele.get("recompiles"), wall_s=wall))
+    except Exception as e:       # never fail a fit over bookkeeping
+        import warnings
+        warnings.warn(f"DFM_RUNS append failed: {e}", RuntimeWarning,
+                      stacklevel=2)
+
+
 def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
-              checkpoint_path, checkpoint_every, debug, robust):
+              checkpoint_path, checkpoint_every, debug, robust,
+              progress=None):
     family = _family_fit(model, Y, mask, backend, max_iters, tol, init,
                          callback, checkpoint_path, debug)
     if family is not None:
+        if progress is not None:
+            import warnings
+            warnings.warn(
+                f"the {type(model).__name__} family has no per-chunk "
+                "progress hook; ignoring progress=", RuntimeWarning,
+                stacklevel=3)
         return family
     max_iters = 50 if max_iters is None else max_iters
     tol = 1e-6 if tol is None else tol
@@ -971,6 +1038,19 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
     if robust is not None and hasattr(b, "robust"):
         restore_robust = (b.robust,)
         b.robust = robust
+    # progress only rides along for THIS fit, same transient contract as
+    # debug/robust.  Backends without the fused-chunk driver (CPU oracle)
+    # have no seam for it.
+    restore_progress = None
+    if progress is not None:
+        if hasattr(b, "_progress"):
+            restore_progress = (b._progress,)
+            b._progress = progress
+        else:
+            import warnings
+            warnings.warn(
+                f"backend {b.name!r} has no per-chunk progress hook; "
+                "ignoring progress=", RuntimeWarning, stacklevel=2)
     restore_gck = None
     if checkpoint_path is not None and hasattr(b, "_guard_checkpoint"):
         # Let the guard save the last GOOD params before declaring failure
@@ -1056,6 +1136,8 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             b.debug = restore_debug
         if restore_robust is not None:
             b.robust = restore_robust[0]
+        if restore_progress is not None:
+            b._progress = restore_progress[0]
         if restore_gck is not None:
             b._guard_checkpoint = restore_gck[0]
     return FitResult(params=params, logliks=np.asarray(lls),

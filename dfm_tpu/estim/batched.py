@@ -317,7 +317,8 @@ def _bmask(m, x):
     return m.reshape(m.shape + (1,) * (x.ndim - 1))
 
 
-def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int):
+def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int,
+                   with_metrics: bool = False):
     """n fused EM iterations over the batch.  Pure (jit/shard_map-able).
 
     carry = (p, p_prev, ll_prev (B,), state (B,) int32, n_lls (B,) int32):
@@ -327,7 +328,12 @@ def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int):
     each problem's loglik column to this).  Frozen problems still compute
     (no early exit from a fused program) but their carry is held by
     ``jnp.where`` selects — the decision logic reproduces ``em_progress``
-    exactly, including NaN -> continue."""
+    exactly, including NaN -> continue.
+
+    ``with_metrics`` (static) additionally scans out a per-iteration
+    (B, 3) [loglik, delta, max param-update] block in f64 — a device-side
+    convergence record with zero extra dispatches.  The flag only ADDS
+    outputs; the default program's traced ops are untouched."""
     Ysq = jnp.einsum("btn,btn->bn", Y, Y)           # iteration-invariant
 
     def body(c, _):
@@ -363,7 +369,19 @@ def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int):
             lambda cur, prv: jnp.where(_bmask(active, cur), cur, prv),
             p, p_prev)
         ll_prev_out = jnp.where(active, ll, ll_prev)
-        return (p_out, p_prev_out, ll_prev_out, new_state, n_new), ll
+        c_out = (p_out, p_prev_out, ll_prev_out, new_state, n_new)
+        if with_metrics:
+            dl = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda new, cur: jnp.max(
+                    jnp.abs(new - cur).reshape(new.shape[0], -1), axis=1),
+                p_out, p))
+            dparam = jnp.max(jnp.stack(dl), axis=0)        # (B,)
+            ll64 = jnp.asarray(ll, jnp.float64)
+            row = jnp.stack(
+                [ll64, ll64 - jnp.asarray(ll_prev, jnp.float64),
+                 jnp.asarray(dparam, jnp.float64)], axis=-1)  # (B, 3)
+            return c_out, (ll, row)
+        return c_out, ll
 
     return lax.scan(body, carry, None, length=n_iters)
 
@@ -371,6 +389,12 @@ def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int):
 @partial(jax.jit, static_argnames=("cfg", "n_iters"))
 def _em_chunk_impl(Y, carry, tol, noise_floor, cfg, n_iters):
     return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_iters"))
+def _em_chunk_metrics_impl(Y, carry, tol, noise_floor, cfg, n_iters):
+    return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters,
+                          with_metrics=True)
 
 
 def _smooth_core(Y, p):
@@ -389,7 +413,8 @@ _smooth_impl = jax.jit(_smooth_core)
 
 def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                    tol: float, fused_chunk: int = 8, policy=None,
-                   scan_impl=None, state0=None):
+                   scan_impl=None, state0=None, with_metrics: bool = False,
+                   scan_impl_metrics=None):
     """Chunked host driver around the fused batched-EM program.
 
     ``Y`` (B, T, N) and ``p0`` batched (device or host arrays).  Runs
@@ -403,14 +428,22 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
     driver marks its pad problems PADDED so they freeze from the start).
 
     Returns (params (batched SSMParams), lls_list (per-problem trace
-    arrays), converged (B,) bool, p_iters (B,) int, healths (B,) list).
+    arrays), converged (B,) bool, p_iters (B,) int, healths (B,) list);
+    with ``with_metrics`` a 6th element — the (total_iters, B, 3) f64
+    per-iteration [loglik, delta, max param-update] block scanned out of
+    the chunk programs (``scan_impl_metrics`` overrides the metrics twin
+    the way ``scan_impl`` overrides the default program).
     """
     B, T, N = Y.shape
     Yj = jnp.asarray(Y)
     dt = Yj.dtype
     acc = accum_dtype(dt)
     nf = noise_floor_for(dt, T * N, mult=cfg.noise_floor_mult)
-    impl = scan_impl if scan_impl is not None else _em_chunk_impl
+    if with_metrics:
+        impl = (scan_impl_metrics if scan_impl_metrics is not None
+                else _em_chunk_metrics_impl)
+    else:
+        impl = scan_impl if scan_impl is not None else _em_chunk_impl
     tol_j = jnp.asarray(tol, acc)
     nf_j = jnp.asarray(nf, acc)
     state = (jnp.zeros((B,), jnp.int32) if state0 is None
@@ -424,6 +457,7 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
     state_prev_h = np.asarray(state) if tr is not None else None
 
     traces: list = []
+    metric_chunks: list = []
     dispatch_events: list = []
     n_chunks = 0
     n_retries = 0
@@ -435,19 +469,25 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
         for a in range(attempts):
             try:
                 if tr is None:
-                    new_carry, lls = impl(Yj, carry, tol_j, nf_j, cfg, n)
+                    new_carry, out = impl(Yj, carry, tol_j, nf_j, cfg, n)
+                    lls, mets = out if with_metrics else (out, None)
                     # The small state transfer is the execution barrier on
                     # this device class (block_until_ready is a no-op on
                     # axon).
                     state_h = np.asarray(new_carry[3])
                     lls_h = np.asarray(lls, np.float64)
+                    mets_h = (np.asarray(mets, np.float64)
+                              if mets is not None else None)
                 else:
                     with tr.dispatch(prog,
                                      shape_key(Yj, prog_key, f"iters{n}"),
                                      barrier=True, n_iters=n, attempt=a):
-                        new_carry, lls = impl(Yj, carry, tol_j, nf_j, cfg, n)
+                        new_carry, out = impl(Yj, carry, tol_j, nf_j, cfg, n)
+                        lls, mets = out if with_metrics else (out, None)
                         state_h = np.asarray(new_carry[3])
                         lls_h = np.asarray(lls, np.float64)
+                        mets_h = (np.asarray(mets, np.float64)
+                                  if mets is not None else None)
                 break
             except (policy.retry_exceptions if policy is not None
                     else ()) as e:
@@ -471,6 +511,8 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                 delay *= policy.backoff_factor
         carry = new_carry
         traces.append(lls_h)                        # (n, B)
+        if mets_h is not None:
+            metric_chunks.append(mets_h)            # (n, B, 3)
         n_chunks += 1
         it += n
         if tr is not None:
@@ -481,11 +523,15 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                 tr.emit("freeze", engine=engine, problem=int(b),
                         state=STATE_NAMES.get(int(state_h[b]), "?"),
                         chunk=n_chunks - 1, iteration=int(n_lls_h[b]))
+            # Batch-max param-update per fused iteration, when the metrics
+            # twin ran (same "dparams" field the single-fit chunk emits).
+            extra = ({"dparams": [float(x) for x in mets_h[:, :, 2].max(1)]}
+                     if mets_h is not None else {})
             tr.emit("chunk", engine=engine, iter0=it - n, n=int(n),
                     noise_floor=float(nf),
                     running=int((state_h == RUNNING).sum()),
                     converged=int((state_h == CONVERGED).sum()),
-                    diverged=int((state_h == DIVERGED).sum()))
+                    diverged=int((state_h == DIVERGED).sum()), **extra)
             state_prev_h = state_h
         if (state_h != RUNNING).all():
             break
@@ -507,6 +553,10 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
         for ev in dispatch_events:
             h.record(dataclasses.replace(ev), emit=False)
         healths.append(h)
+    if with_metrics:
+        metrics_all = (np.concatenate(metric_chunks, axis=0) if metric_chunks
+                       else np.zeros((0, B, 3)))
+        return p, lls_list, converged, p_iters, healths, metrics_all
     return p, lls_list, converged, p_iters, healths
 
 
@@ -599,6 +649,9 @@ class BatchFitResult:
     model: object
     spec: DFMBatchSpec
     backend: str
+    # (total_iters, B, 3) f64 [loglik, delta, max param-update] per fused
+    # iteration when fit_many(with_metrics=True); None otherwise.
+    metrics: Optional[np.ndarray] = None
 
     @property
     def logliks_final(self) -> np.ndarray:
@@ -612,7 +665,8 @@ class BatchFitResult:
 def fit_many(spec: DFMBatchSpec, backend: str = "tpu", max_iters: int = 50,
              tol: float = 1e-6, dtype=None, fused_chunk: int = 8,
              n_devices: Optional[int] = None, robust=True,
-             device_init: bool = False) -> BatchFitResult:
+             device_init: bool = False,
+             with_metrics: bool = False) -> BatchFitResult:
     """Fit B independent DFM problems in ONE fused program per chunk.
 
     The batched twin of ``api.fit`` for same-shaped, fully-observed
@@ -627,6 +681,9 @@ def fit_many(spec: DFMBatchSpec, backend: str = "tpu", max_iters: int = 50,
     ``device_init`` opts into the vmapped Gram-eigh PCA init on device
     (``estim.init.pca_init_batched``; uniform-k specs only) — the NumPy
     initializer stays canonical, same policy as ``TPUBackend``.
+    ``with_metrics`` routes the chunks through the metrics twin program
+    and fills ``BatchFitResult.metrics`` (per-iteration device-side
+    convergence record; the default program is untouched when off).
     """
     from ..api import _resolve_policy
     Y = np.asarray(spec.Y, np.float64)
@@ -682,20 +739,30 @@ def fit_many(spec: DFMBatchSpec, backend: str = "tpu", max_iters: int = 50,
     Yj = jnp.asarray(Yz, dt)
     p0 = stack_params(inits, dt)
 
+    metrics = None
     with jax.default_matmul_precision("highest"):
         if backend == "sharded":
             from ..parallel.batched import (batched_smooth_sharded,
                                             run_batched_em_sharded)
-            p, lls_list, conv, p_iters, healths = run_batched_em_sharded(
+            out = run_batched_em_sharded(
                 Yj, p0, cfg, max_iters, tol, fused_chunk=fused_chunk,
-                n_devices=n_devices, policy=policy)
+                n_devices=n_devices, policy=policy,
+                with_metrics=with_metrics)
+            if with_metrics:
+                p, lls_list, conv, p_iters, healths, metrics = out
+            else:
+                p, lls_list, conv, p_iters, healths = out
 
             def _smooth():
                 return batched_smooth_sharded(Yj, p, n_devices=n_devices)
         elif backend == "tpu":
-            p, lls_list, conv, p_iters, healths = run_batched_em(
+            out = run_batched_em(
                 Yj, p0, cfg, max_iters, tol, fused_chunk=fused_chunk,
-                policy=policy)
+                policy=policy, with_metrics=with_metrics)
+            if with_metrics:
+                p, lls_list, conv, p_iters, healths, metrics = out
+            else:
+                p, lls_list, conv, p_iters, healths = out
 
             def _smooth():
                 return _smooth_impl(Yj, p)
@@ -723,4 +790,4 @@ def fit_many(spec: DFMBatchSpec, backend: str = "tpu", max_iters: int = 50,
         n_iters=np.array([len(t) for t in lls_list]),
         p_iters=np.asarray(p_iters), factors=factors,
         factor_cov=factor_cov, standardizers=stds, health=healths,
-        model=model, spec=spec, backend=backend)
+        model=model, spec=spec, backend=backend, metrics=metrics)

@@ -331,11 +331,15 @@ def run_em_loop(step, max_iters: int, tol: float, callback=None,
 
 def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                    noise_floor: float, callback=None, fused_chunk: int = 8,
-                   ss_tau=None, monitor=None):
+                   ss_tau=None, monitor=None, progress=None):
     """Shared fused-chunk EM driver (single-device, sharded, and MF fits).
 
     ``scan_fn(p, n) -> (p_new, logliks (n,), ss_deltas (n,) | None)`` runs n
-    fused EM iterations in one XLA program.  Convergence/divergence can only
+    fused EM iterations in one XLA program.  A scan_fn may append a 4th
+    element — a (n, 3) per-iteration metrics array [loglik, in-chunk delta,
+    max param-update norm] (see ``em_fit_scan(with_metrics=True)``) —
+    surfaced in the chunk trace events and the ``progress`` hook.
+    Convergence/divergence can only
     be detected once a chunk's logliks reach the host, by which point the
     device params embody the WHOLE chunk; a mid-chunk stop therefore replays
     the chunk's prefix from the stored chunk-entry params (one shorter fused
@@ -346,6 +350,13 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
     Callbacks receive chunk-entry params; a callback carrying
     ``wants_params_iter = True`` is additionally passed ``params_iter`` (the
     iteration those params embody) so checkpoints are never mislabeled.
+
+    ``progress``: live per-chunk hook — ``progress(info)`` fires once per
+    dispatched chunk with a dict {chunk, iter, total, loglik, delta,
+    dparam, elapsed_s, eta_s, metrics, stopped, converged}; ``eta_s`` is
+    the amortized-wall estimate ``elapsed / iters_done * iters_left``
+    (first chunk includes compile — the estimate improves as chunks
+    amortize it).  Fires AFTER the stopping rule so ``stopped`` is final.
 
     ``ss_tau``: when set, ss freeze deltas (up to the stop) feed
     ``warn_ss_delta`` with this tau.  Returns (p, lls, converged, p_iters).
@@ -358,7 +369,9 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         from ..robust.guard import guarded_run_em_chunked
         return guarded_run_em_chunked(
             scan_fn, p0, max_iters, tol, noise_floor, callback=callback,
-            fused_chunk=fused_chunk, ss_tau=ss_tau, monitor=monitor)
+            fused_chunk=fused_chunk, ss_tau=ss_tau, monitor=monitor,
+            progress=progress)
+    import time
     import numpy as np
     fused_chunk = max(1, int(fused_chunk))   # 0/negative would never advance
     pass_piter = getattr(callback, "wants_params_iter", False)
@@ -373,6 +386,8 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
     max_delta = 0.0
     p = p0
     it = 0
+    n_chunks = 0
+    t0 = time.perf_counter()
     p_entry = p_entry_prev = p0
     entry_it = entry_it_prev = 0
     while it < max_iters and not stop:
@@ -380,8 +395,11 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         p_entry_prev, entry_it_prev = p_entry, entry_it
         p_entry, entry_it = p, it
         if tr is None:
-            p, chunk, deltas = scan_fn(p, n)
-            chunk = np.asarray(chunk, np.float64)
+            out = scan_fn(p, n)
+            p, chunk = out[0], np.asarray(out[1], np.float64)
+            deltas = out[2]
+            metrics = (np.asarray(out[3], np.float64)
+                       if len(out) > 3 and out[3] is not None else None)
         else:
             # The np.asarray transfer is the execution barrier (CLAUDE.md:
             # block_until_ready is a no-op on axon), so the span wall time
@@ -389,15 +407,21 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
             # length n is a distinct XLA program -> part of the shape key.
             with tr.dispatch(prog, shape_key(prog_key, f"iters{n}"),
                              barrier=True, n_iters=n):
-                p, chunk, deltas = scan_fn(p, n)
-                chunk = np.asarray(chunk, np.float64)
+                out = scan_fn(p, n)
+                p, chunk = out[0], np.asarray(out[1], np.float64)
+                deltas = out[2]
+                metrics = (np.asarray(out[3], np.float64)
+                           if len(out) > 3 and out[3] is not None else None)
             drops = np.diff(chunk)
+            extra = ({"dparams": [float(x) for x in metrics[:, 2]]}
+                     if metrics is not None else {})
             tr.emit("chunk", engine=engine, iter0=it, n=int(n),
                     lls=[float(x) for x in chunk],
                     noise_floor=float(noise_floor),
                     max_drop=float(-drops.min()) if drops.size else 0.0,
                     below_floor=bool(drops.size == 0
-                                     or np.abs(drops).max() < noise_floor))
+                                     or np.abs(drops).max() < noise_floor),
+                    **extra)
         consumed = n
         for j, ll in enumerate(chunk):
             lls.append(float(ll))
@@ -424,6 +448,23 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
             # garbage params).
             max_delta = max(max_delta,
                             float(np.max(np.asarray(deltas)[:consumed])))
+        if progress is not None:
+            iters_done = entry_it + consumed
+            elapsed = time.perf_counter() - t0
+            left = 0 if stop else max_iters - (it + n)
+            progress({"chunk": n_chunks, "iter": int(iters_done),
+                      "total": int(max_iters), "loglik": lls[-1],
+                      "delta": (lls[-1] - lls[-2]) if len(lls) > 1
+                      else None,
+                      "dparam": (float(metrics[consumed - 1, 2])
+                                 if metrics is not None and consumed
+                                 else None),
+                      "elapsed_s": elapsed,
+                      "eta_s": ((elapsed / iters_done) * left
+                                if iters_done else None),
+                      "metrics": metrics, "stopped": bool(stop),
+                      "converged": bool(converged)})
+        n_chunks += 1
         it += n
     if ss_tau is not None:
         warn_ss_delta(max_delta, ss_tau)
@@ -513,9 +554,51 @@ def _em_scan_core(Y, mask, p0, cfg, has_mask, n_iters):
     return p, lls, deltas, sumsq
 
 
+def max_abs_update(p_new, p):
+    """max over all param leaves of max|p_new - p| (the in-loop
+    param-update norm of the per-iteration metrics row)."""
+    leaves = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda a, b: jnp.max(jnp.abs(a - b)),
+                               p_new, p))
+    return jnp.max(jnp.stack(leaves))
+
+
+def _em_scan_core_metrics(Y, mask, p0, cfg, has_mask, n_iters):
+    """Metrics twin of ``_em_scan_core``: the scan carry additionally
+    threads the previous loglik so each fused iteration emits a metrics
+    row [loglik, in-chunk delta, max param-update norm] — iteration-
+    granularity convergence data at ZERO extra dispatches.  A separate
+    function (not a flag on the default body) so the metrics-off path is
+    the byte-identical PR 3 program with an unchanged jit cache."""
+    m = mask if has_mask else None
+    sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
+
+    def body(carry, _):
+        p, ll_prev = carry
+        kf, sm, delta = cfg.e_step(Y, m, p, sumsq=sumsq)
+        p_new = _m_step(Y, m, sm, p, cfg, Ysq=Ysq)
+        ll = jnp.asarray(kf.loglik, jnp.float64)
+        row = jnp.stack([ll, ll - ll_prev,
+                         jnp.asarray(max_abs_update(p_new, p),
+                                     jnp.float64)])
+        return (p_new, ll), (kf.loglik, delta, row)
+
+    # NaN seed: the first iteration of a chunk has no in-device
+    # predecessor loglik (the chunk driver knows the cross-chunk delta).
+    ll0 = jnp.asarray(jnp.nan, jnp.float64)
+    (p, _), (lls, deltas, metrics) = jax.lax.scan(
+        body, (p0, ll0), None, length=n_iters)
+    return p, lls, deltas, metrics
+
+
 @partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
 def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
     return _em_scan_core(Y, mask, p0, cfg, has_mask, n_iters)[:3]
+
+
+@partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
+def _em_fit_scan_metrics_impl(Y, mask, p0, cfg, has_mask, n_iters):
+    return _em_scan_core_metrics(Y, mask, p0, cfg, has_mask, n_iters)
 
 
 @partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
@@ -540,23 +623,29 @@ def _em_fit_scan_checked_impl(Y, mask, p0, cfg, has_mask, n_iters):
 
 
 def em_fit_scan(Y, p0: SSMParams, n_iters: int, mask=None,
-                cfg: EMConfig = EMConfig()):
+                cfg: EMConfig = EMConfig(), with_metrics: bool = False):
     """Fixed-iteration EM fused into one XLA program (benchmark path:
     BASELINE.json:2 'EM iters/sec' measured without host round-trips).
-    Returns (params, logliks (n,), ss_deltas (n,))."""
+    Returns (params, logliks (n,), ss_deltas (n,)); with
+    ``with_metrics=True`` a 4th element is appended — a (n, 3) per-
+    iteration array [loglik, in-chunk delta, max param-update norm]
+    (see ``_em_scan_core_metrics``; the default path's compiled program
+    is untouched).  Debug mode has no metrics twin (checkify is the
+    diagnostic already): it returns metrics=None."""
     if cfg.debug:
         err, out = _em_fit_scan_checked_impl(Y, mask, p0, cfg,
                                              mask is not None, n_iters)
         err.throw()
-        return out
+        return out + (None,) if with_metrics else out
+    impl = _em_fit_scan_metrics_impl if with_metrics else _em_fit_scan_impl
     tr = current_tracer()
     if tr is None:
-        return _em_fit_scan_impl(Y, mask, p0, cfg, mask is not None, n_iters)
+        return impl(Y, mask, p0, cfg, mask is not None, n_iters)
     # When called from a chunk driver this span is suppressed (the driver's
     # barrier'd span owns the launch); direct callers (bench, dryrun) get
     # the async-dispatch record here.
     key = shape_key(Y, cfg.filter, f"iters{n_iters}")
-    tr.maybe_cost("em_fit_scan", key, _em_fit_scan_impl,
+    tr.maybe_cost("em_fit_scan", key, impl,
                   Y, mask, p0, cfg, mask is not None, n_iters)
     with tr.dispatch("em_fit_scan", key, n_iters=n_iters):
-        return _em_fit_scan_impl(Y, mask, p0, cfg, mask is not None, n_iters)
+        return impl(Y, mask, p0, cfg, mask is not None, n_iters)

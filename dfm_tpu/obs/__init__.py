@@ -3,6 +3,10 @@
 Zero-overhead when off: every instrumentation site is gated on
 ``current_tracer() is None``.  Activate with ``fit(telemetry=...)`` or
 ``DFM_TRACE=<path>``; summarize with ``python -m dfm_tpu.obs.report``.
+
+Perf observatory (PR 4): ``obs.store`` is the persistent run registry
+(``DFM_RUNS``), ``obs.regress`` the cross-run regression gate —
+``python -m dfm_tpu.obs.regress`` / ``report --diff``.
 """
 
 from .cost import (RecompileDetector, global_detector, program_cost,
@@ -17,8 +21,18 @@ def summarize(events_or_path):
     from .report import summarize as _summarize
     return _summarize(events_or_path)
 
+
+def run_store(path=None):
+    """Open the run registry (lazy import, same policy as ``summarize``):
+    ``RunStore`` at ``path`` or the resolved ``runs_dir()``; None when
+    recording is disabled and no path is given."""
+    from .store import RunStore, runs_dir
+    d = path or runs_dir()
+    return RunStore(d) if d is not None else None
+
+
 __all__ = [
     "Tracer", "activate", "current_tracer", "fit_tracer", "shape_key",
     "RecompileDetector", "global_detector", "reset_global_detector",
-    "program_cost", "summarize",
+    "program_cost", "summarize", "run_store",
 ]

@@ -1,0 +1,233 @@
+"""Cross-run perf/convergence regression gate (jax-free CLI).
+
+Diffs a candidate run against either an explicit baseline
+(``--against <run_id|file>``) or the registry's best-of-history for the
+same config fingerprint.  Thresholds are noise-aware: the historical
+baseline per metric is the *median of the best N* recorded values
+(axon-tunnel walls drift run to run; a single lucky best would
+over-trigger), and the tolerance band is relative (default 30% — wide
+enough for tunnel jitter, far inside the 2x-slowdown gate the acceptance
+criteria require).
+
+Exit codes: 0 = no regression, 1 = perf or convergence regression,
+2 = usage error (unknown run, unreadable file, empty registry).
+
+::
+
+    python -m dfm_tpu.obs.regress [candidate] [--against <run|file>]
+        [--runs DIR] [--tol 0.30] [--loglik-rtol 1e-3] [--best-n 5]
+        [--json]
+
+``candidate`` defaults to the latest recorded run; it may also be a
+run_id or a path to a JSON file (a RunRecord or a raw ``bench.py``
+output line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from .store import (RunStore, lower_is_better, noise_floor,
+                    record_from_bench_json, runs_dir)
+
+DEFAULT_TOL = 0.30
+DEFAULT_LOGLIK_RTOL = 1e-3
+
+
+class UsageError(Exception):
+    pass
+
+
+def _load_record(spec: str, store: Optional[RunStore]) -> Dict[str, Any]:
+    """Resolve a run_id-or-path spec to a RunRecord dict."""
+    if os.path.exists(spec):
+        try:
+            with open(spec) as f:
+                obj = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise UsageError("cannot read %s: %s" % (spec, e))
+        if isinstance(obj, dict) and "metrics" in obj and "run_id" in obj:
+            return obj
+        if isinstance(obj, dict) and "parsed" in obj:   # BENCH_r* wrapper
+            obj = obj["parsed"]
+        if isinstance(obj, dict) and "metric" in obj:   # raw bench line
+            return record_from_bench_json(obj, source=spec)
+        raise UsageError("%s is not a RunRecord or bench JSON" % spec)
+    if store is None:
+        raise UsageError("no runs dir and %s is not a file" % spec)
+    rec = store.get(spec)
+    if rec is None:
+        raise UsageError("run %r not found in %s" % (spec, store.file))
+    return rec
+
+
+def record_from_trace_summary(summary: Dict[str, Any], *,
+                              source: str = "trace") -> Dict[str, Any]:
+    """Adapt an ``obs.report.summarize`` dict into a pseudo-RunRecord so
+    two traces (or a trace and a recorded run) diff through the same gate
+    (``obs.report --diff``)."""
+    metrics: Dict[str, float] = {}
+    for k in ("amortized_ms_per_iter", "wall_s"):
+        v = summary.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            metrics[k] = float(v)
+    for k, v in (summary.get("phases") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            metrics[k] = float(v)
+    rec: Dict[str, Any] = {
+        "run_id": source, "kind": "trace", "source": source,
+        "config": {"kind": "trace"}, "fingerprint": "kind=trace",
+        "metrics": metrics,
+        "dispatches": summary.get("dispatches"),
+        "recompiles": summary.get("recompiles"),
+    }
+    conv = summary.get("convergence") or {}
+    ll = conv.get("loglik_last")
+    if isinstance(ll, (int, float)) and not isinstance(ll, bool):
+        rec["loglik"] = float(ll)
+    return rec
+
+
+def compare(cand: Dict[str, Any], baselines: Dict[str, float],
+            base_loglik: Optional[float], *, tol: float = DEFAULT_TOL,
+            loglik_rtol: float = DEFAULT_LOGLIK_RTOL,
+            baseline_label: str = "history") -> Dict[str, Any]:
+    """Diff candidate metrics against per-metric baseline values.
+
+    A perf regression is a candidate worse than baseline by more than
+    ``tol`` relative (direction per :func:`store.lower_is_better`); a
+    convergence regression is a final loglik *below* baseline by more
+    than ``loglik_rtol`` relative."""
+    checks: List[Dict[str, Any]] = []
+    for metric, base in sorted(baselines.items()):
+        c = cand.get("metrics", {}).get(metric)
+        if c is None or base is None:
+            continue
+        lower = lower_is_better(metric)
+        ratio = (c / base) if base else float("inf")
+        ok = ratio <= 1.0 + tol if lower else ratio >= 1.0 - tol
+        sub_noise = False
+        if not ok and lower and abs(c - base) <= noise_floor(metric):
+            ok = sub_noise = True      # out of band but below unit floor
+        checks.append({"metric": metric, "candidate": c, "baseline": base,
+                       "ratio": ratio, "tol": tol,
+                       "direction": "lower" if lower else "higher",
+                       "sub_noise": sub_noise, "ok": bool(ok)})
+    ll_check = None
+    c_ll = cand.get("loglik")
+    if c_ll is not None and base_loglik is not None:
+        rel = (c_ll - base_loglik) / max(1.0, abs(base_loglik))
+        ll_check = {"candidate": c_ll, "baseline": base_loglik,
+                    "rel": rel, "rtol": loglik_rtol,
+                    "ok": bool(rel >= -loglik_rtol)}
+    ok = all(c["ok"] for c in checks) and (ll_check is None
+                                           or ll_check["ok"])
+    return {"candidate": cand.get("run_id"),
+            "fingerprint": cand.get("fingerprint"),
+            "baseline": baseline_label, "checks": checks,
+            "loglik": ll_check, "n_checked": len(checks), "ok": bool(ok)}
+
+
+def diff_against_history(cand: Dict[str, Any], store: RunStore, *,
+                         tol: float = DEFAULT_TOL,
+                         loglik_rtol: float = DEFAULT_LOGLIK_RTOL,
+                         best_n: int = 5) -> Dict[str, Any]:
+    fp = cand.get("fingerprint")
+    baselines = {}
+    for metric in cand.get("metrics", {}):
+        b = store.baseline(fp, metric, best_n=best_n,
+                           exclude_run=cand.get("run_id"))
+        if b is not None:
+            baselines[metric] = b
+    base_ll = store.baseline_loglik(fp, exclude_run=cand.get("run_id"))
+    return compare(cand, baselines, base_ll, tol=tol,
+                   loglik_rtol=loglik_rtol,
+                   baseline_label="best-of-history(n=%d)" % best_n)
+
+
+def diff_records(cand: Dict[str, Any], base: Dict[str, Any], *,
+                 tol: float = DEFAULT_TOL,
+                 loglik_rtol: float = DEFAULT_LOGLIK_RTOL
+                 ) -> Dict[str, Any]:
+    return compare(cand, dict(base.get("metrics", {})),
+                   base.get("loglik"), tol=tol, loglik_rtol=loglik_rtol,
+                   baseline_label=base.get("run_id") or "baseline")
+
+
+def print_diff(d: Dict[str, Any], file=None) -> None:
+    file = file or sys.stdout
+    print("regress: candidate %s vs %s"
+          % (d.get("candidate"), d.get("baseline")), file=file)
+    for c in d["checks"]:
+        arrow = "<=" if c["direction"] == "lower" else ">="
+        print("  [%s] %-42s %.4g vs %.4g (ratio %.3f, need %s %.2f)%s"
+              % ("ok" if c["ok"] else "REGRESSION", c["metric"],
+                 c["candidate"], c["baseline"], c["ratio"], arrow,
+                 1.0 + c["tol"] if c["direction"] == "lower"
+                 else 1.0 - c["tol"],
+                 " [sub-noise]" if c.get("sub_noise") else ""), file=file)
+    ll = d.get("loglik")
+    if ll is not None:
+        print("  [%s] %-42s %.6g vs %.6g (rel %.3g, floor -%.1g)"
+              % ("ok" if ll["ok"] else "REGRESSION", "final loglik",
+                 ll["candidate"], ll["baseline"], ll["rel"], ll["rtol"]),
+              file=file)
+    if not d["checks"] and ll is None:
+        print("  (no comparable metrics — nothing gated)", file=file)
+    print("regress: %s" % ("OK" if d["ok"] else "REGRESSION"), file=file)
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m dfm_tpu.obs.regress",
+        description="Perf/convergence regression gate (jax-free).")
+    ap.add_argument("candidate", nargs="?", default=None,
+                    help="run_id or JSON file (default: latest run)")
+    ap.add_argument("--against", default=None,
+                    help="baseline run_id or JSON file "
+                         "(default: best-of-history)")
+    ap.add_argument("--runs", default=None)
+    ap.add_argument("--tol", type=float, default=DEFAULT_TOL)
+    ap.add_argument("--loglik-rtol", type=float,
+                    default=DEFAULT_LOGLIK_RTOL)
+    ap.add_argument("--best-n", type=int, default=5)
+    ap.add_argument("--json", action="store_true")
+    a = ap.parse_args(argv)
+
+    d = runs_dir(a.runs)
+    store = RunStore(d) if d is not None else None
+    try:
+        if a.candidate is None:
+            if store is None:
+                raise UsageError("no candidate given and no runs dir")
+            cand = store.latest()
+            if cand is None:
+                raise UsageError("registry %s is empty" % store.file)
+        else:
+            cand = _load_record(a.candidate, store)
+        if a.against is not None:
+            base = _load_record(a.against, store)
+            diff = diff_records(cand, base, tol=a.tol,
+                                loglik_rtol=a.loglik_rtol)
+        else:
+            if store is None:
+                raise UsageError("no --against and no runs dir")
+            diff = diff_against_history(cand, store, tol=a.tol,
+                                        loglik_rtol=a.loglik_rtol,
+                                        best_n=a.best_n)
+    except UsageError as e:
+        print("error: %s" % e, file=sys.stderr)
+        return 2
+    if a.json:
+        print(json.dumps(diff))
+    else:
+        print_diff(diff)
+    return 0 if diff["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

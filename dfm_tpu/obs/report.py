@@ -28,6 +28,10 @@ __all__ = ["load", "summarize", "main"]
 
 
 def load(path: str) -> List[dict]:
+    """Parse a JSONL trace, tolerating damage: empty files, and
+    truncated/corrupt lines (a process killed mid-write leaves a partial
+    last line) are warned about on stderr and skipped — a damaged trace
+    must still summarize."""
     events = []
     with open(path, "r", encoding="utf-8") as fh:
         for i, line in enumerate(fh):
@@ -35,9 +39,16 @@ def load(path: str) -> List[dict]:
             if not line:
                 continue
             try:
-                events.append(json.loads(line))
+                ev = json.loads(line)
             except json.JSONDecodeError as e:
-                raise ValueError(f"{path}:{i + 1}: invalid JSONL: {e}") from e
+                print(f"warning: {path}:{i + 1}: skipping invalid JSONL "
+                      f"({e})", file=sys.stderr)
+                continue
+            if isinstance(ev, dict):
+                events.append(ev)
+            else:
+                print(f"warning: {path}:{i + 1}: skipping non-object line",
+                      file=sys.stderr)
     return events
 
 
@@ -115,6 +126,12 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
         if nf is not None and deltas:
             convergence["deltas_below_floor"] = sum(
                 1 for d in deltas if abs(d) < nf)
+        # Device-side per-iteration metrics (fit(progress=...) /
+        # metrics-enabled chunks): max param-update norm per iteration.
+        dparams = [float(x) for c in chunks for x in c.get("dparams", [])]
+        if dparams:
+            convergence["dparams"] = dparams
+            convergence["dparam_last"] = dparams[-1]
 
     freezes = [e for e in events if e.get("kind") == "freeze"]
     health = [e for e in events if e.get("kind") == "health"]
@@ -139,6 +156,23 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
         fused = sum(int(e.get("n_iters") or 1) for e in disp
                     if e.get("barrier"))
         out["amortized_ms_per_iter"] = 1e3 * sum(walls) / max(fused, 1)
+    # Total wall + per-phase breakdown: dispatch (device walls measured
+    # behind a barrier or async enqueue), transfer (h2d/d2h walls), host
+    # (everything else — python driver, numpy, event emission).
+    ts = [e["t"] for e in events
+          if isinstance(e.get("t"), (int, float))]
+    if ts:
+        end = max(e["t"] + float(e.get("dur") or 0.0) for e in events
+                  if isinstance(e.get("t"), (int, float)))
+        wall = max(end - min(ts), 0.0)
+        dispatch_s = sum(float(e["dur"]) for e in disp
+                         if e.get("dur") is not None)
+        transfer_s = sum(float(e.get("dur") or 0.0) for e in events
+                         if e.get("kind") == "transfer")
+        out["wall_s"] = wall
+        out["phases"] = {
+            "dispatch_s": dispatch_s, "transfer_s": transfer_s,
+            "host_s": max(wall - dispatch_s - transfer_s, 0.0)}
     if convergence is not None:
         out["convergence"] = convergence
     if freezes:
@@ -167,6 +201,12 @@ def _print_text(s: dict) -> None:
         print(f"amortized tunnel latency: "
               f"{s['amortized_ms_per_iter']:.2f} ms/iter "
               f"(barrier'd wall / fused iters)")
+    if "wall_s" in s:
+        ph = s.get("phases", {})
+        print(f"wall: {_fmt_s(s['wall_s'])} "
+              f"(dispatch {_fmt_s(ph.get('dispatch_s', 0.0))}, "
+              f"transfer {_fmt_s(ph.get('transfer_s', 0.0))}, "
+              f"host {_fmt_s(ph.get('host_s', 0.0))})")
     for name, p in s.get("programs", {}).items():
         line = (f"  {name}: {p['dispatches']} dispatch"
                 f"{'es' if p['dispatches'] != 1 else ''}, "
@@ -195,6 +235,9 @@ def _print_text(s: dict) -> None:
             print(f"  noise floor {c['noise_floor']:.3g}; "
                   f"{c.get('deltas_below_floor', 0)}/{len(c['deltas'])} "
                   f"deltas below floor")
+        if c.get("dparam_last") is not None:
+            print(f"  per-iteration metrics: {len(c['dparams'])} rows, "
+                  f"last max param-update {c['dparam_last']:.3g}")
     if s.get("freezes"):
         for f in s["freezes"]:
             print(f"  freeze: problem {f.get('problem')} -> "
@@ -220,14 +263,50 @@ def main(argv=None) -> int:
     ap.add_argument("trace", help="path to a trace.jsonl file")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object")
+    ap.add_argument("--diff", default=None, metavar="RUN|FILE",
+                    help="diff this trace against a baseline (another "
+                         "trace.jsonl, a RunRecord/bench JSON file, or a "
+                         "registry run_id) via obs.regress; exits nonzero "
+                         "on a perf/convergence regression")
     args = ap.parse_args(argv)
     s = summarize(args.trace)
+    if args.diff is not None:
+        return _diff(s, args.trace, args.diff, as_json=args.json)
     if args.json:
         json.dump(s, sys.stdout, indent=2, default=str)
         print()
     else:
         _print_text(s)
     return 0
+
+
+def _diff(s: dict, trace_path: str, baseline: str, *,
+          as_json: bool = False) -> int:
+    """Gate this trace's summary against a baseline through obs.regress
+    (exit 0 ok / 1 regression / 2 usage)."""
+    from . import regress
+    from .store import RunStore, runs_dir
+    cand = regress.record_from_trace_summary(s, source=trace_path)
+    try:
+        if baseline.endswith(".jsonl"):
+            # Another trace: summarize it through the same adapter so the
+            # two sides carry the same metric names.
+            base = regress.record_from_trace_summary(
+                summarize(baseline), source=baseline)
+        else:
+            d = runs_dir()
+            store = RunStore(d) if d is not None else None
+            base = regress._load_record(baseline, store)
+        diff = regress.diff_records(cand, base)
+    except (regress.UsageError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if as_json:
+        json.dump(diff, sys.stdout, indent=2, default=str)
+        print()
+    else:
+        regress.print_diff(diff)
+    return 0 if diff["ok"] else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,401 @@
+"""Persistent run registry for the perf observatory (jax-free).
+
+An append-only JSONL registry of benchmark / fit runs so perf history
+survives the process: every ``bench.py`` / ``bench/all.py`` /
+``bench/batched.py`` invocation appends a :class:`RunRecord` dict, and a
+traced ``fit()`` appends one when ``DFM_RUNS`` is explicitly set.  The
+``backfill`` importer seeds the registry from the checked-in
+``BENCH_r*.json`` + ``BENCH_ALL.json`` so history starts populated.
+``obs.regress`` diffs a run against this history.
+
+Resolution of the registry directory (``runs_dir``):
+
+- bench CLIs: ``DFM_RUNS=<dir>`` wins; ``DFM_RUNS=""`` disables;
+  unset -> the default ``.dfm_runs/`` (git-ignored).
+- traced fits (``ambient_only=True``): only an explicitly set non-empty
+  ``DFM_RUNS`` enables appending — a library call must not create
+  directories as a side effect of a default.
+
+CLI::
+
+    python -m dfm_tpu.obs.store backfill [--root DIR] [--runs DIR]
+    python -m dfm_tpu.obs.store list [--runs DIR] [--json]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+RUNS_ENV = "DFM_RUNS"
+DEFAULT_DIR = ".dfm_runs"
+RUNS_FILE = "runs.jsonl"
+
+# Metric-direction heuristics: throughputs ("..._per_sec...") are
+# higher-is-better; walls / per-program costs are lower-is-better.
+_LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
+                         "compile_s", "dispatch_s", "transfer_s", "host_s",
+                         "rel_err")
+
+
+def lower_is_better(metric: str) -> bool:
+    return any(m in metric for m in _LOWER_BETTER_MARKERS)
+
+
+# Absolute noise floors for lower-is-better metrics: a relative band alone
+# over-triggers when the baseline is tiny (a 0.6 ms CPU-fallback dispatch
+# jittering to 1.3 ms is a 2.2x "regression" with zero signal — real
+# tunnel dispatches are 60-100 ms).  A regression must clear the relative
+# band AND move by more than the metric's unit floor.
+_NOISE_FLOORS = (
+    ("rel_err", 1e-6),     # accuracy drift toward the 1e-5 contract bound
+    ("ms", 2.0),           # milliseconds: ms_per, _ms, dispatch_ms_...
+    ("_s", 0.05),          # seconds: wall_s, dispatch_s, compile_s, time_s
+    ("secs", 0.05),
+    ("wall", 0.05),
+)
+
+
+def noise_floor(metric: str) -> float:
+    """Absolute delta below which a lower-is-better move is noise."""
+    for marker, floor in _NOISE_FLOORS:
+        if marker in metric:
+            return floor
+    return 0.0
+
+
+def runs_dir(explicit: Optional[str] = None, *,
+             ambient_only: bool = False) -> Optional[str]:
+    """Resolve the registry directory; ``None`` means "do not record"."""
+    if explicit:
+        return str(explicit)
+    env = os.environ.get(RUNS_ENV)
+    if env:
+        return env
+    if env == "":          # explicitly disabled
+        return None
+    return None if ambient_only else DEFAULT_DIR
+
+
+def new_run_id() -> str:
+    return "r%x-%s" % (int(time.time()), uuid.uuid4().hex[:6])
+
+
+def git_rev(root: Optional[str] = None) -> Optional[str]:
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             cwd=root, capture_output=True, text=True,
+                             timeout=10)
+    except Exception:
+        return None
+    rev = out.stdout.strip()
+    return rev if out.returncode == 0 and rev else None
+
+
+def fingerprint(config: Dict[str, Any]) -> str:
+    """Stable config fingerprint: sorted ``k=v`` joined with ``|``."""
+    return "|".join("%s=%s" % (k, config[k]) for k in sorted(config))
+
+
+def device_kind(device: Optional[str]) -> str:
+    """Coarse device class ("tpu"/"cpu"/"gpu"/...) for the fingerprint —
+    runs on different hardware must not share a perf baseline."""
+    d = (device or "").lower()
+    for kind in ("tpu", "gpu", "cpu"):
+        if kind in d:
+            return kind
+    return d.split()[0] if d else "unknown"
+
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def make_record(kind: str, config: Dict[str, Any],
+                metrics: Dict[str, Any], *, device: Optional[str] = None,
+                loglik: Optional[float] = None,
+                convergence: Optional[List[float]] = None,
+                dispatches: Optional[int] = None,
+                recompiles: Optional[int] = None,
+                wall_s: Optional[float] = None, source: str = "live",
+                run_id: Optional[str] = None,
+                t_unix: Optional[float] = None,
+                root: Optional[str] = None) -> Dict[str, Any]:
+    """Assemble a RunRecord dict (the registry's one schema)."""
+    rec: Dict[str, Any] = {
+        "run_id": run_id or new_run_id(),
+        "t_unix": time.time() if t_unix is None else float(t_unix),
+        "kind": kind,
+        "device": device,
+        "git_rev": git_rev(root),
+        "source": source,
+        "config": dict(config),
+        "fingerprint": fingerprint(config),
+        "metrics": {k: _num(v) for k, v in metrics.items()
+                    if _num(v) is not None},
+    }
+    if loglik is not None and _num(loglik) is not None:
+        rec["loglik"] = float(loglik)
+    if convergence is not None:
+        rec["convergence"] = [float(x) for x in convergence]
+    if dispatches is not None:
+        rec["dispatches"] = int(dispatches)
+    if recompiles is not None:
+        rec["recompiles"] = int(recompiles)
+    if wall_s is not None:
+        rec["wall_s"] = float(wall_s)
+    return rec
+
+
+class RunStore:
+    """Append-only JSONL registry in ``<dir>/runs.jsonl``."""
+
+    def __init__(self, path: str):
+        self.dir = str(path)
+        self.file = os.path.join(self.dir, RUNS_FILE)
+
+    def append(self, rec: Dict[str, Any]) -> str:
+        os.makedirs(self.dir, exist_ok=True)
+        with open(self.file, "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.flush()
+        return rec["run_id"]
+
+    def load(self) -> List[Dict[str, Any]]:
+        """All records, oldest first; corrupt/truncated lines are skipped
+        (a run may die mid-append — history must still load)."""
+        if not os.path.exists(self.file):
+            return []
+        out = []
+        with open(self.file) as f:
+            for i, ln in enumerate(f, 1):
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    print("warning: %s line %d: corrupt record skipped"
+                          % (self.file, i), file=sys.stderr)
+                    continue
+                if isinstance(rec, dict) and "run_id" in rec:
+                    out.append(rec)
+        return out
+
+    def get(self, run_id: str) -> Optional[Dict[str, Any]]:
+        for rec in reversed(self.load()):
+            if rec.get("run_id") == run_id:
+                return rec
+        return None
+
+    def query(self, fingerprint: Optional[str] = None,
+              kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        recs = self.load()
+        if fingerprint is not None:
+            recs = [r for r in recs if r.get("fingerprint") == fingerprint]
+        if kind is not None:
+            recs = [r for r in recs if r.get("kind") == kind]
+        return sorted(recs, key=lambda r: r.get("t_unix", 0.0))
+
+    def latest(self, **kw) -> Optional[Dict[str, Any]]:
+        recs = self.query(**kw)
+        return recs[-1] if recs else None
+
+    def sources(self) -> set:
+        return {r.get("source") for r in self.load()}
+
+    def baseline(self, fingerprint: str, metric: str, *, best_n: int = 5,
+                 exclude_run: Optional[str] = None) -> Optional[float]:
+        """Noise-aware baseline: the median of the best ``best_n``
+        historical values of ``metric`` for this fingerprint (best = max
+        for throughputs, min for walls).  None when no history."""
+        vals = [r["metrics"][metric] for r in self.query(fingerprint)
+                if r.get("run_id") != exclude_run
+                and metric in r.get("metrics", {})]
+        if not vals:
+            return None
+        vals.sort(reverse=not lower_is_better(metric))
+        return float(statistics.median(vals[:max(1, best_n)]))
+
+    def baseline_loglik(self, fingerprint: str, *,
+                        exclude_run: Optional[str] = None
+                        ) -> Optional[float]:
+        lls = [r["loglik"] for r in self.query(fingerprint)
+               if r.get("run_id") != exclude_run and "loglik" in r]
+        return float(statistics.median(lls)) if lls else None
+
+
+# -- importer: seed the registry from the checked-in bench artifacts ------
+
+_DEVICE_RE = re.compile(r"JAX device: ([^\n]+)")
+
+
+def _device_from_tail(tail: str) -> Optional[str]:
+    m = _DEVICE_RE.search(tail or "")
+    return m.group(1).strip() if m else None
+
+
+_BENCH_NUMERIC_KEYS = (
+    "value", "vs_baseline", "iters_per_sec_with_dispatch",
+    "dispatch_ms_per_program", "n_iters_fused", "loglik_rel_err_iter3",
+    "loglik_rel_err_iter50", "speedup_vs_looped",
+)
+
+
+def record_from_bench_json(parsed: Dict[str, Any], *,
+                           device: Optional[str] = None,
+                           source: str = "live",
+                           t_unix: Optional[float] = None,
+                           kind: str = "bench",
+                           root: Optional[str] = None) -> Dict[str, Any]:
+    """Adapt one ``bench.py``-style JSON line into a RunRecord."""
+    metric = parsed.get("metric") or "bench"
+    metrics: Dict[str, Any] = {}
+    if _num(parsed.get("value")) is not None:
+        metrics[metric] = parsed["value"]
+    for k in _BENCH_NUMERIC_KEYS[1:]:
+        if _num(parsed.get(k)) is not None:
+            metrics[k] = parsed[k]
+    config = {"bench": kind.replace("bench_", "") if kind != "bench"
+              else "headline",
+              "metric": metric, "device": device_kind(device)}
+    loglik = parsed.get("loglik_tpu_iter50", parsed.get("loglik"))
+    return make_record(
+        kind, config, metrics, device=device, loglik=loglik,
+        dispatches=parsed.get("dispatches"),
+        recompiles=parsed.get("recompiles"), source=source,
+        t_unix=t_unix, run_id=parsed.get("run_id"), root=root)
+
+
+_ALL_METRIC_KEYS = ("em_iters_per_sec", "em_iters_per_sec_sustained",
+                    "vs_cpu", "vs_cpu_sustained", "total_secs")
+
+
+def record_from_bench_all_entry(name: str, res: Dict[str, Any], *,
+                                device: Optional[str] = None,
+                                source: str = "live",
+                                t_unix: Optional[float] = None,
+                                root: Optional[str] = None
+                                ) -> Optional[Dict[str, Any]]:
+    """Adapt one ``bench.all`` results entry into a RunRecord (None when
+    the entry errored or carries no numeric metric)."""
+    if not isinstance(res, dict) or res.get("error"):
+        return None
+    metrics = {k: res[k] for k in _ALL_METRIC_KEYS
+               if _num(res.get(k)) is not None}
+    if not metrics:
+        return None
+    config = {"bench": "all", "config": res.get("config", name),
+              "backend": res.get("backend"),
+              "N": res.get("N"), "T": res.get("T"),
+              "k": res.get("k"), "device": device_kind(device)}
+    return make_record("bench_all", config, metrics, device=device,
+                       loglik=res.get("loglik"), source=source,
+                       t_unix=t_unix, root=root)
+
+
+def backfill(root: str = ".", store: Optional[RunStore] = None,
+             runs: Optional[str] = None) -> int:
+    """Import ``BENCH_r*.json`` + ``BENCH_ALL.json`` under ``root`` into
+    the registry.  Idempotent: records whose ``source`` is already present
+    are skipped.  Returns the number of records appended."""
+    store = store or RunStore(runs or runs_dir() or DEFAULT_DIR)
+    existing = store.sources()
+    n = 0
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        src = os.path.basename(path)
+        if src in existing:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print("warning: backfill: %s: %s" % (path, e), file=sys.stderr)
+            continue
+        parsed = data.get("parsed") or {}
+        if _num(parsed.get("value")) is None:
+            continue
+        rec = record_from_bench_json(
+            parsed, device=_device_from_tail(data.get("tail", "")),
+            source=src, t_unix=os.path.getmtime(path), root=root)
+        store.append(rec)
+        n += 1
+    path = os.path.join(root, "BENCH_ALL.json")
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print("warning: backfill: %s: %s" % (path, e), file=sys.stderr)
+            data = {}
+        device = data.get("device")
+        for name, res in (data.get("results") or {}).items():
+            src = "BENCH_ALL.json#%s" % name
+            if src in existing:
+                continue
+            rec = record_from_bench_all_entry(
+                name, res, device=device, source=src,
+                t_unix=data.get("recorded_unix"), root=root)
+            if rec is None:
+                continue
+            store.append(rec)
+            n += 1
+    return n
+
+
+# -- CLI ------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m dfm_tpu.obs.store",
+        description="Perf-observatory run registry (jax-free).")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    bf = sub.add_parser("backfill",
+                        help="import BENCH_r*.json + BENCH_ALL.json")
+    bf.add_argument("--root", default=".")
+    bf.add_argument("--runs", default=None)
+    ls = sub.add_parser("list", help="list recorded runs")
+    ls.add_argument("--runs", default=None)
+    ls.add_argument("--json", action="store_true")
+    a = ap.parse_args(argv)
+    d = runs_dir(a.runs)
+    if d is None:
+        print("error: no runs dir (DFM_RUNS is disabled)", file=sys.stderr)
+        return 2
+    store = RunStore(d)
+    if a.cmd == "backfill":
+        n = backfill(a.root, store=store)
+        print("backfilled %d record(s) into %s" % (n, store.file))
+        return 0
+    recs = store.load()
+    if a.json:
+        print(json.dumps(recs))
+        return 0
+    if not recs:
+        print("no runs recorded in %s" % store.file)
+        return 0
+    for r in recs:
+        top = sorted(r.get("metrics", {}).items())[:3]
+        mt = " ".join("%s=%.4g" % kv for kv in top)
+        print("%-24s %-10s %-28s %s" % (
+            r.get("run_id", "?"), r.get("kind", "?"),
+            (r.get("fingerprint") or "")[:28], mt))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:      # `... list | head` must exit quietly
+        raise SystemExit(0)

@@ -71,12 +71,33 @@ def _sharded_chunk_impl(Y, carry, tol, noise_floor, cfg, n_iters, mesh):
     )(Y, carry, tol, noise_floor)
 
 
+@partial(jax.jit, static_argnames=("cfg", "n_iters", "mesh"))
+def _sharded_chunk_metrics_impl(Y, carry, tol, noise_floor, cfg, n_iters,
+                                mesh):
+    """Metrics twin of ``_sharded_chunk_impl``: the chunk core with its
+    per-iteration (B, 3) metrics block scanned out.  Both scan outputs are
+    time-major with the batch on axis 1, hence the P(None, "batch") specs;
+    still no collectives (the per-problem max param-update is local to each
+    problem's shard)."""
+    Pb = P(BATCH_AXIS)
+    body = lambda Yb, c, t, nf: _em_chunk_core(Yb, c, t, nf, cfg, n_iters,
+                                               with_metrics=True)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P()),
+        out_specs=((Pb, Pb, Pb, Pb, Pb),
+                   (P(None, BATCH_AXIS), P(None, BATCH_AXIS))),
+    )(Y, carry, tol, noise_floor)
+
+
 def run_batched_em_sharded(Y, p0, cfg, max_iters: int, tol: float,
                            fused_chunk: int = 8,
-                           n_devices: Optional[int] = None, policy=None):
+                           n_devices: Optional[int] = None, policy=None,
+                           with_metrics: bool = False):
     """Sharded batched-EM driver: same contract as ``run_batched_em``
-    (params, per-problem traces, converged, p_iters, healths), with the
-    batch axis laid across the mesh so B also scales across chips."""
+    (params, per-problem traces, converged, p_iters, healths — plus the
+    metrics block when ``with_metrics``), with the batch axis laid across
+    the mesh so B also scales across chips."""
     mesh = make_batch_mesh(n_devices)
     D = mesh.devices.size
     B = Y.shape[0]
@@ -84,20 +105,32 @@ def run_batched_em_sharded(Y, p0, cfg, max_iters: int, tol: float,
     state0 = np.concatenate([np.zeros(B, np.int32),
                              np.full(n_pad, PADDED, np.int32)])
     impl = partial(_sharded_chunk_impl, mesh=mesh)
+    impl_m = partial(_sharded_chunk_metrics_impl, mesh=mesh)
     # Telemetry identity for the shared driver's dispatch spans: the
     # sharded twin is a DIFFERENT logical program (its own compile cache
     # entry per device count), so it gets its own name and a key carrying
     # the mesh size.
-    impl.trace_name = "sharded_batched_em_chunk"
-    impl.trace_key = f"mesh{D}"
-    impl.trace_engine = "sharded_batched_em"
-    p, lls_list, conv, p_iters, healths = run_batched_em(
+    for f in (impl, impl_m):
+        f.trace_name = "sharded_batched_em_chunk"
+        f.trace_key = f"mesh{D}"
+        f.trace_engine = "sharded_batched_em"
+    out = run_batched_em(
         Yp, pp, cfg, max_iters, tol, fused_chunk=fused_chunk, policy=policy,
-        scan_impl=impl, state0=state0)
+        scan_impl=impl, state0=state0, with_metrics=with_metrics,
+        scan_impl_metrics=impl_m)
+    if with_metrics:
+        p, lls_list, conv, p_iters, healths, metrics = out
+    else:
+        p, lls_list, conv, p_iters, healths = out
+        metrics = None
     if n_pad:
         p = jax.tree_util.tree_map(lambda x: x[:B], p)
         lls_list, conv = lls_list[:B], conv[:B]
         p_iters, healths = p_iters[:B], healths[:B]
+        if metrics is not None:
+            metrics = metrics[:, :B]
+    if with_metrics:
+        return p, lls_list, conv, p_iters, healths, metrics
     return p, lls_list, conv, p_iters, healths
 
 

@@ -206,6 +206,53 @@ def _sharded_em_scan_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
     return mapped(Y, mask, gate, p)
 
 
+@partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask", "has_gate",
+                                   "n_iters"))
+def _sharded_em_scan_metrics_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
+                                  cfg: EMConfig, has_mask: bool,
+                                  has_gate: bool, n_iters: int):
+    """Metrics twin of ``_sharded_em_scan_impl``: same fused chunk plus a
+    per-iteration (n, 3) [loglik, delta, max param-update] block (the
+    sharded analog of ``estim.em._em_scan_core_metrics``).  Lam/R rows are
+    shard-local, so the update norm is a local max + ``pmax`` over the mesh
+    axis (one extra k-free collective per iteration).  Kept as a separate
+    program so the default chunk stays byte-identical to the metrics-free
+    path."""
+    def body(Y_s, mask_s, gate_s, p_s):
+        m = mask_s if has_mask else None
+        g = gate_s if has_gate else None
+        sumsq_s = None if has_mask else Y_s * Y_s
+        Ysq_s = None if has_mask else jnp.sum(sumsq_s, axis=0)
+
+        def it(carry, _):
+            p_c, ll_prev = carry
+            p_new, ll, delta = _shard_em_step(Y_s, m, p_c, cfg, g, Ysq_s,
+                                              sumsq_s)
+            leaves = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda a, b: jnp.max(jnp.abs(a - b)), p_new, p_c))
+            dparam = lax.pmax(jnp.max(jnp.stack(leaves)), SERIES_AXIS)
+            ll64 = jnp.asarray(ll, jnp.float64)
+            row = jnp.stack([ll64, ll64 - ll_prev,
+                             jnp.asarray(dparam, jnp.float64)])
+            return (p_new, ll64), (ll, delta, row)
+
+        ll0 = jnp.asarray(jnp.nan, jnp.float64)
+        (p_f, _), (lls, deltas, metrics) = lax.scan(
+            it, (p_s, ll0), None, length=n_iters)
+        return p_f, lls, deltas, metrics
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS),
+                  P(SERIES_AXIS), _param_specs()),
+        out_specs=(_param_specs(), P(), P(), P()))
+    if mask is None:
+        mask = jnp.ones_like(Y)
+    if gate is None:
+        gate = jnp.ones((Y.shape[1],), Y.dtype)
+    return mapped(Y, mask, gate, p)
+
+
 @partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask", "has_gate"))
 def _sharded_em_step_checked_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
                                   cfg: EMConfig, has_mask: bool,
@@ -340,28 +387,33 @@ class ShardedEM:
             self.p, ll, self.last_delta = _sharded_em_step_impl(*args)
         return ll
 
-    def run_scan(self, p: SSMParams, n_iters: int):
+    def run_scan(self, p: SSMParams, n_iters: int, with_metrics: bool = False):
         """n fused EM iterations from ``p`` (does NOT update ``self.p``).
 
         Returns (params, logliks (n,), ss_deltas (n,)) — the sharded analog
         of ``estim.em.em_fit_scan``, one XLA dispatch total.  With
         ``cfg.debug`` the whole fused chunk is checkified.
+        ``with_metrics`` appends a per-iteration (n, 3) metrics block
+        (loglik, delta, max param-update) via the metrics twin program;
+        the debug path has no metrics twin and returns ``None`` for it.
         """
         args = (self.Y, self.mask, self.gate, p, self.mesh, self.cfg,
                 self.has_mask, self.has_gate, n_iters)
         if self.cfg.debug:
             err, out = _sharded_em_scan_checked_impl(*args)
             err.throw()
-            return out
+            return out + (None,) if with_metrics else out
+        impl = (_sharded_em_scan_metrics_impl if with_metrics
+                else _sharded_em_scan_impl)
         tr = current_tracer()
         if tr is None:
-            return _sharded_em_scan_impl(*args)
+            return impl(*args)
         # Suppressed when a chunk driver's barrier'd span is already open;
         # direct callers (dryrun) get the async-dispatch record.
         with tr.dispatch("sharded_em_chunk",
                          shape_key(self._trace_key(), f"iters{n_iters}"),
                          n_iters=n_iters):
-            return _sharded_em_scan_impl(*args)
+            return impl(*args)
 
     def _trace_key(self) -> str:
         return shape_key(self.Y, self.cfg.filter,

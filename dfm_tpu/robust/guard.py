@@ -180,8 +180,10 @@ def repair_params(p_np: SSMParams, r_floor: float = 1e-6,
 def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                            noise_floor: float, callback=None,
                            fused_chunk: int = 8, ss_tau=None,
-                           monitor: ChunkMonitor = None):
-    """Monitored twin of ``estim.em.run_em_chunked`` (same return tuple)."""
+                           monitor: ChunkMonitor = None, progress=None):
+    """Monitored twin of ``estim.em.run_em_chunked`` (same return tuple,
+    same optional 4-element scan_fn metrics contract and per-chunk
+    ``progress`` hook)."""
     from ..estim.em import em_progress, warn_ss_delta
     from ..obs.trace import current_tracer, shape_key
 
@@ -211,6 +213,7 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
     chunk_idx = 0
     stall_run = 0
     done_actions: set = set()
+    t0 = time.perf_counter()
 
     def _fail(msg: str, cause=None):
         try:
@@ -238,13 +241,20 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         """
         delay = policy.backoff_base
         attempt = 0
+
+        def _pull(out):
+            p_out, chunk = out[0], np.asarray(out[1], np.float64)
+            deltas = out[2]
+            if deltas is not None:
+                deltas = np.asarray(deltas, np.float64)
+            metrics = (np.asarray(out[3], np.float64)
+                       if len(out) > 3 and out[3] is not None else None)
+            return p_out, chunk, deltas, metrics
+
         while True:
             try:
                 if tr is None:
-                    p_out, chunk, deltas = fn(p_in, n)
-                    chunk = np.asarray(chunk, np.float64)
-                    if deltas is not None:
-                        deltas = np.asarray(deltas, np.float64)
+                    p_out, chunk, deltas, metrics = _pull(fn(p_in, n))
                 else:
                     # Failed attempts each leave a dispatch event with an
                     # ``error`` field; the transfers inside the span make
@@ -254,11 +264,8 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                             shape_key(getattr(fn, "trace_key", prog_key),
                                       f"iters{n}"),
                             barrier=True, n_iters=n, attempt=attempt):
-                        p_out, chunk, deltas = fn(p_in, n)
-                        chunk = np.asarray(chunk, np.float64)
-                        if deltas is not None:
-                            deltas = np.asarray(deltas, np.float64)
-                return p_out, chunk, deltas
+                        p_out, chunk, deltas, metrics = _pull(fn(p_in, n))
+                return p_out, chunk, deltas, metrics
             except policy.retry_exceptions as e:
                 if isinstance(e, GuardFailure):
                     raise
@@ -307,10 +314,10 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
 
     while it < max_iters and not stop:
         n = min(fused_chunk, max_iters - it)
-        chunk = deltas = None
+        chunk = deltas = metrics = None
         p_try = None
         for attempt in range(policy.chunk_retries + 1):
-            p_try, chunk, deltas = _dispatch(scan_fn, p, n)
+            p_try, chunk, deltas, metrics = _dispatch(scan_fn, p, n)
             if np.all(np.isfinite(chunk)):
                 break
             if not policy.recover_divergence:
@@ -330,7 +337,7 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                 if not _apply_rebuild("loglik_f64", ev):
                     _fail("non-finite logliks persisted through "
                           f"{policy.chunk_retries} chunk retries")
-                p_try, chunk, deltas = _dispatch(scan_fn, p, n)
+                p_try, chunk, deltas, metrics = _dispatch(scan_fn, p, n)
                 if not np.all(np.isfinite(chunk)):
                     _fail("non-finite logliks survived f64 escalation")
                 break
@@ -350,12 +357,15 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                 * (10.0 ** attempt)))
         if tr is not None and chunk is not None:
             drops = np.diff(chunk)
+            extra = ({"dparams": [float(x) for x in metrics[:, 2]]}
+                     if metrics is not None else {})
             tr.emit("chunk", engine=engine, iter0=it, n=int(n),
                     lls=[float(x) for x in chunk],
                     noise_floor=float(noise_floor),
                     max_drop=float(-drops.min()) if drops.size else 0.0,
                     below_floor=bool(drops.size == 0
-                                     or np.abs(drops).max() < noise_floor))
+                                     or np.abs(drops).max() < noise_floor),
+                    **extra)
         p_entry_prev, entry_it_prev = p_entry, entry_it
         p_entry, entry_it = p, it
         p = p_try
@@ -411,6 +421,25 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         it += n
         health.n_chunks += 1
         chunk_idx += 1
+        if progress is not None:
+            # Same per-chunk live-progress contract as the unguarded
+            # driver (see run_em_chunked): fires after the stopping rule,
+            # with the amortized-wall ETA over the remaining budget.
+            iters_done = entry_it + consumed
+            elapsed = time.perf_counter() - t0
+            left = 0 if stop else max_iters - it
+            progress({"chunk": chunk_idx - 1, "iter": int(iters_done),
+                      "total": int(max_iters), "loglik": lls[-1],
+                      "delta": (lls[-1] - lls[-2]) if len(lls) > 1
+                      else None,
+                      "dparam": (float(metrics[consumed - 1, 2])
+                                 if metrics is not None and consumed
+                                 else None),
+                      "elapsed_s": elapsed,
+                      "eta_s": ((elapsed / iters_done) * left
+                                if iters_done else None),
+                      "metrics": metrics, "stopped": bool(stop),
+                      "converged": bool(converged)})
         if stop:
             break
         # Freeze drift: correct, don't just warn (ADVICE #2).

@@ -98,11 +98,12 @@ def test_batched_bench_prints_one_json_line(tmp_path):
     import subprocess
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     trace = tmp_path / "bench_batched.jsonl"
+    runs = tmp_path / "runs"
     env = _driver_env()
     env.update({"JAX_PLATFORMS": "cpu", "DFM_BENCH_B": "1,2",
                 "DFM_BENCH_N": "10", "DFM_BENCH_T": "30",
                 "DFM_BENCH_K": "2", "DFM_BENCH_ITERS": "3",
-                "DFM_TRACE": str(trace)})
+                "DFM_TRACE": str(trace), "DFM_RUNS": str(runs)})
     proc = subprocess.run(
         [sys.executable, "-m", "bench.batched"], cwd=repo, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -122,6 +123,12 @@ def test_batched_bench_prints_one_json_line(tmp_path):
               trace.read_text().splitlines() if ln.strip()]
     n_disp = sum(1 for e in events if e.get("kind") == "dispatch")
     assert n_disp == out["dispatches"]
+    # Perf-observatory contract (ISSUE 4): the line carries a run_id and
+    # the run landed in the DFM_RUNS registry under that id.
+    from dfm_tpu.obs.store import RunStore
+    (rec,) = RunStore(str(runs)).load()
+    assert rec["run_id"] == out["run_id"]
+    assert rec["metrics"][out["metric"]] == out["value"]
 
 
 def test_headline_bench_prints_one_json_line_with_telemetry(tmp_path):
@@ -132,11 +139,13 @@ def test_headline_bench_prints_one_json_line_with_telemetry(tmp_path):
     import subprocess
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     trace = tmp_path / "bench_headline.jsonl"
+    runs = tmp_path / "runs"
     env = _driver_env()
     env.update({"JAX_PLATFORMS": "cpu", "DFM_BENCH_N": "20",
                 "DFM_BENCH_T": "30", "DFM_BENCH_K": "2",
                 "DFM_BENCH_ITERS": "3", "DFM_BENCH_CPU_TIMING_ITERS": "1",
-                "DFM_BENCH_CPU_CHECK_ITERS": "3", "DFM_TRACE": str(trace)})
+                "DFM_BENCH_CPU_CHECK_ITERS": "3", "DFM_TRACE": str(trace),
+                "DFM_RUNS": str(runs)})
     proc = subprocess.run(
         [sys.executable, "bench.py"], cwd=repo, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -154,6 +163,17 @@ def test_headline_bench_prints_one_json_line_with_telemetry(tmp_path):
               trace.read_text().splitlines() if ln.strip()]
     n_disp = sum(1 for e in events if e.get("kind") == "dispatch")
     assert n_disp == out["dispatches"]
+    # run_id + registry append (ISSUE 4), and the recorded run passes the
+    # regression gate against itself-in-history trivially (nothing gated
+    # on the first same-fingerprint run).
+    from dfm_tpu.obs.store import RunStore
+    (rec,) = RunStore(str(runs)).load()
+    assert rec["run_id"] == out["run_id"]
+    gate = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.regress", out["run_id"]],
+        cwd=repo, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, timeout=120)
+    assert gate.returncode == 0, gate.stdout + gate.stderr
 
 
 def test_dryrun_multichip_driver_context():

@@ -1,0 +1,448 @@
+"""Perf observatory (ISSUE 4): run registry (``obs.store``), cross-run
+regression gate (``obs.regress`` / ``report --diff``), device-side
+per-iteration metrics, and the ``fit(progress=...)`` live hook — on the
+fake 8-device mesh (conftest).
+
+The operative acceptance checks: ``obs.regress`` detects an injected 2x
+per-iter slowdown against stored history and exits nonzero; with metrics
+and progress disabled, fit results are bit-identical to the PR 3 path and
+the chunk-program dispatch count is unchanged (asserted via the tracer).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dfm_tpu.api import DynamicFactorModel, ShardedBackend, TPUBackend, fit
+from dfm_tpu.obs import Tracer, activate
+from dfm_tpu.obs import regress as obs_regress
+from dfm_tpu.obs import store as obs_store
+from dfm_tpu.utils import dgp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(7)
+    p = dgp.dfm_params(16, 2, rng)
+    Y, _ = dgp.simulate(p, 40, rng)
+    return (Y - Y.mean(0)) / Y.std(0)
+
+
+def _fit(Y, **kw):
+    kw.setdefault("max_iters", 12)
+    kw.setdefault("tol", 1e-8)
+    return fit(DynamicFactorModel(n_factors=2), Y,
+               backend=TPUBackend(dtype=jnp.float64, filter="info"), **kw)
+
+
+def _bench_record(run_id, value, *, metric="em_iters_per_sec_s1",
+                  loglik=None, t_unix=None):
+    return obs_store.make_record(
+        "bench", {"bench": "headline", "metric": metric, "device": "cpu"},
+        {metric: value}, loglik=loglik, run_id=run_id, t_unix=t_unix)
+
+
+# -- store: round-trip, baselines, damage tolerance ----------------------
+
+def test_store_roundtrip_and_query(tmp_path):
+    store = obs_store.RunStore(str(tmp_path / "runs"))
+    r1 = _bench_record("a", 100.0, t_unix=1.0)
+    r2 = _bench_record("b", 120.0, t_unix=2.0)
+    other = obs_store.make_record("fit", {"fit": "DFM"}, {"wall_s": 3.0},
+                                  run_id="c", t_unix=3.0)
+    for r in (r1, r2, other):
+        store.append(r)
+    recs = store.load()
+    assert [r["run_id"] for r in recs] == ["a", "b", "c"]
+    assert store.get("b")["metrics"]["em_iters_per_sec_s1"] == 120.0
+    assert store.get("nope") is None
+    fp = r1["fingerprint"]
+    assert fp == r2["fingerprint"] != other["fingerprint"]
+    assert [r["run_id"] for r in store.query(fp)] == ["a", "b"]
+    assert store.latest()["run_id"] == "c"
+    assert store.latest(kind="bench")["run_id"] == "b"
+
+
+def test_store_skips_corrupt_lines(tmp_path, capsys):
+    store = obs_store.RunStore(str(tmp_path))
+    store.append(_bench_record("a", 1.0))
+    with open(store.file, "a") as f:
+        f.write('{"run_id": "tr'          # killed mid-append
+                '\nnot json at all\n[1, 2]\n')
+    store.append(_bench_record("b", 2.0))
+    recs = store.load()
+    assert [r["run_id"] for r in recs] == ["a", "b"]
+    assert "corrupt record skipped" in capsys.readouterr().err
+
+
+def test_baseline_is_median_of_best_n(tmp_path):
+    store = obs_store.RunStore(str(tmp_path))
+    for i, v in enumerate([100.0, 200.0, 300.0, 400.0, 500.0, 600.0]):
+        store.append(_bench_record(f"r{i}", v, t_unix=float(i)))
+    fp = store.load()[0]["fingerprint"]
+    # throughput: best 3 = [600, 500, 400] -> median 500
+    assert store.baseline(fp, "em_iters_per_sec_s1", best_n=3) == 500.0
+    # exclude_run drops the candidate itself from its own baseline
+    assert store.baseline(fp, "em_iters_per_sec_s1", best_n=3,
+                          exclude_run="r5") == 400.0
+    assert store.baseline(fp, "missing_metric") is None
+    # a wall-clock metric picks the SMALLEST values as "best"
+    for i, v in enumerate([9.0, 5.0, 7.0]):
+        store.append(obs_store.make_record(
+            "bench", {"metric": "wall"}, {"wall_s": v}, run_id=f"w{i}"))
+    fpw = obs_store.fingerprint({"metric": "wall"})
+    assert store.baseline(fpw, "wall_s", best_n=3) == 7.0
+
+
+def test_metric_direction_markers():
+    assert obs_store.lower_is_better("amortized_ms_per_iter")
+    assert obs_store.lower_is_better("wall_s")
+    assert obs_store.lower_is_better("loglik_rel_err_iter3")
+    assert not obs_store.lower_is_better("em_iters_per_sec_sustained")
+    assert not obs_store.lower_is_better("vs_baseline")
+
+
+# -- backfill importer on the checked-in artifacts ------------------------
+
+def test_backfill_checked_in_artifacts(tmp_path):
+    store = obs_store.RunStore(str(tmp_path))
+    n = obs_store.backfill(REPO, store=store)
+    recs = store.load()
+    assert n == len(recs) >= 5          # 5 BENCH_r rounds + BENCH_ALL
+    kinds = {r["kind"] for r in recs}
+    assert "bench" in kinds and "bench_all" in kinds
+    # the bench records carry the real device string + a numeric metric
+    bench = [r for r in recs if r["kind"] == "bench"]
+    assert any(r["device"] and "TPU" in r["device"] for r in bench)
+    for r in recs:
+        assert r["metrics"], r["run_id"]
+        assert r["fingerprint"]
+    # idempotent: a second import appends nothing
+    assert obs_store.backfill(REPO, store=store) == 0
+    assert len(store.load()) == len(recs)
+
+
+def test_store_cli_backfill_and_list(tmp_path):
+    env = dict(os.environ, DFM_RUNS=str(tmp_path))
+    out = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.store", "backfill",
+         "--root", REPO], capture_output=True, text=True, timeout=120,
+        cwd=REPO, env=env)
+    assert out.returncode == 0, out.stderr
+    assert "backfilled" in out.stdout
+    ls = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.store", "list", "--json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env)
+    assert ls.returncode == 0, ls.stderr
+    assert len(json.loads(ls.stdout)) >= 5
+
+
+# -- regress: the 2x-slowdown gate (acceptance criterion) -----------------
+
+def _seed_history(runs, *, n=3):
+    store = obs_store.RunStore(str(runs))
+    for i in range(n):
+        store.append(_bench_record(f"h{i}", 1000.0 + i, loglik=-500.0,
+                                   t_unix=float(i)))
+    return store
+
+
+def _regress(args, runs):
+    return subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.regress", *args],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=dict(os.environ, DFM_RUNS=str(runs)))
+
+
+def test_regress_detects_2x_slowdown(tmp_path):
+    store = _seed_history(tmp_path)
+    store.append(_bench_record("cand", 500.0, loglik=-500.0))  # 2x slower
+    out = _regress(["cand", "--json"], tmp_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    d = json.loads(out.stdout)
+    assert not d["ok"]
+    (chk,) = [c for c in d["checks"]
+              if c["metric"] == "em_iters_per_sec_s1"]
+    assert not chk["ok"] and chk["ratio"] < 0.6
+
+
+def test_regress_ok_within_tolerance(tmp_path):
+    store = _seed_history(tmp_path)
+    store.append(_bench_record("cand", 950.0, loglik=-500.0))
+    out = _regress(["cand"], tmp_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "regress: OK" in out.stdout
+
+
+def test_regress_convergence_gate(tmp_path):
+    store = _seed_history(tmp_path)
+    # perf fine, but the final loglik fell: convergence regression
+    store.append(_bench_record("cand", 1100.0, loglik=-520.0))
+    out = _regress(["cand"], tmp_path)
+    assert out.returncode == 1, out.stdout
+    assert "REGRESSION" in out.stdout and "final loglik" in out.stdout
+
+
+def test_regress_against_explicit_file(tmp_path):
+    base = _bench_record("base", 1000.0)
+    cand = _bench_record("cand", 490.0)
+    bp, cp = tmp_path / "base.json", tmp_path / "cand.json"
+    bp.write_text(json.dumps(base))
+    cp.write_text(json.dumps(cand))
+    out = _regress([str(cp), "--against", str(bp)], tmp_path / "empty")
+    assert out.returncode == 1, out.stdout + out.stderr
+    # and the pure-API path agrees
+    d = obs_regress.diff_records(cand, base)
+    assert not d["ok"]
+    d2 = obs_regress.diff_records(base, base)
+    assert d2["ok"]
+
+
+def test_regress_usage_errors(tmp_path):
+    out = _regress(["no-such-run"], tmp_path)     # empty registry
+    assert out.returncode == 2
+    store = _seed_history(tmp_path)
+    assert _regress(["still-missing"], tmp_path).returncode == 2
+    # the latest run IS gated by default (no candidate argument)
+    store.append(_bench_record("slow", 400.0))
+    assert _regress([], tmp_path).returncode == 1
+
+
+def test_regress_sub_noise_floor():
+    # A lower-is-better metric with a TINY baseline must not flag on
+    # absolute moves below its unit floor: a 0.6 -> 1.3 ms dispatch cost
+    # (CPU-fallback jitter) is out of the 30% band but carries no signal,
+    # while the same ratio at tunnel scale (60 -> 130 ms) is real.
+    def rec(rid, ms):
+        return obs_store.make_record(
+            "bench", {"bench": "h", "metric": "m", "device": "cpu"},
+            {"dispatch_ms_per_program": ms}, run_id=rid)
+    d = obs_regress.diff_records(rec("cand", 1.3), rec("base", 0.6))
+    assert d["ok"]
+    (chk,) = d["checks"]
+    assert chk["sub_noise"] and chk["ratio"] > 2.0
+    d2 = obs_regress.diff_records(rec("cand", 130.0), rec("base", 60.0))
+    assert not d2["ok"]
+    # seconds floor: 1.888 -> 3.776 s is far above 50 ms and still gates
+    def recs(rid, s):
+        return obs_store.make_record(
+            "bench", {"bench": "h", "metric": "m", "device": "cpu"},
+            {"wall_s": s}, run_id=rid)
+    assert not obs_regress.diff_records(recs("c", 3.776),
+                                        recs("b", 1.888))["ok"]
+
+
+def test_regress_reads_bench_r_wrapper(tmp_path):
+    # a checked-in BENCH_r*.json wrapper is a valid --against baseline
+    out = _regress(
+        [os.path.join(REPO, "BENCH_r01.json"),
+         "--against", os.path.join(REPO, "BENCH_r01.json")],
+        tmp_path / "empty")
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# -- report: damage tolerance + --diff ------------------------------------
+
+def test_report_tolerates_truncated_trace(panel, tmp_path):
+    trace = tmp_path / "t.jsonl"
+    _fit(panel, telemetry=str(trace))
+    whole = trace.read_text()
+    cut = tmp_path / "cut.jsonl"
+    # a process killed mid-append leaves a partial last line
+    cut.write_text(whole[: int(len(whole) * 0.6)])
+    out = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.report", str(cut)],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert "skipping invalid JSONL" in out.stderr
+    assert "dispatches:" in out.stdout
+    # empty file: no events, still rc 0
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    out = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.report", str(empty)],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+
+
+def test_report_diff_traces(panel, tmp_path):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _fit(panel, telemetry=str(a))
+    _fit(panel, telemetry=str(b))
+    out = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.report", str(a),
+         "--diff", str(b)],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    # same fit twice: never a CONVERGENCE regression; perf walls may
+    # jitter, so only the exit-code domain is asserted
+    assert out.returncode in (0, 1), out.stderr
+    assert "final loglik" in out.stdout
+    assert "[ok] final loglik" in out.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.report", str(a),
+         "--diff", "/does/not/exist.json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert bad.returncode == 2
+
+
+def test_telemetry_summary_has_wall_and_phases(panel):
+    r = _fit(panel, telemetry=True)
+    s = r.telemetry
+    assert s["wall_s"] > 0
+    ph = s["phases"]
+    assert set(ph) == {"dispatch_s", "transfer_s", "host_s"}
+    assert all(v >= 0 for v in ph.values())
+    assert ph["dispatch_s"] + ph["transfer_s"] <= s["wall_s"] + 1e-9
+
+
+# -- fit(progress=...) + per-iteration metrics ----------------------------
+
+def test_progress_callback_ordering(panel):
+    infos = []
+    r = _fit(panel, max_iters=20, tol=0.0, progress=infos.append)
+    assert len(infos) >= 2                      # 20 iters / chunk 8 -> 3
+    assert [i["chunk"] for i in infos] == list(range(len(infos)))
+    iters = [i["iter"] for i in infos]
+    assert iters == sorted(iters) and iters[-1] == r.n_iters
+    assert infos[-1]["total"] == 20
+    for info in infos:
+        m = info["metrics"]
+        assert m is not None and m.ndim == 2 and m.shape[1] == 3
+        assert np.all(np.isfinite(m[:, 0]))     # loglik column
+        assert info["elapsed_s"] > 0
+    # in-loop metrics agree with the host-side loglik trajectory
+    lls = np.concatenate([i["metrics"][:, 0] for i in infos])[:r.n_iters]
+    np.testing.assert_allclose(lls, r.logliks, rtol=0, atol=0)
+    # final chunk knows it stopped; ETA only meaningful before that
+    assert infos[-1]["stopped"] or iters[-1] == 20
+    assert infos[0]["eta_s"] is None or infos[0]["eta_s"] >= 0
+    assert infos[-1]["dparam"] is not None and infos[-1]["dparam"] >= 0
+
+
+def test_progress_on_sharded_backend(panel):
+    infos = []
+    r = fit(DynamicFactorModel(n_factors=2), panel,
+            backend=ShardedBackend(dtype=jnp.float64, filter="info"),
+            max_iters=12, tol=0.0, progress=infos.append)
+    assert infos and infos[-1]["iter"] == r.n_iters
+    assert infos[0]["metrics"] is not None
+    lls = np.concatenate([i["metrics"][:, 0] for i in infos])[:r.n_iters]
+    np.testing.assert_allclose(lls, r.logliks, rtol=0, atol=0)
+
+
+def test_progress_off_is_bit_identical_same_dispatches(panel):
+    """Acceptance: metrics/progress off -> bit-identical results AND an
+    unchanged chunk-program dispatch count (the tracer is the witness)."""
+    with activate(Tracer()) as tr_off:
+        r_off = _fit(panel, max_iters=16, tol=0.0)
+    infos = []
+    with activate(Tracer()) as tr_on:
+        r_on = _fit(panel, max_iters=16, tol=0.0, progress=infos.append)
+    assert infos, "progress hook never fired"
+    np.testing.assert_array_equal(r_off.logliks, r_on.logliks)
+    np.testing.assert_array_equal(np.asarray(r_off.params.Lam),
+                                  np.asarray(r_on.params.Lam))
+    np.testing.assert_array_equal(np.asarray(r_off.params.A),
+                                  np.asarray(r_on.params.A))
+
+    def chunk_dispatches(tr):
+        return sum(1 for e in tr.events if e["kind"] == "dispatch"
+                   and e["program"] == "em_chunk")
+    assert chunk_dispatches(tr_off) == chunk_dispatches(tr_on) > 0
+    # chunk events carry dparams ONLY when the metrics twin ran
+    assert not any("dparams" in e for e in tr_off.events
+                   if e["kind"] == "chunk")
+    assert all("dparams" in e for e in tr_on.events
+               if e["kind"] == "chunk")
+
+
+def test_progress_dparams_reach_report_curve(panel):
+    tr = Tracer()
+    r = _fit(panel, max_iters=12, tol=0.0, telemetry=tr,
+             progress=lambda i: None)
+    s = tr.summary()
+    conv = s["convergence"]
+    assert len(conv["dparams"]) == r.n_iters
+    assert conv["dparam_last"] == conv["dparams"][-1]
+    assert all(d >= 0 for d in conv["dparams"])
+
+
+def test_progress_warns_on_family_and_cpu(panel):
+    from dfm_tpu.models.tv_loadings import TVLSpec
+    with pytest.warns(RuntimeWarning, match="progress"):
+        fit(TVLSpec(n_factors=2, n_rounds=1), panel,
+            progress=lambda i: None)
+    with pytest.warns(RuntimeWarning, match="progress"):
+        fit(DynamicFactorModel(n_factors=2), panel, backend="cpu",
+            max_iters=2, progress=lambda i: None)
+
+
+def test_batched_metrics_block():
+    from dfm_tpu.estim.batched import DFMBatchSpec, fit_many
+    rng = np.random.default_rng(5)
+    Y = np.stack([rng.standard_normal((50, 10)) for _ in range(3)])
+    model = DynamicFactorModel(n_factors=2, dynamics="ar1")
+    spec = DFMBatchSpec(Y=Y, model=model)
+    r_off = fit_many(spec, max_iters=10, tol=0.0, dtype=np.float64)
+    r_on = fit_many(spec, max_iters=10, tol=0.0, dtype=np.float64,
+                    with_metrics=True)
+    assert r_off.metrics is None
+    assert r_on.metrics.shape == (10, 3, 3)     # (iters, B, 3)
+    np.testing.assert_array_equal(r_off.logliks_final, r_on.logliks_final)
+    # metrics loglik column = the per-problem trajectories
+    for b in range(3):
+        np.testing.assert_allclose(r_on.metrics[: len(r_on.logliks[b]), b, 0],
+                                   r_on.logliks[b], rtol=0, atol=0)
+
+
+def test_sharded_batched_metrics_match_single(monkeypatch):
+    from dfm_tpu.estim.batched import DFMBatchSpec, fit_many
+    rng = np.random.default_rng(6)
+    Y = np.stack([rng.standard_normal((50, 10)) for _ in range(3)])
+    model = DynamicFactorModel(n_factors=2, dynamics="ar1")
+    spec = DFMBatchSpec(Y=Y, model=model)
+    r1 = fit_many(spec, max_iters=8, tol=0.0, dtype=np.float64,
+                  with_metrics=True)
+    r2 = fit_many(spec, backend="sharded", max_iters=8, tol=0.0,
+                  dtype=np.float64, with_metrics=True, n_devices=2)
+    assert r2.metrics.shape == r1.metrics.shape
+    np.testing.assert_allclose(r2.metrics[:, :, 0], r1.metrics[:, :, 0],
+                               rtol=0, atol=0)
+
+
+# -- traced fits append to the registry (DFM_RUNS) ------------------------
+
+def test_traced_fit_appends_run_record(panel, tmp_path, monkeypatch):
+    monkeypatch.setenv("DFM_RUNS", str(tmp_path))
+    r = _fit(panel, telemetry=True)
+    recs = obs_store.RunStore(str(tmp_path)).load()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["kind"] == "fit"
+    assert rec["config"]["T"] == panel.shape[0]
+    assert rec["config"]["N"] == panel.shape[1]
+    assert rec["metrics"]["wall_s"] > 0
+    assert rec["loglik"] == pytest.approx(float(r.logliks[-1]))
+    assert rec["convergence"] == [float(x) for x in r.logliks]
+    assert rec["dispatches"] == r.telemetry["dispatches"]
+
+
+def test_untraced_fit_does_not_append(panel, tmp_path, monkeypatch):
+    monkeypatch.setenv("DFM_RUNS", str(tmp_path))
+    _fit(panel)                                   # no telemetry: no record
+    assert obs_store.RunStore(str(tmp_path)).load() == []
+
+
+def test_traced_fit_without_dfm_runs_does_not_append(panel, monkeypatch,
+                                                     tmp_path):
+    monkeypatch.delenv("DFM_RUNS", raising=False)
+    monkeypatch.chdir(tmp_path)                   # guard the repo root
+    _fit(panel, telemetry=True)
+    assert not os.path.exists(tmp_path / obs_store.DEFAULT_DIR)

@@ -35,6 +35,10 @@ TIME_TIME_ALLOWLIST = {
     # Unix timestamp stamped into the BENCH_ALL.json artifact
     # ("recorded_unix") — a wall-clock *date*, not a duration.
     "bench/all.py": (1, "recorded_unix artifact timestamp"),
+    # Run-registry records carry a wall-clock date (``t_unix``, and the
+    # time prefix of ``new_run_id``) so history sorts across processes;
+    # all durations in a RunRecord come from perf_counter upstream.
+    "dfm_tpu/obs/store.py": (2, "RunRecord t_unix / run_id timestamps"),
 }
 
 

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# One-command perf gate: smoke-size bench -> run-registry append ->
+# cross-run regression check.  Exit 0 when the new run is within tolerance
+# of history, 1 on a perf/convergence regression, 2 on usage errors —
+# wire it straight into CI.
+#
+# Usage (from the repo root):
+#   tools/perf_gate.sh                      # gate vs best-of-history
+#   tools/perf_gate.sh --against <run|file> # gate vs an explicit baseline
+#   DFM_BENCH_N=500 ... tools/perf_gate.sh  # different smoke shape
+#
+# The registry lives in .dfm_runs/ (override with DFM_RUNS).  History is
+# seeded from the checked-in BENCH_r*.json + BENCH_ALL.json on first use;
+# note the gate only compares runs with the SAME config fingerprint (shape,
+# metric, device class), so the smoke-size gate accumulates its own smoke
+# history — the first smoke run records a baseline, later ones are gated.
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUNS="${DFM_RUNS:-.dfm_runs}"
+export DFM_RUNS="$RUNS"
+
+# Seed history from the checked-in bench artifacts (idempotent).
+python -m dfm_tpu.obs.store backfill --runs "$RUNS" >/dev/null
+
+# Smoke-size by default: tiny panel, enough fused iters to get a stable
+# sustained rate without real-device minutes.
+OUT=$(JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" \
+      DFM_BENCH_N="${DFM_BENCH_N:-200}" \
+      DFM_BENCH_T="${DFM_BENCH_T:-100}" \
+      DFM_BENCH_K="${DFM_BENCH_K:-4}" \
+      DFM_BENCH_ITERS="${DFM_BENCH_ITERS:-30}" \
+      DFM_BENCH_CPU_TIMING_ITERS="${DFM_BENCH_CPU_TIMING_ITERS:-2}" \
+      python bench.py)
+echo "$OUT"
+
+RUN_ID=$(printf '%s' "$OUT" | python -c \
+    'import json,sys; print(json.loads(sys.stdin.readline())["run_id"])')
+
+echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
+python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"
