@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -365,6 +366,11 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     cfg = get(args.config)
+    # CLI entry points opt into the persistent compile cache (default
+    # .dfm_cache/; DFM_COMPILE_CACHE overrides, "" disables) — a re-run at
+    # the same shapes skips XLA compiles entirely.
+    from dfm_tpu.pipeline import setup_compile_cache
+    setup_compile_cache()
     Y, mask, _ = make_data(cfg)
     iters = args.iters if args.iters is not None else cfg.em_iters
 
@@ -430,11 +436,28 @@ def main(argv=None):
                   Y, mask=mask, backend=args.backend, max_iters=iters,
                   tol=args.tol, callback=cb)
         wall_cold = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        fit(DynamicFactorModel(n_factors=cfg.k, dynamics=cfg.dynamics),
-            Y, mask=mask, backend=args.backend, max_iters=iters,
-            tol=args.tol)
-        wall_warm = time.perf_counter() - t0
+        # Warm pass through the pipelined dispatch driver (depth 2): the
+        # chunk programs are hot, speculative issue hides the per-dispatch
+        # tunnel latency, and the telemetry summary reports how many host
+        # barriers the fit actually paid (``blocking_transfers``).
+        # Internal timing probe: keep it out of the run registry — the
+        # bench records its own RunRecord for the config.
+        runs_env = os.environ.pop("DFM_RUNS", None)
+        try:
+            t0 = time.perf_counter()
+            res_w = fit(DynamicFactorModel(n_factors=cfg.k,
+                                           dynamics=cfg.dynamics),
+                        Y, mask=mask, backend=args.backend, max_iters=iters,
+                        tol=args.tol, pipeline=2, telemetry=True)
+            wall_warm = time.perf_counter() - t0
+        finally:
+            if runs_env is not None:
+                os.environ["DFM_RUNS"] = runs_env
+        tele_w = res_w.telemetry or {}
+        extra["e2e_warm_fit_iters_per_sec"] = (
+            float(res_w.n_iters) / wall_warm if wall_warm else None)
+        if tele_w.get("blocking_transfers") is not None:
+            extra["blocking_transfers"] = tele_w["blocking_transfers"]
         res_backend = res.backend
     if cfg.kind != "sv":
         extra.update(accuracy_fields(cfg, res, Y, mask))

@@ -23,6 +23,7 @@ import numpy as np
 
 from .backends import cpu_ref
 from .obs.trace import activate, current_tracer, fit_tracer, shape_key
+from .pipeline import compile_cache_entries, setup_compile_cache
 from .utils.data import Standardizer, build_mask, standardize
 
 __all__ = [
@@ -223,6 +224,10 @@ class TPUBackend(Backend):
         # Transient per-fit live-progress hook (fit(progress=...) sets and
         # restores it); also switches the chunk program to the metrics twin.
         self._progress = None
+        # Transient per-fit dispatch-pipeline config (fit(pipeline=...)
+        # sets and restores it); resolved by estim.em.run_em_chunked —
+        # None keeps the serial chunk driver.
+        self._pipeline = None
         # PCA warm start on device (estim.init) — saves the ~1.2 s host SVD
         # at 10k series.  "auto" (default) switches it on when the panel is
         # large enough that the host SVD dominates the fit's fixed cost
@@ -425,6 +430,27 @@ class TPUBackend(Backend):
                                     shape_key(Yj, cfg.filter))
         scan_fn.trace_engine = getattr(em_fit_scan, "trace_engine", "tpu_em")
 
+        # Bucketed-dispatch seam (PipelineConfig(bucket=True)): a fused-
+        # length program with a traced n_active cap, so tail chunks and
+        # mid-chunk replays reuse the full chunk's ONE executable (see
+        # estim.em._em_scan_core_active).  checkify debug mode has no
+        # bucketed twin — the attr's absence degrades to exact-length
+        # dispatch, which is also what escalation-wrapped scan_fns do.
+        if not cfg.debug:
+            def bucket_call(p, n_active, n_bucket):
+                if with_metrics:
+                    p_new, lls, deltas, metrics = em_fit_scan(
+                        Yj, p, n_bucket, mask=mj, cfg=cfg,
+                        with_metrics=True, n_active=n_active)
+                    return (p_new, lls,
+                            (deltas if cfg.filter == "ss" else None),
+                            metrics)
+                p_new, lls, deltas = em_fit_scan(
+                    Yj, p, n_bucket, mask=mj, cfg=cfg, n_active=n_active)
+                return p_new, lls, (deltas if cfg.filter == "ss" else None)
+
+            scan_fn.bucket_call = bucket_call
+
         monitor = None
         # checkify debug mode is a diagnostic: its located errors must
         # propagate verbatim, not be dispatch-retried (they are
@@ -446,7 +472,8 @@ class TPUBackend(Backend):
             noise_floor_for(Yj.dtype, Yj.size, mult=cfg.noise_floor_mult),
             callback, self.fused_chunk,
             ss_tau=cfg.tau if cfg.filter == "ss" else None,
-            monitor=monitor, progress=progress)
+            monitor=monitor, progress=progress,
+            pipeline=getattr(self, "_pipeline", None))
 
     def smooth(self, Y, mask, params):
         # fit() calls smooth right after run_em with the exact (Y, mask,
@@ -616,8 +643,10 @@ class ShardedBackend(TPUBackend):
             drv = ShardedEM(Y, p0, mask=mask, mesh=self._mesh(),
                             dtype=self._dtype(), cfg=cfg, Y_dev=Y_dev)
 
-            def scan_fn(Yj, p, n, mask=None, cfg=None, with_metrics=False):
-                return drv.run_scan(p, n, with_metrics=with_metrics)
+            def scan_fn(Yj, p, n, mask=None, cfg=None, with_metrics=False,
+                        n_active=None):
+                return drv.run_scan(p, n, with_metrics=with_metrics,
+                                    n_active=n_active)
 
             scan_fn.trace_name = "sharded_em_chunk"
             scan_fn.trace_key = drv._trace_key()
@@ -815,7 +844,8 @@ def fit(model,                     # DynamicFactorModel | family spec
         debug: bool = False,
         robust=None,
         telemetry=None,
-        progress: Optional[Callable] = None):
+        progress: Optional[Callable] = None,
+        pipeline=None):
     """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
 
     ``model`` may also be a family spec — ``MixedFreqSpec``, ``TVLSpec``,
@@ -869,15 +899,38 @@ def fit(model,                     # DynamicFactorModel | family spec
         dispatches; see ``estim.em``).  With ``progress=None`` the
         metrics code never runs and the device program is byte-identical
         to the metrics-free path.
+    pipeline : latency-hiding dispatch pipeline for the fused-chunk JAX
+        backends (see ``dfm_tpu.pipeline``): an int issues that many
+        chunks speculatively before each BLOCKING device->host loglik
+        transfer (``pipeline=2`` halves the per-chunk tunnel round-trips
+        on healthy fits; results stay bit-identical — convergence/health
+        checks just run up to depth-1 chunks behind, rolling back through
+        the drivers' existing chunk-entry replay on a mid-round stop).
+        ``True`` means depth 2; a ``pipeline.PipelineConfig`` additionally
+        opts into tail-chunk bucketing (``bucket=True``) so one fused-
+        length executable serves every chunk length the fit dispatches;
+        ``None``/``False`` keep the serial driver.  CPU oracle fits and
+        the family drivers ignore it.  Independently, when the
+        ``DFM_COMPILE_CACHE`` env var names a directory, compiled XLA
+        executables persist across processes (``fit`` never creates the
+        default ``.dfm_cache/`` on its own — only the bench/entry CLIs
+        do; see ``pipeline.setup_compile_cache``).
     """
     tracer, owned = fit_tracer(telemetry)
+    cache_dir = setup_compile_cache(ambient_only=True)
+    cache_n0 = (compile_cache_entries(cache_dir)
+                if cache_dir is not None and tracer is not None else 0)
     t0 = time.perf_counter()
     try:
         with activate(tracer):
             res = _fit_impl(model, Y, mask, backend, max_iters, tol, init,
                             callback, checkpoint_path, checkpoint_every,
-                            debug, robust, progress)
+                            debug, robust, progress, pipeline)
             if tracer is not None and isinstance(res, FitResult):
+                if cache_dir is not None:
+                    n1 = compile_cache_entries(cache_dir)
+                    tracer.emit("compile_cache", dir=cache_dir, entries=n1,
+                                new_entries=n1 - cache_n0)
                 tracer.emit("fit", t=t0, engine=res.backend,
                             shape=shape_key(Y), n_iters=res.n_iters,
                             converged=bool(res.converged),
@@ -913,6 +966,8 @@ def _maybe_record_fit_run(res: "FitResult", Y, wall: float) -> None:
     if wall > 0:
         metrics["fit_iters_per_sec"] = res.n_iters / wall
     tele = res.telemetry or {}
+    if tele.get("blocking_transfers") is not None:
+        metrics["blocking_transfers"] = tele["blocking_transfers"]
     try:
         RunStore(d).append(make_record(
             "fit", config, metrics, device=dev, loglik=res.loglik,
@@ -927,7 +982,7 @@ def _maybe_record_fit_run(res: "FitResult", Y, wall: float) -> None:
 
 def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
               checkpoint_path, checkpoint_every, debug, robust,
-              progress=None):
+              progress=None, pipeline=None):
     family = _family_fit(model, Y, mask, backend, max_iters, tol, init,
                          callback, checkpoint_path, debug)
     if family is not None:
@@ -1051,6 +1106,13 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             warnings.warn(
                 f"backend {b.name!r} has no per-chunk progress hook; "
                 "ignoring progress=", RuntimeWarning, stacklevel=2)
+    # pipeline rides along for THIS fit only, same transient contract as
+    # debug/robust/progress.  A perf knob with no semantic effect, so
+    # backends without the fused-chunk driver just ignore it silently.
+    restore_pipeline = None
+    if pipeline is not None and hasattr(b, "_pipeline"):
+        restore_pipeline = (b._pipeline,)
+        b._pipeline = pipeline
     restore_gck = None
     if checkpoint_path is not None and hasattr(b, "_guard_checkpoint"):
         # Let the guard save the last GOOD params before declaring failure
@@ -1138,6 +1200,8 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             b.robust = restore_robust[0]
         if restore_progress is not None:
             b._progress = restore_progress[0]
+        if restore_pipeline is not None:
+            b._pipeline = restore_pipeline[0]
         if restore_gck is not None:
             b._guard_checkpoint = restore_gck[0]
     return FitResult(params=params, logliks=np.asarray(lls),

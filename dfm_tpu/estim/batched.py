@@ -318,7 +318,7 @@ def _bmask(m, x):
 
 
 def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int,
-                   with_metrics: bool = False):
+                   with_metrics: bool = False, n_active=None):
     """n fused EM iterations over the batch.  Pure (jit/shard_map-able).
 
     carry = (p, p_prev, ll_prev (B,), state (B,) int32, n_lls (B,) int32):
@@ -333,16 +333,25 @@ def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int,
     ``with_metrics`` (static) additionally scans out a per-iteration
     (B, 3) [loglik, delta, max param-update] block in f64 — a device-side
     convergence record with zero extra dispatches.  The flag only ADDS
-    outputs; the default program's traced ops are untouched."""
+    outputs; the default program's traced ops are untouched.
+
+    ``n_active`` (traced scalar, bucketed mode): iterations at index
+    >= n_active freeze EVERY problem — the same in-carry hold the state
+    machine already performs for converged problems — so a STATIC
+    ``n_iters`` bucket serves every tail-chunk length (the host slices
+    the scanned outputs to the active prefix).  ``None`` (default) leaves
+    the traced program untouched."""
     Ysq = jnp.einsum("btn,btn->bn", Y, Y)           # iteration-invariant
 
-    def body(c, _):
+    def body(c, j):
         p, p_prev, ll_prev, state, n_lls = c
         ll, (xp, Pp, xf, Pf) = _batched_filter(Y, p)
         x_sm, P_sm, P_lag = _batched_rts(xp, Pp, xf, Pf, p.A)
         p_new = batched_m_step(Y, x_sm, P_sm, P_lag, p, cfg, Ysq)
 
         active = state == RUNNING
+        if n_active is not None:
+            active = active & (j < n_active)
         n_new = n_lls + active.astype(n_lls.dtype)
         # em_progress on the device: rel-tol convergence, noise-floor
         # divergence, plateau-drop convergence; <2 lls -> continue.
@@ -383,7 +392,8 @@ def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int,
             return c_out, (ll, row)
         return c_out, ll
 
-    return lax.scan(body, carry, None, length=n_iters)
+    xs = None if n_active is None else jnp.arange(n_iters)
+    return lax.scan(body, carry, xs, length=n_iters)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_iters"))
@@ -395,6 +405,23 @@ def _em_chunk_impl(Y, carry, tol, noise_floor, cfg, n_iters):
 def _em_chunk_metrics_impl(Y, carry, tol, noise_floor, cfg, n_iters):
     return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters,
                           with_metrics=True)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_iters"))
+def _em_chunk_capped_impl(Y, carry, tol, noise_floor, n_active, cfg,
+                          n_iters):
+    """Bucketed chunk: STATIC ``n_iters`` fused length, TRACED ``n_active``
+    cap — one executable serves every tail-chunk length (pipeline
+    bucketing; the default program above stays byte-identical)."""
+    return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters,
+                          n_active=n_active)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_iters"))
+def _em_chunk_capped_metrics_impl(Y, carry, tol, noise_floor, n_active, cfg,
+                                  n_iters):
+    return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters,
+                          with_metrics=True, n_active=n_active)
 
 
 def _smooth_core(Y, p):
@@ -414,7 +441,8 @@ _smooth_impl = jax.jit(_smooth_core)
 def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                    tol: float, fused_chunk: int = 8, policy=None,
                    scan_impl=None, state0=None, with_metrics: bool = False,
-                   scan_impl_metrics=None):
+                   scan_impl_metrics=None, pipeline=None,
+                   scan_impl_capped=None, scan_impl_capped_metrics=None):
     """Chunked host driver around the fused batched-EM program.
 
     ``Y`` (B, T, N) and ``p0`` batched (device or host arrays).  Runs
@@ -427,6 +455,18 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
     ``state0`` overrides the initial per-problem state vector (the sharded
     driver marks its pad problems PADDED so they freeze from the start).
 
+    ``pipeline`` (``pipeline.PipelineConfig`` / int depth / None): depth d
+    issues d chunks speculatively — chaining the DEVICE carries, so no
+    transfer is needed between issues — then performs ONE blocking
+    device->host state/loglik pull per round (the early-exit check runs up
+    to d-1 chunks behind; speculative chunks past an all-frozen state are
+    inert by the in-carry freeze, so results match serial exactly).
+    ``bucket=True`` routes every chunk through the capped twin program
+    (static fused length, traced ``n_active``) so one executable serves
+    every tail length; ``scan_impl_capped`` / ``scan_impl_capped_metrics``
+    override it the way ``scan_impl`` does (bucketing silently degrades
+    when a custom ``scan_impl`` comes without its capped twin).
+
     Returns (params (batched SSMParams), lls_list (per-problem trace
     arrays), converged (B,) bool, p_iters (B,) int, healths (B,) list);
     with ``with_metrics`` a 6th element — the (total_iters, B, 3) f64
@@ -434,6 +474,7 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
     the chunk programs (``scan_impl_metrics`` overrides the metrics twin
     the way ``scan_impl`` overrides the default program).
     """
+    from ..pipeline import resolve_pipeline
     B, T, N = Y.shape
     Yj = jnp.asarray(Y)
     dt = Yj.dtype
@@ -442,8 +483,16 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
     if with_metrics:
         impl = (scan_impl_metrics if scan_impl_metrics is not None
                 else _em_chunk_metrics_impl)
+        impl_c = (scan_impl_capped_metrics
+                  if scan_impl_metrics is not None
+                  else _em_chunk_capped_metrics_impl)
     else:
         impl = scan_impl if scan_impl is not None else _em_chunk_impl
+        impl_c = (scan_impl_capped if scan_impl is not None
+                  else _em_chunk_capped_impl)
+    pipe = resolve_pipeline(pipeline)
+    n_bucket = max(1, int(fused_chunk))
+    use_bucket = pipe.bucket and impl_c is not None
     tol_j = jnp.asarray(tol, acc)
     nf_j = jnp.asarray(nf, acc)
     state = (jnp.zeros((B,), jnp.int32) if state0 is None
@@ -462,35 +511,62 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
     n_chunks = 0
     n_retries = 0
     it = 0
-    while it < max_iters:
-        n = min(max(1, int(fused_chunk)), max_iters - it)
+    retry_exc = policy.retry_exceptions if policy is not None else ()
+
+    def _key(n):
+        return shape_key(Yj, prog_key,
+                         f"iters{n_bucket}b" if use_bucket else f"iters{n}")
+
+    def _payload(n):
+        d = {"n_iters": int(n)}
+        if use_bucket:
+            d["bucket"] = n_bucket
+        return d
+
+    def _call(carry_in, n):
+        if use_bucket:
+            return impl_c(Yj, carry_in, tol_j, nf_j,
+                          jnp.asarray(n, jnp.int32), cfg, n_bucket)
+        return impl(Yj, carry_in, tol_j, nf_j, cfg, n)
+
+    def _pull(new_carry, out, n):
+        lls, mets = out if with_metrics else (out, None)
+        # The small state transfer is the execution barrier on this device
+        # class (block_until_ready is a no-op on axon).
+        state_h = np.asarray(new_carry[3])
+        lls_h = np.asarray(lls, np.float64)[:n]     # bucketed pad sliced off
+        mets_h = (np.asarray(mets, np.float64)[:n]
+                  if mets is not None else None)
+        return state_h, lls_h, mets_h
+
+    def _dispatch_block(carry_in, n, a):
+        if tr is None:
+            new_carry, out = _call(carry_in, n)
+            return (new_carry,) + _pull(new_carry, out, n)
+        with tr.dispatch(prog, _key(n), barrier=True, attempt=a,
+                         **_payload(n)):
+            new_carry, out = _call(carry_in, n)
+            res = _pull(new_carry, out, n)
+        return (new_carry,) + res
+
+    def _attempt_chunk(carry_in, n, pre=None, first_exc=None):
+        """The guard's dispatch retry/backoff seam.  ``pre`` short-circuits
+        attempt 0 with a pipeline-drained result; ``first_exc`` replays an
+        issue/drain-time exception AS attempt 0 so health records and retry
+        counts match the serial driver exactly."""
+        nonlocal n_retries
         attempts = 1 + (policy.dispatch_retries if policy is not None else 0)
         delay = policy.backoff_base if policy is not None else 0.0
         for a in range(attempts):
             try:
-                if tr is None:
-                    new_carry, out = impl(Yj, carry, tol_j, nf_j, cfg, n)
-                    lls, mets = out if with_metrics else (out, None)
-                    # The small state transfer is the execution barrier on
-                    # this device class (block_until_ready is a no-op on
-                    # axon).
-                    state_h = np.asarray(new_carry[3])
-                    lls_h = np.asarray(lls, np.float64)
-                    mets_h = (np.asarray(mets, np.float64)
-                              if mets is not None else None)
-                else:
-                    with tr.dispatch(prog,
-                                     shape_key(Yj, prog_key, f"iters{n}"),
-                                     barrier=True, n_iters=n, attempt=a):
-                        new_carry, out = impl(Yj, carry, tol_j, nf_j, cfg, n)
-                        lls, mets = out if with_metrics else (out, None)
-                        state_h = np.asarray(new_carry[3])
-                        lls_h = np.asarray(lls, np.float64)
-                        mets_h = (np.asarray(mets, np.float64)
-                                  if mets is not None else None)
-                break
-            except (policy.retry_exceptions if policy is not None
-                    else ()) as e:
+                if first_exc is not None:
+                    e, first_exc = first_exc, None
+                    raise e
+                if pre is not None:
+                    res, pre = pre, None
+                    return res
+                return _dispatch_block(carry_in, n, a)
+            except retry_exc as e:
                 last = a == attempts - 1
                 ev = HealthEvent(
                     chunk=n_chunks, iteration=it, kind="dispatch_error",
@@ -509,7 +585,11 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                 n_retries += 1
                 time.sleep(delay)
                 delay *= policy.backoff_factor
-        carry = new_carry
+
+    def _consume(n, new_carry, state_h, lls_h, mets_h):
+        """Host-side bookkeeping for one pulled chunk; True means every
+        problem left RUNNING (early exit)."""
+        nonlocal n_chunks, it, state_prev_h
         traces.append(lls_h)                        # (n, B)
         if mets_h is not None:
             metric_chunks.append(mets_h)            # (n, B, 3)
@@ -533,8 +613,73 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                     converged=int((state_h == CONVERGED).sum()),
                     diverged=int((state_h == DIVERGED).sum()), **extra)
             state_prev_h = state_h
-        if (state_h != RUNNING).all():
-            break
+        return bool((state_h != RUNNING).all())
+
+    if not pipe.active:
+        while it < max_iters:
+            n = min(n_bucket, max_iters - it)
+            new_carry, state_h, lls_h, mets_h = _attempt_chunk(carry, n)
+            carry = new_carry
+            if _consume(n, new_carry, state_h, lls_h, mets_h):
+                break
+    else:
+        def _issue(carry_in, n, k):
+            if tr is None:
+                return _call(carry_in, n)
+            with tr.dispatch(prog, _key(n), queue_depth=k, **_payload(n)):
+                return _call(carry_in, n)
+
+        stop = False
+        while it < max_iters and not stop:
+            # Issue phase: up to depth chunks, chaining DEVICE carries —
+            # no host transfer between issues.
+            flights = []         # [carry_entry, n, new_carry, out, exc, res]
+            carry_i, it_i = carry, it
+            while len(flights) < pipe.depth and it_i < max_iters:
+                n = min(n_bucket, max_iters - it_i)
+                try:
+                    new_c, out = _issue(carry_i, n, len(flights) + 1)
+                except retry_exc as e:
+                    flights.append([carry_i, n, None, None, e, None])
+                    break
+                flights.append([carry_i, n, new_c, out, None, None])
+                carry_i = new_c
+                it_i += n
+            # Drain phase, newest-first: the newest flight's state pull is
+            # the round's ONE blocking transfer; older flights' outputs
+            # are already materialized by the time it returns.
+            live = [i for i, fl in enumerate(flights) if fl[3] is not None]
+            for pos, i in enumerate(reversed(live)):
+                fl = flights[i]
+                tt = time.perf_counter()
+                err = None
+                try:
+                    fl[5] = _pull(fl[2], fl[3], fl[1])
+                except retry_exc as e:
+                    fl[4], fl[2], fl[3] = e, None, None
+                    err = f"{type(e).__name__}: {e}"[:200]
+                if tr is not None:
+                    ev = dict(program=prog, direction="d2h",
+                              blocking=bool(pos == 0), n_iters=int(fl[1]))
+                    if err is not None:
+                        ev["error"] = err
+                    tr.emit("transfer", t=tt, dur=time.perf_counter() - tt,
+                            **ev)
+            # Process phase, oldest-first (serial order).  A failed flight
+            # re-enters the retry seam with its captured exception as
+            # attempt 0; anything younger chained on it is discarded.
+            for carry_e, n, new_c, out, exc, res in flights:
+                if exc is not None or res is None:
+                    new_c, state_h, lls_h, mets_h = _attempt_chunk(
+                        carry_e, n, first_exc=exc)
+                    carry = new_c
+                    stop = _consume(n, new_c, state_h, lls_h, mets_h)
+                    break
+                state_h, lls_h, mets_h = res
+                carry = new_c
+                stop = _consume(n, new_c, state_h, lls_h, mets_h)
+                if stop:
+                    break
 
     p, _, _, state_f, n_lls = carry
     state_h = np.asarray(state_f)
@@ -666,7 +811,7 @@ def fit_many(spec: DFMBatchSpec, backend: str = "tpu", max_iters: int = 50,
              tol: float = 1e-6, dtype=None, fused_chunk: int = 8,
              n_devices: Optional[int] = None, robust=True,
              device_init: bool = False,
-             with_metrics: bool = False) -> BatchFitResult:
+             with_metrics: bool = False, pipeline=None) -> BatchFitResult:
     """Fit B independent DFM problems in ONE fused program per chunk.
 
     The batched twin of ``api.fit`` for same-shaped, fully-observed
@@ -684,6 +829,8 @@ def fit_many(spec: DFMBatchSpec, backend: str = "tpu", max_iters: int = 50,
     ``with_metrics`` routes the chunks through the metrics twin program
     and fills ``BatchFitResult.metrics`` (per-iteration device-side
     convergence record; the default program is untouched when off).
+    ``pipeline`` as in ``api.fit``: speculative chunk issue + bucketed
+    executable reuse in the chunk driver (see ``dfm_tpu.pipeline``).
     """
     from ..api import _resolve_policy
     Y = np.asarray(spec.Y, np.float64)
@@ -747,7 +894,7 @@ def fit_many(spec: DFMBatchSpec, backend: str = "tpu", max_iters: int = 50,
             out = run_batched_em_sharded(
                 Yj, p0, cfg, max_iters, tol, fused_chunk=fused_chunk,
                 n_devices=n_devices, policy=policy,
-                with_metrics=with_metrics)
+                with_metrics=with_metrics, pipeline=pipeline)
             if with_metrics:
                 p, lls_list, conv, p_iters, healths, metrics = out
             else:
@@ -758,7 +905,8 @@ def fit_many(spec: DFMBatchSpec, backend: str = "tpu", max_iters: int = 50,
         elif backend == "tpu":
             out = run_batched_em(
                 Yj, p0, cfg, max_iters, tol, fused_chunk=fused_chunk,
-                policy=policy, with_metrics=with_metrics)
+                policy=policy, with_metrics=with_metrics,
+                pipeline=pipeline)
             if with_metrics:
                 p, lls_list, conv, p_iters, healths, metrics = out
             else:

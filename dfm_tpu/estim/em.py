@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from ..obs.trace import current_tracer, shape_key
 from ..ops.linalg import sym, solve_psd
+from ..pipeline import resolve_pipeline
 from ..ssm.kalman import kalman_filter, rts_smoother
 from ..ssm.info_filter import info_filter
 from ..ssm.parallel_filter import pit_filter, pit_smoother
@@ -331,7 +332,7 @@ def run_em_loop(step, max_iters: int, tol: float, callback=None,
 
 def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                    noise_floor: float, callback=None, fused_chunk: int = 8,
-                   ss_tau=None, monitor=None, progress=None):
+                   ss_tau=None, monitor=None, progress=None, pipeline=None):
     """Shared fused-chunk EM driver (single-device, sharded, and MF fits).
 
     ``scan_fn(p, n) -> (p_new, logliks (n,), ss_deltas (n,) | None)`` runs n
@@ -364,16 +365,29 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
     ``monitor``: a ``robust.ChunkMonitor`` switches to the health-monitored
     twin of this loop (same contract; adds between-chunk recovery and
     escalation — see ``robust.guard``).  None keeps the legacy loop below.
+
+    ``pipeline``: a ``pipeline.PipelineConfig`` (or int depth / None) —
+    ``depth > 1`` issues that many chunks speculatively before the one
+    blocking loglik transfer per round (latency hiding; bit-identical
+    results), ``bucket=True`` dispatches every chunk through the
+    scan_fn's ``bucket_call`` so one fused-length executable serves all
+    tail/replay lengths.  The default is exactly the serial loop below.
     """
     if monitor is not None:
         from ..robust.guard import guarded_run_em_chunked
         return guarded_run_em_chunked(
             scan_fn, p0, max_iters, tol, noise_floor, callback=callback,
             fused_chunk=fused_chunk, ss_tau=ss_tau, monitor=monitor,
-            progress=progress)
+            progress=progress, pipeline=pipeline)
     import time
     import numpy as np
     fused_chunk = max(1, int(fused_chunk))   # 0/negative would never advance
+    pipe = resolve_pipeline(pipeline)
+    if pipe.active:
+        return _run_em_chunked_pipelined(
+            scan_fn, p0, max_iters, tol, noise_floor, callback=callback,
+            fused_chunk=fused_chunk, ss_tau=ss_tau, progress=progress,
+            pipe=pipe)
     pass_piter = getattr(callback, "wants_params_iter", False)
     tr = current_tracer()
     prog = getattr(scan_fn, "trace_name", "em_chunk")
@@ -490,6 +504,191 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
     return p, np.asarray(lls), converged, p_iters
 
 
+class _ChunkCall:
+    """Resolves one chunk dispatch against the current scan_fn.
+
+    With bucketing on and a scan_fn carrying ``bucket_call(p, n_active,
+    n_bucket)`` (the api-layer closures do), every chunk — full, tail,
+    or replay — dispatches the ONE fused-length executable with a
+    dynamic active-iteration cap; scan_fns without the attribute
+    (escalated rebuilds, wrapped test seams) degrade to per-length
+    programs.  The bucketed shape key gains a ``b`` suffix so the
+    RecompileDetector sees one bucket-aware key instead of tail churn.
+    """
+
+    def __init__(self, bucket: bool, n_bucket: int):
+        self.bucket = bool(bucket)
+        self.n_bucket = int(n_bucket)
+
+    def bucketed(self, scan_fn) -> bool:
+        return (self.bucket
+                and getattr(scan_fn, "bucket_call", None) is not None)
+
+    def run(self, scan_fn, p, n):
+        if self.bucketed(scan_fn):
+            return scan_fn.bucket_call(p, n, self.n_bucket)
+        return scan_fn(p, n)
+
+    def key(self, scan_fn, prog_key, n) -> str:
+        if self.bucketed(scan_fn):
+            return shape_key(prog_key, f"iters{self.n_bucket}b")
+        return shape_key(prog_key, f"iters{n}")
+
+    def payload(self, scan_fn) -> dict:
+        return ({"bucket": self.n_bucket} if self.bucketed(scan_fn)
+                else {})
+
+
+def _run_em_chunked_pipelined(scan_fn, p0, max_iters: int, tol: float,
+                              noise_floor: float, callback=None,
+                              fused_chunk: int = 8, ss_tau=None,
+                              progress=None, pipe=None):
+    """Latency-hiding twin of the serial ``run_em_chunked`` loop.
+
+    Issues up to ``pipe.depth`` chunks back-to-back, each chained from
+    the previous chunk's still-on-device output params — the values
+    computed do not depend on when the host reads them, so results are
+    bit-identical to serial — then performs ONE blocking device->host
+    transfer per round: the newest chunk's logliks (the only read that
+    waits on device compute; the older chunks are finished by then and
+    their fetches just move bytes).  Host-side convergence checks run up
+    to depth-1 chunks late; a stop mid-round discards the younger
+    speculative chunks and lands on exactly the serial stopping rule's
+    update count via the shared chunk-entry replay.
+    """
+    import time
+    import numpy as np
+    pass_piter = getattr(callback, "wants_params_iter", False)
+    tr = current_tracer()
+    prog = getattr(scan_fn, "trace_name", "em_chunk")
+    prog_key = getattr(scan_fn, "trace_key", "")
+    engine = getattr(scan_fn, "trace_engine", prog)
+    cc = _ChunkCall(pipe.bucket, fused_chunk)
+    lls: list = []
+    converged = False
+    stop = False
+    target = 0
+    max_delta = 0.0
+    p = p0
+    it = 0
+    n_chunks = 0
+    t0 = time.perf_counter()
+    p_entry = p_entry_prev = p0
+    entry_it = entry_it_prev = 0
+    while it < max_iters and not stop:
+        # -- issue: enqueue up to depth chunks, chaining device params.
+        # No host read happens here, so the spans record async-enqueue
+        # overhead only (non-barrier) plus how deep the device queue was
+        # when each program was issued.
+        flights = []
+        while len(flights) < pipe.depth and it < max_iters:
+            n = min(fused_chunk, max_iters - it)
+            if tr is None:
+                out = cc.run(scan_fn, p, n)
+            else:
+                with tr.dispatch(prog, cc.key(scan_fn, prog_key, n),
+                                 n_iters=n, queue_depth=len(flights) + 1,
+                                 **cc.payload(scan_fn)):
+                    out = cc.run(scan_fn, p, n)
+            flights.append([p, it, n, out, None, None, None])
+            p = out[0]
+            it += n
+        # -- drain: one blocking transfer per round, newest chunk first.
+        for idx in range(len(flights) - 1, -1, -1):
+            fl = flights[idx]
+            out, n = fl[3], fl[2]
+            blocking = idx == len(flights) - 1
+            tt = time.perf_counter()
+            chunk = np.asarray(out[1], np.float64)[:n]
+            deltas = (np.asarray(out[2], np.float64)[:n]
+                      if out[2] is not None else None)
+            metrics = (np.asarray(out[3], np.float64)[:n]
+                       if len(out) > 3 and out[3] is not None else None)
+            if tr is not None:
+                tr.emit("transfer", t=tt, dur=time.perf_counter() - tt,
+                        program=prog, direction="d2h",
+                        blocking=bool(blocking), n_iters=int(n))
+            fl[4], fl[5], fl[6] = chunk, deltas, metrics
+        # -- process: the serial loop's host-side checks, oldest first.
+        for f_entry, f_it, n, out, chunk, deltas, metrics in flights:
+            if stop:
+                break       # younger speculative chunks are discarded
+            p_entry_prev, entry_it_prev = p_entry, entry_it
+            p_entry, entry_it = f_entry, f_it
+            if tr is not None:
+                drops = np.diff(chunk)
+                extra = ({"dparams": [float(x) for x in metrics[:, 2]]}
+                         if metrics is not None else {})
+                tr.emit("chunk", engine=engine, iter0=f_it, n=int(n),
+                        lls=[float(x) for x in chunk],
+                        noise_floor=float(noise_floor),
+                        max_drop=float(-drops.min()) if drops.size else 0.0,
+                        below_floor=bool(drops.size == 0
+                                         or np.abs(drops).max()
+                                         < noise_floor),
+                        **extra)
+            consumed = n
+            for j, ll in enumerate(chunk):
+                lls.append(float(ll))
+                if callback is not None:
+                    if pass_piter:
+                        callback(f_it + j, float(ll), p_entry,
+                                 params_iter=entry_it)
+                    else:
+                        callback(f_it + j, float(ll), p_entry)
+                state = em_progress(lls, tol, noise_floor)
+                if state != "continue":
+                    converged = state == "converged"
+                    target = (len(lls) if converged
+                              else max(len(lls) - 2, 0))
+                    stop = True
+                    consumed = j + 1
+                    break
+            if deltas is not None and consumed:
+                max_delta = max(max_delta,
+                                float(np.max(deltas[:consumed])))
+            if progress is not None:
+                iters_done = entry_it + consumed
+                elapsed = time.perf_counter() - t0
+                left = 0 if stop else max_iters - (f_it + n)
+                progress({"chunk": n_chunks, "iter": int(iters_done),
+                          "total": int(max_iters), "loglik": lls[-1],
+                          "delta": (lls[-1] - lls[-2]) if len(lls) > 1
+                          else None,
+                          "dparam": (float(metrics[consumed - 1, 2])
+                                     if metrics is not None and consumed
+                                     else None),
+                          "elapsed_s": elapsed,
+                          "eta_s": ((elapsed / iters_done) * left
+                                    if iters_done else None),
+                          "metrics": metrics, "stopped": bool(stop),
+                          "converged": bool(converged)})
+            n_chunks += 1
+            if stop:
+                # Land on the stopped chunk's state: the younger flights
+                # (and their iterations) never happened.
+                p = out[0]
+                it = f_it + n
+    if ss_tau is not None:
+        warn_ss_delta(max_delta, ss_tau)
+    p_iters = it
+    if stop and target != it:
+        base, base_it = ((p_entry, entry_it) if target >= entry_it
+                         else (p_entry_prev, entry_it_prev))
+        n_replay = target - base_it
+        if n_replay == 0:
+            p = base
+        elif tr is None:
+            p = cc.run(scan_fn, base, n_replay)[0]
+        else:
+            with tr.dispatch(prog, cc.key(scan_fn, prog_key, n_replay),
+                             n_iters=n_replay, replay=True,
+                             **cc.payload(scan_fn)):
+                p = cc.run(scan_fn, base, n_replay)[0]
+        p_iters = target
+    return p, np.asarray(lls), converged, p_iters
+
+
 def warn_ss_delta(max_delta: float, tau: int, threshold: float = 1e-4):
     """Warn when the steady-state freeze error is large enough to bias EM
     (the delta ss_filter_smoother reports; see ssm.steady)."""
@@ -591,9 +790,72 @@ def _em_scan_core_metrics(Y, mask, p0, cfg, has_mask, n_iters):
     return p, lls, deltas, metrics
 
 
+def _em_scan_core_active(Y, mask, p0, n_active, cfg, has_mask, n_bucket):
+    """Bucketed twin of ``_em_scan_core``: a STATIC ``n_bucket`` fused
+    length with a DYNAMIC (traced) ``n_active`` cap.  Iterations at index
+    >= n_active hold the param carry via where-selects (the batched
+    engine's convergence-freeze idiom), so ONE executable serves every
+    tail-chunk and replay length a fit can produce; the driver slices the
+    scanned outputs down to the active prefix host-side."""
+    m = mask if has_mask else None
+    sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
+
+    def body(p, j):
+        kf, sm, delta = cfg.e_step(Y, m, p, sumsq=sumsq)
+        p_new = _m_step(Y, m, sm, p, cfg, Ysq=Ysq)
+        live = j < n_active
+        p_out = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(live, a, b), p_new, p)
+        return p_out, (kf.loglik, delta)
+
+    p, (lls, deltas) = jax.lax.scan(body, p0, jnp.arange(n_bucket))
+    return p, lls, deltas
+
+
+def _em_scan_core_active_metrics(Y, mask, p0, n_active, cfg, has_mask,
+                                 n_bucket):
+    """Metrics twin of ``_em_scan_core_active`` (see
+    ``_em_scan_core_metrics`` for the per-iteration row contract)."""
+    m = mask if has_mask else None
+    sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
+
+    def body(carry, j):
+        p, ll_prev = carry
+        kf, sm, delta = cfg.e_step(Y, m, p, sumsq=sumsq)
+        p_new = _m_step(Y, m, sm, p, cfg, Ysq=Ysq)
+        ll = jnp.asarray(kf.loglik, jnp.float64)
+        row = jnp.stack([ll, ll - ll_prev,
+                         jnp.asarray(max_abs_update(p_new, p),
+                                     jnp.float64)])
+        live = j < n_active
+        p_out = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(live, a, b), p_new, p)
+        ll_out = jnp.where(live, ll, ll_prev)
+        return (p_out, ll_out), (kf.loglik, delta, row)
+
+    ll0 = jnp.asarray(jnp.nan, jnp.float64)
+    (p, _), (lls, deltas, metrics) = jax.lax.scan(
+        body, (p0, ll0), jnp.arange(n_bucket))
+    return p, lls, deltas, metrics
+
+
 @partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
 def _em_fit_scan_impl(Y, mask, p0, cfg, has_mask, n_iters):
     return _em_scan_core(Y, mask, p0, cfg, has_mask, n_iters)[:3]
+
+
+@partial(jax.jit, static_argnames=("cfg", "has_mask", "n_bucket"))
+def _em_fit_scan_active_impl(Y, mask, p0, n_active, cfg, has_mask,
+                             n_bucket):
+    return _em_scan_core_active(Y, mask, p0, n_active, cfg, has_mask,
+                                n_bucket)
+
+
+@partial(jax.jit, static_argnames=("cfg", "has_mask", "n_bucket"))
+def _em_fit_scan_active_metrics_impl(Y, mask, p0, n_active, cfg, has_mask,
+                                     n_bucket):
+    return _em_scan_core_active_metrics(Y, mask, p0, n_active, cfg,
+                                        has_mask, n_bucket)
 
 
 @partial(jax.jit, static_argnames=("cfg", "has_mask", "n_iters"))
@@ -623,7 +885,8 @@ def _em_fit_scan_checked_impl(Y, mask, p0, cfg, has_mask, n_iters):
 
 
 def em_fit_scan(Y, p0: SSMParams, n_iters: int, mask=None,
-                cfg: EMConfig = EMConfig(), with_metrics: bool = False):
+                cfg: EMConfig = EMConfig(), with_metrics: bool = False,
+                n_active=None):
     """Fixed-iteration EM fused into one XLA program (benchmark path:
     BASELINE.json:2 'EM iters/sec' measured without host round-trips).
     Returns (params, logliks (n,), ss_deltas (n,)); with
@@ -631,7 +894,32 @@ def em_fit_scan(Y, p0: SSMParams, n_iters: int, mask=None,
     iteration array [loglik, in-chunk delta, max param-update norm]
     (see ``_em_scan_core_metrics``; the default path's compiled program
     is untouched).  Debug mode has no metrics twin (checkify is the
-    diagnostic already): it returns metrics=None."""
+    diagnostic already): it returns metrics=None.
+
+    ``n_active`` (bucketed mode): ``n_iters`` becomes the STATIC bucket
+    length and ``n_active`` the traced count of iterations that advance
+    the params — the rest hold the carry (see ``_em_scan_core_active``),
+    so every (n_active <= n_iters) call reuses one executable.  Scanned
+    outputs still have length ``n_iters``; callers slice ``[:n_active]``.
+    """
+    if n_active is not None:
+        if cfg.debug:
+            raise ValueError(
+                "bucketed scans (n_active=) have no debug/checkify twin — "
+                "run debug fits unbucketed")
+        impl = (_em_fit_scan_active_metrics_impl if with_metrics
+                else _em_fit_scan_active_impl)
+        tr = current_tracer()
+        if tr is None:
+            return impl(Y, mask, p0, n_active, cfg, mask is not None,
+                        n_iters)
+        key = shape_key(Y, cfg.filter, f"iters{n_iters}b")
+        tr.maybe_cost("em_fit_scan", key, impl,
+                      Y, mask, p0, n_active, cfg, mask is not None, n_iters)
+        with tr.dispatch("em_fit_scan", key, n_iters=n_iters,
+                         bucket=n_iters):
+            return impl(Y, mask, p0, n_active, cfg, mask is not None,
+                        n_iters)
     if cfg.debug:
         err, out = _em_fit_scan_checked_impl(Y, mask, p0, cfg,
                                              mask is not None, n_iters)

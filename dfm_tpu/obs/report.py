@@ -72,7 +72,8 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
         p = by_prog.setdefault(e.get("program", "?"), {
             "dispatches": 0, "first_calls": 0, "recompiles": 0, "errors": 0,
             "keys": set(), "first_durs": [], "steady_durs": [],
-            "barrier_durs": [], "fused_iters": 0})
+            "barrier_durs": [], "fused_iters": 0, "bucketed": 0,
+            "queue_depths": []})
         p["dispatches"] += 1
         p["keys"].add(e.get("key", ""))
         if e.get("error"):
@@ -80,6 +81,9 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
         first = bool(e.get("first_call"))
         p["first_calls"] += first
         p["recompiles"] += bool(e.get("recompile"))
+        p["bucketed"] += e.get("bucket") is not None
+        if e.get("queue_depth") is not None:
+            p["queue_depths"].append(int(e["queue_depth"]))
         dur = e.get("dur")
         if dur is not None:
             (p["first_durs"] if first else p["steady_durs"]).append(dur)
@@ -93,6 +97,14 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
                  "first_calls": p["first_calls"],
                  "recompiles": p["recompiles"],
                  "shape_keys": sorted(p["keys"])}
+        if p["bucketed"]:
+            entry["bucketed_dispatches"] = p["bucketed"]
+        if p["queue_depths"]:
+            # Speculative (pipelined) launches: depth>1 means the host
+            # issued this chunk while an older one was still in flight.
+            entry["speculative_dispatches"] = sum(
+                1 for d in p["queue_depths"] if d > 1)
+            entry["max_queue_depth"] = max(p["queue_depths"])
         if p["errors"]:
             entry["errors"] = p["errors"]
         if p["first_durs"]:
@@ -149,6 +161,24 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
         "dispatch_errors": sum(1 for e in disp if e.get("error")),
         "programs": programs,
     }
+    # Execution barriers the host actually waited on: barrier'd dispatch
+    # spans (transfer inside the span) + explicit blocking transfer events
+    # (the pipelined drivers' one-pull-per-round).  The pipelining win is
+    # this number dropping from n_chunks to ~n_chunks/depth.
+    transfers = [e for e in events if e.get("kind") == "transfer"]
+    out["blocking_transfers"] = (
+        sum(1 for e in disp if e.get("barrier"))
+        + sum(1 for e in transfers if e.get("blocking")))
+    if transfers:
+        out["nonblocking_transfers"] = sum(
+            1 for e in transfers if not e.get("blocking"))
+    cache_evs = [e for e in events if e.get("kind") == "compile_cache"]
+    if cache_evs:
+        last = cache_evs[-1]
+        out["compile_cache"] = {
+            "dir": last.get("dir"), "entries": last.get("entries"),
+            "new_entries": sum(int(e.get("new_entries") or 0)
+                               for e in cache_evs)}
     walls = [e["dur"] for e in disp
              if e.get("dur") is not None and e.get("barrier")]
     if walls:
@@ -207,6 +237,17 @@ def _print_text(s: dict) -> None:
               f"(dispatch {_fmt_s(ph.get('dispatch_s', 0.0))}, "
               f"transfer {_fmt_s(ph.get('transfer_s', 0.0))}, "
               f"host {_fmt_s(ph.get('host_s', 0.0))})")
+    if "blocking_transfers" in s:
+        line = f"blocking transfers (host barriers): {s['blocking_transfers']}"
+        if s.get("nonblocking_transfers"):
+            line += (f" (+{s['nonblocking_transfers']} overlapped by the "
+                     f"dispatch pipeline)")
+        print(line)
+    cc = s.get("compile_cache")
+    if cc:
+        print(f"compile cache: {cc.get('entries')} entries at "
+              f"{cc.get('dir')} ({cc.get('new_entries')} new this trace"
+              f"{'' if cc.get('new_entries') else ' — warm'})")
     for name, p in s.get("programs", {}).items():
         line = (f"  {name}: {p['dispatches']} dispatch"
                 f"{'es' if p['dispatches'] != 1 else ''}, "
@@ -214,6 +255,15 @@ def _print_text(s: dict) -> None:
                 f"{'s' if len(p['shape_keys']) != 1 else ''}")
         if p.get("recompiles"):
             line += f", {p['recompiles']} RECOMPILE"
+            if p.get("bucketed_dispatches"):
+                # Recompiles despite bucketing = genuine churn (shape/
+                # config drift), not tail-chunk proliferation.
+                line += " (genuine churn despite bucketing)"
+        elif p.get("bucketed_dispatches"):
+            line += ", bucketed reuse (1 executable serves all chunk sizes)"
+        if p.get("speculative_dispatches"):
+            line += (f", {p['speculative_dispatches']} speculative "
+                     f"(queue depth {p.get('max_queue_depth')})")
         if "compile_proxy_s" in p:
             line += f", compile~{_fmt_s(max(p['compile_proxy_s'], 0.0))}"
         if "steady_s" in p:

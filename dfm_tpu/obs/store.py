@@ -43,7 +43,7 @@ RUNS_FILE = "runs.jsonl"
 # higher-is-better; walls / per-program costs are lower-is-better.
 _LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
                          "compile_s", "dispatch_s", "transfer_s", "host_s",
-                         "rel_err")
+                         "rel_err", "blocking_transfers")
 
 
 def lower_is_better(metric: str) -> bool:
@@ -249,6 +249,7 @@ _BENCH_NUMERIC_KEYS = (
     "value", "vs_baseline", "iters_per_sec_with_dispatch",
     "dispatch_ms_per_program", "n_iters_fused", "loglik_rel_err_iter3",
     "loglik_rel_err_iter50", "speedup_vs_looped",
+    "e2e_warm_fit_iters_per_sec", "blocking_transfers",
 )
 
 
@@ -278,7 +279,8 @@ def record_from_bench_json(parsed: Dict[str, Any], *,
 
 
 _ALL_METRIC_KEYS = ("em_iters_per_sec", "em_iters_per_sec_sustained",
-                    "vs_cpu", "vs_cpu_sustained", "total_secs")
+                    "vs_cpu", "vs_cpu_sustained", "total_secs",
+                    "e2e_warm_fit_iters_per_sec", "blocking_transfers")
 
 
 def record_from_bench_all_entry(name: str, res: Dict[str, Any], *,

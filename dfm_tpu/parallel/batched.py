@@ -90,14 +90,46 @@ def _sharded_chunk_metrics_impl(Y, carry, tol, noise_floor, cfg, n_iters,
     )(Y, carry, tol, noise_floor)
 
 
+@partial(jax.jit, static_argnames=("cfg", "n_iters", "mesh"))
+def _sharded_chunk_capped_impl(Y, carry, tol, noise_floor, n_active, cfg,
+                               n_iters, mesh):
+    """Bucketed twin of ``_sharded_chunk_impl``: STATIC ``n_iters`` fused
+    length, TRACED ``n_active`` cap (replicated scalar, P() spec) — one
+    executable per mesh size serves every tail-chunk length."""
+    Pb = P(BATCH_AXIS)
+    body = lambda Yb, c, t, nf, na: _em_chunk_core(Yb, c, t, nf, cfg,
+                                                   n_iters, n_active=na)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P(), P()),
+        out_specs=((Pb, Pb, Pb, Pb, Pb), P(None, BATCH_AXIS)),
+    )(Y, carry, tol, noise_floor, n_active)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_iters", "mesh"))
+def _sharded_chunk_capped_metrics_impl(Y, carry, tol, noise_floor, n_active,
+                                       cfg, n_iters, mesh):
+    Pb = P(BATCH_AXIS)
+    body = lambda Yb, c, t, nf, na: _em_chunk_core(
+        Yb, c, t, nf, cfg, n_iters, with_metrics=True, n_active=na)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P(), P()),
+        out_specs=((Pb, Pb, Pb, Pb, Pb),
+                   (P(None, BATCH_AXIS), P(None, BATCH_AXIS))),
+    )(Y, carry, tol, noise_floor, n_active)
+
+
 def run_batched_em_sharded(Y, p0, cfg, max_iters: int, tol: float,
                            fused_chunk: int = 8,
                            n_devices: Optional[int] = None, policy=None,
-                           with_metrics: bool = False):
+                           with_metrics: bool = False, pipeline=None):
     """Sharded batched-EM driver: same contract as ``run_batched_em``
     (params, per-problem traces, converged, p_iters, healths — plus the
     metrics block when ``with_metrics``), with the batch axis laid across
-    the mesh so B also scales across chips."""
+    the mesh so B also scales across chips.  ``pipeline`` passes through
+    to the shared driver with this module's capped twins, so speculative
+    issue and bucketed reuse work identically here."""
     mesh = make_batch_mesh(n_devices)
     D = mesh.devices.size
     B = Y.shape[0]
@@ -106,18 +138,21 @@ def run_batched_em_sharded(Y, p0, cfg, max_iters: int, tol: float,
                              np.full(n_pad, PADDED, np.int32)])
     impl = partial(_sharded_chunk_impl, mesh=mesh)
     impl_m = partial(_sharded_chunk_metrics_impl, mesh=mesh)
+    impl_c = partial(_sharded_chunk_capped_impl, mesh=mesh)
+    impl_cm = partial(_sharded_chunk_capped_metrics_impl, mesh=mesh)
     # Telemetry identity for the shared driver's dispatch spans: the
     # sharded twin is a DIFFERENT logical program (its own compile cache
     # entry per device count), so it gets its own name and a key carrying
     # the mesh size.
-    for f in (impl, impl_m):
+    for f in (impl, impl_m, impl_c, impl_cm):
         f.trace_name = "sharded_batched_em_chunk"
         f.trace_key = f"mesh{D}"
         f.trace_engine = "sharded_batched_em"
     out = run_batched_em(
         Yp, pp, cfg, max_iters, tol, fused_chunk=fused_chunk, policy=policy,
         scan_impl=impl, state0=state0, with_metrics=with_metrics,
-        scan_impl_metrics=impl_m)
+        scan_impl_metrics=impl_m, pipeline=pipeline,
+        scan_impl_capped=impl_c, scan_impl_capped_metrics=impl_cm)
     if with_metrics:
         p, lls_list, conv, p_iters, healths, metrics = out
     else:

@@ -207,6 +207,93 @@ def _sharded_em_scan_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
 
 
 @partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask", "has_gate",
+                                   "n_bucket"))
+def _sharded_em_scan_active_impl(Y, mask, gate, p: SSMParams, n_active,
+                                 mesh: Mesh, cfg: EMConfig, has_mask: bool,
+                                 has_gate: bool, n_bucket: int):
+    """Bucketed twin of ``_sharded_em_scan_impl``: STATIC ``n_bucket`` fused
+    length, TRACED ``n_active`` cap — iterations at index >= n_active hold
+    the replicated param carry via where-selects (see
+    ``estim.em._em_scan_core_active``), so one executable serves every
+    tail-chunk/replay length.  ``n_active`` is a replicated scalar; the
+    freeze select needs no collective."""
+    def body(Y_s, mask_s, gate_s, p_s, n_active_r):
+        m = mask_s if has_mask else None
+        g = gate_s if has_gate else None
+        sumsq_s = None if has_mask else Y_s * Y_s
+        Ysq_s = None if has_mask else jnp.sum(sumsq_s, axis=0)
+
+        def it(p_c, j):
+            p_new, ll, delta = _shard_em_step(Y_s, m, p_c, cfg, g, Ysq_s,
+                                              sumsq_s)
+            live = j < n_active_r
+            p_out = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(live, a, b), p_new, p_c)
+            return p_out, (ll, delta)
+
+        p_f, (lls, deltas) = lax.scan(it, p_s, jnp.arange(n_bucket))
+        return p_f, lls, deltas
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS),
+                  P(SERIES_AXIS), _param_specs(), P()),
+        out_specs=(_param_specs(), P(), P()))
+    if mask is None:
+        mask = jnp.ones_like(Y)
+    if gate is None:
+        gate = jnp.ones((Y.shape[1],), Y.dtype)
+    return mapped(Y, mask, gate, p, n_active)
+
+
+@partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask", "has_gate",
+                                   "n_bucket"))
+def _sharded_em_scan_active_metrics_impl(Y, mask, gate, p: SSMParams,
+                                         n_active, mesh: Mesh, cfg: EMConfig,
+                                         has_mask: bool, has_gate: bool,
+                                         n_bucket: int):
+    """Metrics twin of ``_sharded_em_scan_active_impl`` (same per-iteration
+    (n, 3) row contract as ``_sharded_em_scan_metrics_impl``)."""
+    def body(Y_s, mask_s, gate_s, p_s, n_active_r):
+        m = mask_s if has_mask else None
+        g = gate_s if has_gate else None
+        sumsq_s = None if has_mask else Y_s * Y_s
+        Ysq_s = None if has_mask else jnp.sum(sumsq_s, axis=0)
+
+        def it(carry, j):
+            p_c, ll_prev = carry
+            p_new, ll, delta = _shard_em_step(Y_s, m, p_c, cfg, g, Ysq_s,
+                                              sumsq_s)
+            leaves = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda a, b: jnp.max(jnp.abs(a - b)), p_new, p_c))
+            dparam = lax.pmax(jnp.max(jnp.stack(leaves)), SERIES_AXIS)
+            ll64 = jnp.asarray(ll, jnp.float64)
+            row = jnp.stack([ll64, ll64 - ll_prev,
+                             jnp.asarray(dparam, jnp.float64)])
+            live = j < n_active_r
+            p_out = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(live, a, b), p_new, p_c)
+            ll_out = jnp.where(live, ll64, ll_prev)
+            return (p_out, ll_out), (ll, delta, row)
+
+        ll0 = jnp.asarray(jnp.nan, jnp.float64)
+        (p_f, _), (lls, deltas, metrics) = lax.scan(
+            it, (p_s, ll0), jnp.arange(n_bucket))
+        return p_f, lls, deltas, metrics
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, SERIES_AXIS), P(None, SERIES_AXIS),
+                  P(SERIES_AXIS), _param_specs(), P()),
+        out_specs=(_param_specs(), P(), P(), P()))
+    if mask is None:
+        mask = jnp.ones_like(Y)
+    if gate is None:
+        gate = jnp.ones((Y.shape[1],), Y.dtype)
+    return mapped(Y, mask, gate, p, n_active)
+
+
+@partial(jax.jit, static_argnames=("mesh", "cfg", "has_mask", "has_gate",
                                    "n_iters"))
 def _sharded_em_scan_metrics_impl(Y, mask, gate, p: SSMParams, mesh: Mesh,
                                   cfg: EMConfig, has_mask: bool,
@@ -387,7 +474,8 @@ class ShardedEM:
             self.p, ll, self.last_delta = _sharded_em_step_impl(*args)
         return ll
 
-    def run_scan(self, p: SSMParams, n_iters: int, with_metrics: bool = False):
+    def run_scan(self, p: SSMParams, n_iters: int, with_metrics: bool = False,
+                 n_active=None):
         """n fused EM iterations from ``p`` (does NOT update ``self.p``).
 
         Returns (params, logliks (n,), ss_deltas (n,)) — the sharded analog
@@ -396,7 +484,28 @@ class ShardedEM:
         ``with_metrics`` appends a per-iteration (n, 3) metrics block
         (loglik, delta, max param-update) via the metrics twin program;
         the debug path has no metrics twin and returns ``None`` for it.
+        ``n_active`` (bucketed mode): ``n_iters`` becomes the static bucket
+        length and ``n_active`` the traced count of advancing iterations —
+        see ``estim.em.em_fit_scan``; callers slice outputs ``[:n_active]``.
         """
+        if n_active is not None:
+            if self.cfg.debug:
+                raise ValueError(
+                    "bucketed scans (n_active=) have no debug/checkify "
+                    "twin — run debug fits unbucketed")
+            impl = (_sharded_em_scan_active_metrics_impl if with_metrics
+                    else _sharded_em_scan_active_impl)
+            args = (self.Y, self.mask, self.gate, p,
+                    jnp.asarray(n_active, jnp.int32), self.mesh, self.cfg,
+                    self.has_mask, self.has_gate, n_iters)
+            tr = current_tracer()
+            if tr is None:
+                return impl(*args)
+            with tr.dispatch("sharded_em_chunk",
+                             shape_key(self._trace_key(),
+                                       f"iters{n_iters}b"),
+                             n_iters=n_iters, bucket=n_iters):
+                return impl(*args)
         args = (self.Y, self.mask, self.gate, p, self.mesh, self.cfg,
                 self.has_mask, self.has_gate, n_iters)
         if self.cfg.debug:

@@ -1,0 +1,171 @@
+"""Latency-hiding dispatch pipeline: config + persistent compile cache.
+
+The chunked EM drivers pay three FIXED costs per fit that have nothing to
+do with the math (docs/PERF.md "End-to-end fixed costs"): ~60-100 ms of
+axon tunnel latency per fused-chunk dispatch, a fresh XLA executable per
+distinct tail-chunk length, and seconds of compile on first call.  This
+module owns the knobs that hide them:
+
+- :class:`PipelineConfig` — ``depth`` speculative chunks in flight before
+  the driver blocks on a device->host loglik transfer (the only true
+  execution barrier on axon), and ``bucket`` tail-chunk padding so every
+  chunk dispatch reuses ONE executable (inert extra iterations via the
+  convergence-freeze selects the batched engine pioneered).  The drivers
+  consume this via ``run_em_chunked(pipeline=...)`` /
+  ``fit(pipeline=...)``; ``PipelineConfig()`` is bit-for-bit today's
+  serial behavior.
+- :func:`setup_compile_cache` — wires jax's persistent compilation cache
+  (``jax_compilation_cache_dir``) so a fresh process re-fitting a known
+  shape skips XLA compilation entirely.  Resolution mirrors the run
+  registry (``obs.store.runs_dir``): an explicit path wins, then the
+  ``DFM_COMPILE_CACHE`` env var (empty/"0"/"off" disables), then the
+  git-ignored ``.dfm_cache/`` default — but library calls (``fit()``)
+  pass ``ambient_only=True`` so a default never creates directories as a
+  side effect; only the CLIs (bench.py, bench/run.py, __graft_entry__.py)
+  opt into the default dir.
+
+Kept jax-free at import time (jax is imported lazily inside
+``setup_compile_cache``) so config resolution is usable from offline
+tooling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Union
+
+__all__ = ["PipelineConfig", "resolve_pipeline", "setup_compile_cache",
+           "compile_cache_dir", "compile_cache_entries",
+           "CACHE_ENV", "DEFAULT_CACHE_DIR"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Dispatch-pipeline knobs for the chunked EM drivers.
+
+    depth: chunks issued speculatively before the driver performs its one
+        BLOCKING device->host transfer per round (the newest chunk's
+        logliks; older chunks' outputs are already materialized by then).
+        Device programs queue on axon, so depth d turns d serial
+        (dispatch, block, check) round-trips into d async dispatches plus
+        one block — convergence checks run up to d-1 chunks behind and
+        roll back through the drivers' existing chunk-entry replay when a
+        stop lands mid-round.  Results are bit-identical to serial: the
+        chunk programs and the params they chain through do not depend on
+        WHEN the logliks are read.  depth=1 is today's behavior.
+
+    bucket: pad tail chunks (``n = min(fused_chunk, max_iters - it)`` and
+        mid-chunk replays) up to the fused chunk length with a dynamic
+        ``n_active`` cap — iterations past the cap hold the carry via
+        where-selects, so one executable serves every chunk length a fit
+        can produce and the RecompileDetector sees one bucket-aware shape
+        key (``itersNb``) instead of per-tail churn.
+    """
+    depth: int = 1
+    bucket: bool = False
+
+    def __post_init__(self):
+        if int(self.depth) < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {self.depth}")
+
+    @property
+    def active(self) -> bool:
+        """Whether this config changes anything vs the serial driver."""
+        return self.depth > 1 or self.bucket
+
+
+def resolve_pipeline(spec: Union[None, bool, int, PipelineConfig]
+                     ) -> PipelineConfig:
+    """Coerce a user-facing ``pipeline=`` value into a PipelineConfig.
+
+    None / False -> defaults (serial); True -> depth 2; an int -> that
+    depth (bucketing stays opt-in via an explicit PipelineConfig so the
+    plain ``pipeline=2`` path keeps the strict bit-identity guarantee).
+    """
+    if spec is None or spec is False:
+        return PipelineConfig()
+    if spec is True:
+        return PipelineConfig(depth=2)
+    if isinstance(spec, PipelineConfig):
+        return spec
+    if isinstance(spec, int):
+        return PipelineConfig(depth=spec)
+    raise TypeError(
+        f"pipeline= expects None, bool, int, or PipelineConfig; "
+        f"got {type(spec).__name__}")
+
+
+# -- persistent compilation cache -----------------------------------------
+
+CACHE_ENV = "DFM_COMPILE_CACHE"
+DEFAULT_CACHE_DIR = ".dfm_cache"
+_DISABLE_VALUES = {"", "0", "off", "none", "disable", "disabled"}
+
+# Process-global record of what was wired, so repeated fits are free and
+# telemetry can report the active dir without re-resolving.
+_state = {"dir": None, "configured": False}
+
+
+def _resolve_cache_dir(path: Optional[str],
+                       ambient_only: bool) -> Optional[str]:
+    if path is not None:
+        p = str(path)
+        return None if p.strip().lower() in _DISABLE_VALUES else p
+    env = os.environ.get(CACHE_ENV)
+    if env is not None:
+        return None if env.strip().lower() in _DISABLE_VALUES else env
+    return None if ambient_only else DEFAULT_CACHE_DIR
+
+
+def setup_compile_cache(path: Optional[str] = None, *,
+                        ambient_only: bool = False) -> Optional[str]:
+    """Point jax's persistent compile cache at a directory; idempotent.
+
+    Returns the resolved absolute cache dir, or None when disabled (an
+    explicit/env value of ""/"0"/"off"..., or ``ambient_only=True`` with
+    ``DFM_COMPILE_CACHE`` unset — the library-call mode: ``fit()`` must
+    not create ``.dfm_cache/`` as a side effect of a default, same
+    contract as ``obs.store.runs_dir``).
+
+    Beyond ``jax_compilation_cache_dir`` this clears jax's minimum
+    compile-time / entry-size thresholds: the defaults skip sub-second
+    compiles, which on the CPU fallback (and for the small per-fit
+    assembly programs) is EVERY program — with the thresholds in place
+    the cache would sit empty exactly where the cold-start cost lives.
+    """
+    d = _resolve_cache_dir(path, ambient_only)
+    if d is None:
+        return None
+    d = os.path.abspath(d)
+    if _state["configured"] and _state["dir"] == d:
+        return d
+    import jax
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _state["dir"] = d
+    _state["configured"] = True
+    return d
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The cache dir wired by ``setup_compile_cache`` this process (None
+    when the cache was never enabled)."""
+    return _state["dir"] if _state["configured"] else None
+
+
+def compile_cache_entries(path: Optional[str]) -> int:
+    """Number of persisted executables under a cache dir (0 when absent).
+
+    The before/after delta around a fit is the cache-miss count the trace
+    surfaces as a ``compile_cache`` event: ``new_entries == 0`` with
+    first-call dispatches present means every compile was served warm —
+    the tracked cold-start metric next to ``compile_proxy_s``.
+    """
+    if not path or not os.path.isdir(path):
+        return 0
+    n = 0
+    for _root, _dirs, files in os.walk(path):
+        n += len(files)
+    return n

@@ -180,15 +180,27 @@ def repair_params(p_np: SSMParams, r_floor: float = 1e-6,
 def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                            noise_floor: float, callback=None,
                            fused_chunk: int = 8, ss_tau=None,
-                           monitor: ChunkMonitor = None, progress=None):
+                           monitor: ChunkMonitor = None, progress=None,
+                           pipeline=None):
     """Monitored twin of ``estim.em.run_em_chunked`` (same return tuple,
     same optional 4-element scan_fn metrics contract and per-chunk
-    ``progress`` hook)."""
-    from ..estim.em import em_progress, warn_ss_delta
+    ``progress`` hook).
+
+    ``pipeline``: same contract as the unguarded driver.  With depth > 1
+    the guard issues chunks speculatively and runs its health checks at
+    drain time, one round behind — a drained chunk's pre-fetched result
+    is "attempt 0" of the serial recovery machinery, so any pathology
+    (NaN chunk, divergence, escalation, dispatch error) discards the
+    younger speculative chunks and replays the SAME recovery trajectory
+    the serial guard produces from that chunk's entry params.
+    """
+    from ..estim.em import _ChunkCall, em_progress, warn_ss_delta
     from ..obs.trace import current_tracer, shape_key
+    from ..pipeline import resolve_pipeline
 
     policy, controls, health = (monitor.policy, monitor.controls,
                                 monitor.health)
+    pipe = resolve_pipeline(pipeline)
     tr = current_tracer()
     prog = getattr(scan_fn, "trace_name", "em_chunk")
     prog_key = getattr(scan_fn, "trace_key", "")
@@ -199,6 +211,7 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         scan_fn = policy.wrap_scan(scan_fn)
 
     fused_chunk = max(1, int(fused_chunk))
+    cc = _ChunkCall(pipe.bucket, fused_chunk)
     pass_piter = getattr(callback, "wants_params_iter", False)
     lls: list = []
     converged = False
@@ -233,38 +246,51 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
             raise err from cause
         raise err
 
-    def _dispatch(fn, p_in, n):
+    def _pull(out, n):
+        """Transfer one chunk's outputs to host, sliced to the active
+        prefix (a no-op unbucketed; bucketed scans return the full
+        fused-length arrays)."""
+        p_out, chunk = out[0], np.asarray(out[1], np.float64)[:n]
+        deltas = out[2]
+        if deltas is not None:
+            deltas = np.asarray(deltas, np.float64)[:n]
+        metrics = (np.asarray(out[3], np.float64)[:n]
+                   if len(out) > 3 and out[3] is not None else None)
+        return p_out, chunk, deltas, metrics
+
+    def _dispatch(fn, p_in, n, first_exc=None):
         """One chunk dispatch with bounded retry + exponential backoff.
 
         The device->host transfers happen INSIDE the try: on the tunneled
         device errors surface at the transfer, not the (async) dispatch.
+
+        ``first_exc``: a pre-observed attempt-0 failure (a pipelined
+        issue/drain already consumed the dispatch and raised) — recorded
+        and retried exactly as if attempt 0 had failed here.
         """
         delay = policy.backoff_base
         attempt = 0
 
-        def _pull(out):
-            p_out, chunk = out[0], np.asarray(out[1], np.float64)
-            deltas = out[2]
-            if deltas is not None:
-                deltas = np.asarray(deltas, np.float64)
-            metrics = (np.asarray(out[3], np.float64)
-                       if len(out) > 3 and out[3] is not None else None)
-            return p_out, chunk, deltas, metrics
-
         while True:
             try:
+                if first_exc is not None:
+                    e, first_exc = first_exc, None
+                    raise e
                 if tr is None:
-                    p_out, chunk, deltas, metrics = _pull(fn(p_in, n))
+                    p_out, chunk, deltas, metrics = _pull(
+                        cc.run(fn, p_in, n), n)
                 else:
                     # Failed attempts each leave a dispatch event with an
                     # ``error`` field; the transfers inside the span make
                     # its wall time the true execution barrier.
                     with tr.dispatch(
                             getattr(fn, "trace_name", prog),
-                            shape_key(getattr(fn, "trace_key", prog_key),
-                                      f"iters{n}"),
-                            barrier=True, n_iters=n, attempt=attempt):
-                        p_out, chunk, deltas, metrics = _pull(fn(p_in, n))
+                            cc.key(fn, getattr(fn, "trace_key", prog_key),
+                                   n),
+                            barrier=True, n_iters=n, attempt=attempt,
+                            **cc.payload(fn)):
+                        p_out, chunk, deltas, metrics = _pull(
+                            cc.run(fn, p_in, n), n)
                 return p_out, chunk, deltas, metrics
             except policy.retry_exceptions as e:
                 if isinstance(e, GuardFailure):
@@ -312,12 +338,22 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         entry_floor = it
         return True
 
-    while it < max_iters and not stop:
-        n = min(fused_chunk, max_iters - it)
+    def _chunk_attempts(n, pre=None, first_exc=None):
+        """The serial NaN-retry attempts loop for one chunk.  ``pre`` is
+        a pre-drained attempt-0 result, ``first_exc`` a pre-observed
+        attempt-0 dispatch failure (the pipelined loop's seam — either
+        way attempt 0's dispatch was already consumed at issue time, so
+        retries line up with the serial call sequence)."""
+        nonlocal p
         chunk = deltas = metrics = None
         p_try = None
         for attempt in range(policy.chunk_retries + 1):
-            p_try, chunk, deltas, metrics = _dispatch(scan_fn, p, n)
+            if attempt == 0 and pre is not None:
+                p_try, chunk, deltas, metrics = pre
+            else:
+                p_try, chunk, deltas, metrics = _dispatch(
+                    scan_fn, p, n, first_exc=first_exc)
+                first_exc = None
             if np.all(np.isfinite(chunk)):
                 break
             if not policy.recover_divergence:
@@ -355,6 +391,15 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
             p = controls.params_device(repair_params(
                 p_np, policy.r_floor, jitter=policy.psd_tol
                 * (10.0 ** attempt)))
+        return p_try, chunk, deltas, metrics
+
+    def _consume_chunk(n, p_try, chunk, deltas, metrics):
+        """Host-side per-chunk machinery (emit, stopping rule, recovery,
+        between-chunk escalations) — the serial loop body after its
+        dispatch.  Returns "redo" (chunk escalated: re-run the same
+        budget from the entry), "stop", or "continue"."""
+        nonlocal p, it, stop, converged, target, stall_run, chunk_idx
+        nonlocal p_entry, p_entry_prev, entry_it, entry_it_prev
         if tr is not None and chunk is not None:
             drops = np.diff(chunk)
             extra = ({"dparams": [float(x) for x in metrics[:, 2]]}
@@ -412,7 +457,7 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         if chunk_escalated:
             health.n_chunks += 1
             chunk_idx += 1
-            continue        # it unchanged: redo the budget from the entry
+            return "redo"   # it unchanged: redo the budget from the entry
         # --- between-chunk health (host-side only) -----------------------
         max_chunk_delta = 0.0
         if deltas is not None and consumed:
@@ -441,7 +486,7 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                       "metrics": metrics, "stopped": bool(stop),
                       "converged": bool(converged)})
         if stop:
-            break
+            return "stop"
         # Freeze drift: correct, don't just warn (ADVICE #2).
         if (max_chunk_delta > policy.freeze_threshold
                 and policy.freeze_action != "warn"):
@@ -456,7 +501,7 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                                                       "fallback_info"):
                 acted = _apply_rebuild("fallback_info", ev)
             if acted:
-                continue
+                return "continue"
         # Stall: a whole chunk inside the noise floor without converging.
         diffs = np.abs(np.diff(np.asarray(lls[-(n + 1):], np.float64)))
         if len(diffs) and np.all(diffs <= max(noise_floor, 0.0)) and tol > 0:
@@ -470,7 +515,7 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                        f"{noise_floor:.3e}", action="none"))
             if policy.escalate_f64 and _apply_rebuild("loglik_f64", ev):
                 stall_run = 0
-                continue
+                return "continue"
             health.stalled = True
             stall_run = 0
         # Parameter pathology scan (costs one small transfer; off the
@@ -500,6 +545,80 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                 if repairing:
                     p = controls.params_device(repair_params(
                         p_np, policy.r_floor, jitter=policy.psd_tol))
+        return "continue"
+
+    if not pipe.active:
+        # Serial driver: dispatch, block on the transfer, check — exactly
+        # the pre-pipeline loop (``_chunk_attempts`` + ``_consume_chunk``
+        # manage ``it``/``stop`` themselves).
+        while it < max_iters and not stop:
+            n = min(fused_chunk, max_iters - it)
+            res = _chunk_attempts(n)
+            _consume_chunk(n, *res)
+    else:
+        def _issue(fn, p_in, n, k):
+            """Speculative enqueue (non-barrier span; ``queue_depth``
+            records how deep the device queue was at issue)."""
+            if tr is None:
+                return cc.run(fn, p_in, n)
+            with tr.dispatch(getattr(fn, "trace_name", prog),
+                             cc.key(fn, getattr(fn, "trace_key", prog_key),
+                                    n),
+                             n_iters=n, queue_depth=k, **cc.payload(fn)):
+                return cc.run(fn, p_in, n)
+
+        while it < max_iters and not stop:
+            # -- issue: up to depth chunks chained through device params.
+            flights = []        # [entry, it, n, out, exc, pulled]
+            p_issue, it_issue = p, it
+            while len(flights) < pipe.depth and it_issue < max_iters:
+                n = min(fused_chunk, max_iters - it_issue)
+                try:
+                    out = _issue(scan_fn, p_issue, n, len(flights) + 1)
+                except GuardFailure:
+                    raise
+                except Exception as e:      # fed to _dispatch at drain
+                    flights.append([p_issue, it_issue, n, None, e, None])
+                    break
+                flights.append([p_issue, it_issue, n, out, None, None])
+                p_issue = out[0]
+                it_issue += n
+            # -- drain: newest successful flight first — the round's one
+            # blocking transfer (older outputs are complete by then, so
+            # their fetches just move bytes).
+            live = [i for i, fl in enumerate(flights)
+                    if fl[3] is not None]
+            for pos, i in enumerate(reversed(live)):
+                fl = flights[i]
+                tt = time.perf_counter()
+                err = None
+                try:
+                    fl[5] = _pull(fl[3], fl[2])
+                except policy.retry_exceptions as e:
+                    fl[3], fl[4] = None, e
+                    err = f"{type(e).__name__}: {e}"[:200]
+                if tr is not None:
+                    ev = dict(program=prog, direction="d2h",
+                              blocking=bool(pos == 0),
+                              n_iters=int(fl[2]))
+                    if err is not None:
+                        ev["error"] = err
+                    tr.emit("transfer", t=tt,
+                            dur=time.perf_counter() - tt, **ev)
+            # -- process: the serial machinery oldest-first, with each
+            # drained result as attempt 0.  Any recovery replaces ``p``
+            # (and leaves ``it`` at the recovered chunk), breaking the
+            # chain check below, so the younger speculative results are
+            # discarded and the next round re-issues from the recovered
+            # state — the same trajectory the serial guard walks.
+            for f_entry, f_it, n, out, exc, pulled in flights:
+                if stop:
+                    break
+                if f_it != it or f_entry is not p:
+                    break       # chain broken by an older recovery
+                res = _chunk_attempts(n, pre=pulled, first_exc=exc)
+                _consume_chunk(n, *res)
+
     corrected = done_actions & {"remeasure_tau", "fallback_info"}
     if ss_tau is not None and not corrected:
         # No correction happened (policy "warn", or controls couldn't

@@ -1,0 +1,431 @@
+"""Latency-hiding dispatch pipeline (dfm_tpu/pipeline.py + chunk drivers).
+
+The operative contracts of the pipelined drivers, verified on the fake
+8-device CPU mesh (conftest):
+
+- HEALTHY-PATH BIT-IDENTITY: ``fit(pipeline=d)`` returns byte-identical
+  logliks/params to the serial driver on every engine (single-device,
+  sharded, batched, sharded-batched) — speculative issue only changes WHEN
+  device results are read, never what is computed.  x64-exact plus an f32
+  variant; bucketed tail padding (convergence-freeze selects) is checked
+  exact on x64 and to f32 tolerance under f32.
+- FAULT PARITY: an injected mid-pipeline divergence/dispatch failure rolls
+  back through the guard's chunk-entry replay to the SAME recovery
+  trajectory (logliks, params, health events) the serial guard produces.
+- BLOCKING-TRANSFER BUDGET: depth d performs ~ceil(n_chunks/d) blocking
+  device->host pulls instead of n_chunks (trace-asserted; the ~60-100 ms
+  axon tunnel latency this hides is docs/PERF.md "End-to-end fixed
+  costs").
+- BUCKETED EXECUTABLE REUSE: one ``itersNb`` shape key serves every chunk
+  length; a second same-shape fit triggers zero first-calls/recompiles.
+- PERSISTENT COMPILE CACHE: a fresh process with DFM_COMPILE_CACHE warm
+  loads every executable from disk (``new_entries == 0``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dfm_tpu.api import DynamicFactorModel, ShardedBackend, TPUBackend, fit
+from dfm_tpu.estim.batched import DFMBatchSpec, fit_many
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.report import summarize, _print_text
+from dfm_tpu.obs.trace import Tracer
+from dfm_tpu.pipeline import (CACHE_ENV, PipelineConfig,
+                              compile_cache_entries, resolve_pipeline,
+                              setup_compile_cache)
+from dfm_tpu.robust import FaultInjector, RobustPolicy
+from dfm_tpu.utils import dgp
+
+MODEL = DynamicFactorModel(n_factors=2, standardize=False)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(7)
+    p = dgp.dfm_params(N=16, k=2, rng=rng)
+    Y, _ = dgp.simulate(p, T=48, rng=rng)
+    return Y
+
+
+@pytest.fixture(scope="module")
+def panels():
+    rng = np.random.default_rng(3)
+    B, T, N, k = 3, 40, 6, 2
+    Y = np.empty((B, T, N))
+    for b in range(B):
+        F = rng.standard_normal((T, k)).cumsum(0) * 0.3
+        C = rng.standard_normal((N, k))
+        Y[b] = F @ C.T + 0.5 * rng.standard_normal((T, N))
+    return Y
+
+
+def quick_policy(inj=None, **kw):
+    kw.setdefault("backoff_base", 1e-4)
+    if inj is not None:
+        kw.setdefault("wrap_scan", inj.wrap)
+    return RobustPolicy(**kw)
+
+
+def _same_params(a, b, rtol=None):
+    for f in ("Lam", "A", "Q", "R", "mu0", "P0"):
+        x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        if rtol is None:
+            np.testing.assert_array_equal(x, y, err_msg=f)
+        else:
+            np.testing.assert_allclose(x, y, rtol=rtol, err_msg=f)
+
+
+def _chunk_dispatches(tr):
+    return [e for e in tr.events if e.get("kind") == "dispatch"
+            and "em_chunk" in e.get("program", "")]
+
+
+def _blocking_counts(tr):
+    """(barrier'd chunk dispatches, blocking transfer events) — their sum
+    is the host-barrier count the chunk driver paid."""
+    barr = sum(1 for e in _chunk_dispatches(tr) if e.get("barrier"))
+    btr = sum(1 for e in tr.events if e.get("kind") == "transfer"
+              and e.get("blocking"))
+    return barr, btr
+
+
+# ---------------------------------------------------------------- units --
+
+def test_pipeline_config_resolution():
+    assert not resolve_pipeline(None).active
+    assert not resolve_pipeline(False).active
+    assert resolve_pipeline(True) == PipelineConfig(depth=2)
+    cfg = resolve_pipeline(3)
+    assert cfg.depth == 3 and not cfg.bucket      # bucketing stays opt-in
+    explicit = PipelineConfig(depth=2, bucket=True)
+    assert resolve_pipeline(explicit) is explicit and explicit.active
+    assert PipelineConfig(depth=1, bucket=True).active
+    with pytest.raises(TypeError, match="pipeline"):
+        resolve_pipeline("fast")
+    with pytest.raises(ValueError, match="depth"):
+        PipelineConfig(depth=0)
+
+
+def test_compile_cache_resolution(monkeypatch, tmp_path):
+    # Library mode (fit()): an unset env NEVER creates a default dir.
+    monkeypatch.delenv(CACHE_ENV, raising=False)
+    assert setup_compile_cache(ambient_only=True) is None
+    for off in ("", "0", "off", "disabled"):
+        monkeypatch.setenv(CACHE_ENV, off)
+        assert setup_compile_cache() is None
+        assert setup_compile_cache(ambient_only=True) is None
+    # Explicit disable wins over an env value.
+    monkeypatch.setenv(CACHE_ENV, str(tmp_path))
+    assert setup_compile_cache("off") is None
+    # Entry counting tolerates absent/None dirs.
+    assert compile_cache_entries(None) == 0
+    assert compile_cache_entries(str(tmp_path / "nope")) == 0
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "a").write_text("x")
+    (tmp_path / "sub" / "b").write_text("y")
+    assert compile_cache_entries(str(tmp_path)) == 2
+
+
+def test_fit_rejects_bad_pipeline(panel):
+    with pytest.raises(TypeError, match="pipeline"):
+        fit(MODEL, panel, backend="tpu", max_iters=2, pipeline="deep")
+
+
+# ----------------------------------------- healthy-path bit-identity ----
+
+PIPES = [2, PipelineConfig(depth=2, bucket=True),
+         PipelineConfig(depth=3, bucket=True)]
+
+
+@pytest.mark.parametrize("robust", [False, True])
+def test_single_device_pipelined_identical(panel, robust):
+    b = TPUBackend(fused_chunk=3)                  # 8 iters -> 3,3,2: a tail
+    r0 = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, robust=robust)
+    for pipe in PIPES:
+        r = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+                robust=robust, pipeline=pipe)
+        np.testing.assert_array_equal(r.logliks, r0.logliks)
+        _same_params(r.params, r0.params)
+        assert r.n_iters == r0.n_iters and r.converged == r0.converged
+
+
+def test_single_device_pipelined_identical_f32(panel):
+    b = TPUBackend(dtype=jnp.float32, fused_chunk=3)
+    r0 = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, robust=False)
+    # Pure depth runs the SAME programs: exact even in f32.
+    r2 = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, robust=False,
+             pipeline=2)
+    np.testing.assert_array_equal(r2.logliks, r0.logliks)
+    _same_params(r2.params, r0.params)
+    # Bucketed tail padding recompiles one fused-length program; f32 is
+    # checked to tolerance (x64 exactness is pinned above).
+    rb = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, robust=False,
+             pipeline=PipelineConfig(depth=2, bucket=True))
+    np.testing.assert_allclose(rb.logliks, r0.logliks, rtol=2e-5)
+    _same_params(rb.params, r0.params, rtol=2e-4)
+
+
+@pytest.mark.parametrize("robust", [False, True])
+def test_sharded_pipelined_identical(panel, robust):
+    b = ShardedBackend(n_devices=8, fused_chunk=3)
+    r0 = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, robust=robust)
+    for pipe in PIPES[:2]:
+        r = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+                robust=robust, pipeline=pipe)
+        np.testing.assert_array_equal(r.logliks, r0.logliks)
+        _same_params(r.params, r0.params)
+
+
+@pytest.mark.parametrize("backend", ["tpu", "sharded"])
+def test_batched_pipelined_identical(panels, backend):
+    spec = DFMBatchSpec(Y=panels, model=MODEL)
+    kw = dict(backend=backend, max_iters=10, tol=1e-8, fused_chunk=3,
+              with_metrics=True)
+    if backend == "sharded":
+        kw["n_devices"] = 4
+    r0 = fit_many(spec, **kw)
+    for pipe in PIPES[:2]:
+        r = fit_many(spec, pipeline=pipe, **kw)
+        for p, p0 in zip(r.params, r0.params):
+            _same_params(p, p0)
+        for a, b in zip(r.logliks, r0.logliks):
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(r.metrics, r0.metrics)
+        np.testing.assert_array_equal(r.converged, r0.converged)
+        np.testing.assert_array_equal(r.p_iters, r0.p_iters)
+
+
+# ------------------------------------------------------- fault parity ---
+
+def test_nan_divergence_mid_pipeline_same_recovery(panel):
+    """An injected NaN chunk lands while younger chunks are in flight; the
+    guard discards them and replays from its last-good checkpoint — the
+    recovery trajectory must match the serial guard's exactly."""
+    b = TPUBackend(fused_chunk=2)
+    rs = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+             robust=quick_policy(FaultInjector().nan_chunk(1),
+                                 recover_divergence=True))
+    rp = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+             robust=quick_policy(FaultInjector().nan_chunk(1),
+                                 recover_divergence=True),
+             pipeline=2)
+    assert np.isfinite(rp.logliks).all()
+    np.testing.assert_array_equal(rp.logliks, rs.logliks)
+    _same_params(rp.params, rs.params)
+    assert ([e.kind for e in rp.health.events]
+            == [e.kind for e in rs.health.events])
+    assert rp.health.n_recoveries == rs.health.n_recoveries >= 1
+
+
+def test_nan_record_only_mid_pipeline_same_trace(panel):
+    # Default policy keeps the NaN chunk in the trace (legacy semantics);
+    # NaN != NaN, hence equal_nan.
+    b = TPUBackend(fused_chunk=2)
+    inj = FaultInjector().nan_chunk(1)
+    rs = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+             robust=quick_policy(FaultInjector().nan_chunk(1)))
+    rp = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+             robust=quick_policy(inj), pipeline=2)
+    assert np.array_equal(rp.logliks, rs.logliks, equal_nan=True)
+    _same_params(rp.params, rs.params)
+    assert np.isnan(rp.logliks[2:4]).all()
+
+
+def test_dispatch_failure_mid_pipeline_retried(panel):
+    b = TPUBackend(fused_chunk=2)
+    rs = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+             robust=quick_policy(FaultInjector().dispatch_failure(at=1,
+                                                                  count=2)))
+    rp = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0,
+             robust=quick_policy(FaultInjector().dispatch_failure(at=1,
+                                                                  count=2)),
+             pipeline=2)
+    np.testing.assert_array_equal(rp.logliks, rs.logliks)
+    _same_params(rp.params, rs.params)
+    assert rp.health.n_dispatch_retries == rs.health.n_dispatch_retries == 2
+    assert ([e.action for e in rp.health.events
+             if e.kind == "dispatch_error"]
+            == ["retried", "retried"])
+
+
+# --------------------------------------------- blocking-transfer budget --
+
+def _traced_fit(panel, robust, pipeline):
+    tr = Tracer(detector=RecompileDetector())
+    fit(MODEL, panel, backend=TPUBackend(fused_chunk=2), max_iters=8,
+        tol=0.0, robust=robust, telemetry=tr, pipeline=pipeline)
+    return tr
+
+
+@pytest.mark.parametrize("robust", [False, quick_policy()])
+def test_depth2_halves_blocking_transfers(panel, robust):
+    # Serial: one barrier'd dispatch per chunk (4 chunks at fused_chunk=2).
+    tr_s = _traced_fit(panel, robust, None)
+    barr_s, btr_s = _blocking_counts(tr_s)
+    assert (barr_s, btr_s) == (4, 0)
+    # Depth 2: non-barrier speculative dispatches + one blocking pull per
+    # round — the ISSUE bound is ceil(n_chunks/depth) + 1.
+    tr_p = _traced_fit(panel, robust, 2)
+    barr_p, btr_p = _blocking_counts(tr_p)
+    assert barr_p == 0
+    assert 0 < btr_p <= 4 // 2 + 1
+    assert barr_p + btr_p < barr_s + btr_s
+    # The speculative launches carry their queue position for the report.
+    depths = [e.get("queue_depth") for e in _chunk_dispatches(tr_p)]
+    assert max(d for d in depths if d is not None) == 2
+    # Summary arithmetic: chunk barriers + blocking pulls (+1 smooth
+    # barrier outside the chunk driver) land in ``blocking_transfers``.
+    s = summarize(tr_p.events)
+    assert s["blocking_transfers"] < summarize(tr_s.events)[
+        "blocking_transfers"]
+
+
+def test_batched_depth2_blocking_budget(panels):
+    spec = DFMBatchSpec(Y=panels, model=MODEL)
+    kw = dict(backend="tpu", max_iters=12, tol=1e-12, fused_chunk=3)
+    tr_s = Tracer(detector=RecompileDetector())
+    from dfm_tpu.obs.trace import activate
+    with activate(tr_s):
+        fit_many(spec, **kw)
+    barr_s, btr_s = _blocking_counts(tr_s)
+    assert barr_s == 4 and btr_s == 0              # 12 iters / 3 = 4 chunks
+    tr_p = Tracer(detector=RecompileDetector())
+    with activate(tr_p):
+        fit_many(spec, pipeline=PipelineConfig(depth=2, bucket=True), **kw)
+    barr_p, btr_p = _blocking_counts(tr_p)
+    assert barr_p == 0 and 0 < btr_p <= 4 // 2 + 1
+
+
+# ------------------------------------------- bucketed executable reuse --
+
+def test_bucketed_fit_compiles_one_chunk_executable(panel):
+    det = RecompileDetector()
+    b = TPUBackend(fused_chunk=3)
+    pipe = PipelineConfig(depth=2, bucket=True)
+    tr1 = Tracer(detector=det)
+    fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, robust=False,
+        telemetry=tr1, pipeline=pipe)
+    keys = {e["key"] for e in _chunk_dispatches(tr1)}
+    assert len(keys) == 1 and keys.pop().endswith("iters3b")
+    assert sum(e.get("recompile", False)
+               for e in _chunk_dispatches(tr1)) == 0
+    # Second same-shape fit against the SAME detector: zero first-calls,
+    # zero recompiles — the single bucketed executable served every chunk.
+    tr2 = Tracer(detector=det)
+    fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, robust=False,
+        telemetry=tr2, pipeline=pipe)
+    assert all(not e.get("first_call") and not e.get("recompile")
+               for e in _chunk_dispatches(tr2))
+    # Serial control: the 3,3,2 tail split needs a second executable.
+    tr3 = Tracer(detector=RecompileDetector())
+    fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, robust=False,
+        telemetry=tr3)
+    assert len({e["key"] for e in _chunk_dispatches(tr3)}) == 2
+
+
+def test_bucket_degrades_in_debug_mode(panel):
+    """Debug (checkify) fits have no bucketed twin: the driver silently
+    falls back to unbucketed chunk programs instead of failing."""
+    b = TPUBackend(fused_chunk=3, debug=True)
+    r0 = fit(MODEL, panel, backend=b, max_iters=4, tol=0.0, robust=False,
+             debug=True)
+    tr = Tracer(detector=RecompileDetector())
+    r = fit(MODEL, panel, backend=b, max_iters=4, tol=0.0, robust=False,
+            debug=True, pipeline=PipelineConfig(depth=2, bucket=True),
+            telemetry=tr)
+    np.testing.assert_array_equal(r.logliks, r0.logliks)
+    assert not any(e["key"].endswith("b") for e in _chunk_dispatches(tr))
+
+
+# --------------------------------------------------- report rendering ---
+
+def _disp(key="x//iters8b", **kw):
+    ev = dict(kind="dispatch", t=0.0, dur=0.1, program="em_chunk", key=key,
+              barrier=False, first_call=False, recompile=False, n_iters=8)
+    ev.update(kw)
+    return ev
+
+
+def test_report_bucketed_reuse_vs_churn(capsys):
+    # Bucketed, zero recompiles -> the reuse note.
+    s = summarize([_disp(bucket=8, queue_depth=2),
+                   _disp(bucket=8, queue_depth=1),
+                   dict(kind="transfer", t=0.3, dur=0.01, program="em_chunk",
+                        direction="d2h", blocking=True, n_iters=8),
+                   dict(kind="transfer", t=0.2, dur=0.01, program="em_chunk",
+                        direction="d2h", blocking=False, n_iters=8)])
+    p = s["programs"]["em_chunk"]
+    assert p["bucketed_dispatches"] == 2
+    assert p["speculative_dispatches"] == 1 and p["max_queue_depth"] == 2
+    assert s["blocking_transfers"] == 1 and s["nonblocking_transfers"] == 1
+    _print_text(s)
+    out = capsys.readouterr().out
+    assert "bucketed reuse" in out
+    assert "overlapped by the dispatch pipeline" in out
+    # Recompiles despite bucketing -> genuine churn, not tail-chunk noise.
+    s2 = summarize([_disp(bucket=8), _disp(bucket=8, key="y//iters8b",
+                                           first_call=True, recompile=True)])
+    _print_text(s2)
+    out2 = capsys.readouterr().out
+    assert "RECOMPILE" in out2 and "genuine churn" in out2
+    assert "bucketed reuse" not in out2
+
+
+def test_report_compile_cache_section(capsys):
+    s = summarize([_disp(), dict(kind="compile_cache", t=1.0,
+                                 dir="/tmp/cc", entries=5, new_entries=0)])
+    assert s["compile_cache"] == {"dir": "/tmp/cc", "entries": 5,
+                                  "new_entries": 0}
+    _print_text(s)
+    assert "warm" in capsys.readouterr().out
+
+
+# ------------------------------------------------ persistent cache -----
+
+_CACHE_SCRIPT = r'''
+import json
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from dfm_tpu.api import DynamicFactorModel, fit
+from dfm_tpu.utils import dgp
+rng = np.random.default_rng(0)
+p = dgp.dfm_params(N=10, k=2, rng=rng)
+Y, _ = dgp.simulate(p, T=30, rng=rng)
+res = fit(DynamicFactorModel(n_factors=2, standardize=False), Y,
+          max_iters=4, tol=0.0, telemetry=True, pipeline=2)
+cc = (res.telemetry or {}).get("compile_cache") or {}
+print(json.dumps({"entries": cc.get("entries"),
+                  "new": cc.get("new_entries")}))
+'''
+
+
+def test_compile_cache_warm_across_processes(tmp_path):
+    """Fresh process + warm DFM_COMPILE_CACHE: every executable loads from
+    disk (zero new cache entries on the second run)."""
+    env = dict(os.environ, DFM_COMPILE_CACHE=str(tmp_path / "cc"),
+               PYTHONPATH=REPO)
+    for k in ("DFM_TRACE", "DFM_RUNS"):
+        env.pop(k, None)
+
+    def run():
+        out = subprocess.run([sys.executable, "-c", _CACHE_SCRIPT],
+                             env=env, capture_output=True, text=True,
+                             timeout=560, cwd=REPO)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold = run()
+    assert cold["new"] and cold["new"] > 0         # populated the cache
+    warm = run()
+    assert warm["new"] == 0                        # fully served from disk
+    assert warm["entries"] == cold["entries"]

@@ -38,5 +38,15 @@ echo "$OUT"
 RUN_ID=$(printf '%s' "$OUT" | python -c \
     'import json,sys; print(json.loads(sys.stdin.readline())["run_id"])')
 
+# The pipelined-dispatch metrics must be present in the bench line (and
+# therefore in the recorded run, where obs.regress gates them: the e2e
+# rate as higher-is-better, blocking_transfers as lower-is-better).
+printf '%s' "$OUT" | python -c '
+import json, sys
+d = json.loads(sys.stdin.readline())
+missing = [k for k in ("e2e_warm_fit_iters_per_sec", "blocking_transfers")
+           if d.get(k) is None]
+sys.exit(f"perf_gate: bench line missing {missing}" if missing else 0)'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"
