@@ -790,15 +790,12 @@ def _em_scan_core_metrics(Y, mask, p0, cfg, has_mask, n_iters):
     return p, lls, deltas, metrics
 
 
-def _em_scan_core_active(Y, mask, p0, n_active, cfg, has_mask, n_bucket):
-    """Bucketed twin of ``_em_scan_core``: a STATIC ``n_bucket`` fused
-    length with a DYNAMIC (traced) ``n_active`` cap.  Iterations at index
-    >= n_active hold the param carry via where-selects (the batched
-    engine's convergence-freeze idiom), so ONE executable serves every
-    tail-chunk and replay length a fit can produce; the driver slices the
-    scanned outputs down to the active prefix host-side."""
-    m = mask if has_mask else None
-    sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
+def _em_chunk_body(Y, m, cfg, sumsq, Ysq, n_active):
+    """Shared live-capped EM chunk body: one (E-step, M-step) per scanned
+    index ``j``, holding the param carry via where-selects once
+    ``j >= n_active`` (the batched engine's convergence-freeze idiom).
+    Used by both the bucketed chunk scan (`_em_scan_core_active`) and the
+    fused while-loop driver (`estim.fused`)."""
 
     def body(p, j):
         kf, sm, delta = cfg.e_step(Y, m, p, sumsq=sumsq)
@@ -808,6 +805,19 @@ def _em_scan_core_active(Y, mask, p0, n_active, cfg, has_mask, n_bucket):
             lambda a, b: jnp.where(live, a, b), p_new, p)
         return p_out, (kf.loglik, delta)
 
+    return body
+
+
+def _em_scan_core_active(Y, mask, p0, n_active, cfg, has_mask, n_bucket):
+    """Bucketed twin of ``_em_scan_core``: a STATIC ``n_bucket`` fused
+    length with a DYNAMIC (traced) ``n_active`` cap.  Iterations at index
+    >= n_active hold the param carry via where-selects (the batched
+    engine's convergence-freeze idiom), so ONE executable serves every
+    tail-chunk and replay length a fit can produce; the driver slices the
+    scanned outputs down to the active prefix host-side."""
+    m = mask if has_mask else None
+    sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
+    body = _em_chunk_body(Y, m, cfg, sumsq, Ysq, n_active)
     p, (lls, deltas) = jax.lax.scan(body, p0, jnp.arange(n_bucket))
     return p, lls, deltas
 

@@ -1,0 +1,333 @@
+"""Dispatch-free end-to-end fit: EM + smooth + forecast in ONE program.
+
+``run_fused`` wraps the existing EM chunk body (`estim.em._em_chunk_body`)
+in a ``lax.while_loop`` whose stopping predicate mirrors the host-side
+``obs.convergence.em_progress`` rule exactly (relative-tolerance
+convergence, plateau detection, divergence vs. the absolute
+``noise_floor_for`` floor), then smooths and emits nowcast /
+diffusion-index forecasts inside the same jitted program.  Only small
+host-bound outputs cross the tunnel: params, the loglik path, iteration
+count, and the forecast arrays.  One barrier'd d2h read per fit.
+
+Donation: warm refits go through ``_fused_fit_impl_donated``
+(``donate_argnums`` on the incoming params pytree) so device-resident
+state is updated in place; the panel itself is cached by the backend
+(`TPUBackend._fused_panel`) so a warm ``fit(warm_start=prev)`` uploads
+nothing.
+
+Semantics vs. the chunked driver (`run_em_chunked`):
+
+- The while loop exits at the first chunk whose in-chunk predicate fires,
+  so the *consumed* iteration count matches the host rule to within one
+  chunk-length (parity-tested in tests/test_fused.py).
+- On convergence the returned params embody the full chunk's updates
+  (up to ``chunk - 1`` extra M-steps at an already-converged point);
+  there is no mid-chunk replay on device.
+- On divergence the last-good checkpoint follows the chunked driver's
+  replay rule: a drop at the chunk's *first* loglik blames the previous
+  chunk's params, otherwise this chunk's entry params are last-good.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..obs.trace import current_tracer, shape_key
+from ..ops.precision import accum_dtype
+from ..ssm.info_filter import info_filter
+from ..ssm.kalman import kalman_filter, rts_smoother
+from .em import _em_chunk_body, _panel_consts
+
+__all__ = ["FusedOptions", "FusedRun", "resolve_fused", "run_fused"]
+
+_RUNNING, _CONVERGED, _DIVERGED = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedOptions:
+    """Static options for the fused end-to-end fit program.
+
+    horizon: forecast steps ahead (state-space iterate + diffusion index).
+    di: also compute the diffusion-index (observable-regression) forecast.
+    fault_chunk/fault_drop: test seam — subtract ``fault_drop`` from the
+    logliks of chunk index ``fault_chunk`` on device, forcing the
+    divergence branch (used by the robust-fallback equivalence tests).
+    """
+
+    horizon: int = 1
+    di: bool = True
+    fault_chunk: Optional[int] = None
+    fault_drop: float = 1e6
+
+
+def resolve_fused(fused):
+    """Normalize the ``fit(fused=...)`` knob to FusedOptions or None."""
+    if not fused:
+        return None
+    if fused is True:
+        return FusedOptions()
+    if isinstance(fused, FusedOptions):
+        return fused
+    if isinstance(fused, int):
+        return FusedOptions(horizon=max(1, int(fused)))
+    raise TypeError(
+        "fused must be bool, int (forecast horizon) or FusedOptions; "
+        f"got {type(fused).__name__}"
+    )
+
+
+def _di_forecast_core(F, Y, horizon, ridge=1e-8):
+    # In-graph port of estim.diffusion.diffusion_index_forecast at its
+    # defaults (f_lags=0, y_lags=1), vectorized over every panel column.
+    # Normal equations share the factor Gram block across columns; the
+    # per-column own-lag row/column is assembled into a batched
+    # (N, k+2, k+2) solve.  This is a ONE-OFF batched solve outside the
+    # EM loop, so the in-scan batched-linalg tax (CLAUDE.md) does not
+    # apply.
+    T, k = F.shape
+    N = Y.shape[1]
+    d = k + 2
+    dt = F.dtype
+    n_fit = max(T - 1 - horizon, 0)
+    Xf = jnp.concatenate([jnp.ones((T - 1, 1), dt), F[1:]], axis=1)
+    Xf_fit = Xf[:n_fit]
+    Ylag_fit = Y[:-1][:n_fit]
+    Z = Y[1 + horizon :]
+    Gff = Xf_fit.T @ Xf_fit
+    Gfy = Xf_fit.T @ Ylag_fit
+    Gyy = jnp.einsum("ti,ti->i", Ylag_fit, Ylag_fit)
+    bf = Xf_fit.T @ Z
+    by = jnp.einsum("ti,ti->i", Ylag_fit, Z)
+    XtX = jnp.zeros((N, d, d), dt)
+    XtX = XtX.at[:, : d - 1, : d - 1].set(Gff[None])
+    XtX = XtX.at[:, : d - 1, d - 1].set(Gfy.T)
+    XtX = XtX.at[:, d - 1, : d - 1].set(Gfy.T)
+    XtX = XtX.at[:, d - 1, d - 1].set(Gyy)
+    XtX = XtX + ridge * jnp.eye(d, dtype=dt)[None]
+    Xtz = jnp.concatenate([bf.T, by[:, None]], axis=1)
+    beta = jnp.linalg.solve(XtX, Xtz[..., None])[..., 0]
+    x_last = jnp.concatenate(
+        [jnp.ones((N, 1), dt), jnp.broadcast_to(F[-1], (N, k)), Y[-2][:, None]],
+        axis=1,
+    )
+    return jnp.einsum("nd,nd->n", x_last, beta)
+
+
+def _fused_fit_core(Y, mask, p0, tol, noise_floor, cfg, has_mask, max_iters, chunk, opts):
+    m = mask if has_mask else None
+    sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
+    C = chunk
+    n_chunks = -(-max_iters // C)
+    acc = accum_dtype(Y.dtype)
+    tol = jnp.asarray(tol, acc)
+    floor = jnp.asarray(noise_floor, acc)
+    i32 = jnp.int32
+
+    def sel(pred, a, b):
+        return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+    def cond(c):
+        return (c["status"] == _RUNNING) & (c["it"] < max_iters)
+
+    def step(c):
+        p, it = c["p"], c["it"]
+        # Tail chunks reuse the same executable: always scan C iterations
+        # with a traced live-cap, exactly like _em_scan_core_active.
+        n_active = jnp.minimum(C, max_iters - it).astype(i32)
+        body = _em_chunk_body(Y, m, cfg, sumsq, Ysq, n_active)
+        p_end, (lls_c, _) = lax.scan(body, p, jnp.arange(C))
+        lls_c = lls_c.astype(acc)
+        if opts.fault_chunk is not None:  # static test seam
+            lls_c = lls_c - jnp.where(
+                it // C == opts.fault_chunk,
+                jnp.asarray(opts.fault_drop, acc),
+                jnp.zeros((), acc),
+            )
+        j = jnp.arange(C)
+        active = j < n_active
+        # On-device mirror of obs.convergence.em_progress over this
+        # chunk's loglik path (prev entry NaN on the very first chunk).
+        prev = jnp.concatenate([c["ll_last"][None], lls_c[:-1]])
+        has_prev = jnp.isfinite(prev)
+        rel = (lls_c - prev) / jnp.maximum(jnp.abs(prev), 1e-12)
+        drop = prev - lls_c
+        small = (tol > 0) & (jnp.abs(rel) < tol)
+        diver = ~small & (drop > floor)
+        plateau = ~small & ~diver & (drop > 0) & (tol > 0)
+        conv = has_prev & active & (small | plateau)
+        # Non-finite logliks count as divergence: NaN comparisons are all
+        # False, so without this a NaN run would burn the whole budget.
+        dive = active & ((has_prev & diver) | ~jnp.isfinite(lls_c))
+        stop = conv | dive
+        any_stop = jnp.any(stop)
+        first = jnp.argmax(stop).astype(i32)
+        stopped_div = any_stop & dive[first]
+        status = jnp.where(
+            any_stop, jnp.where(stopped_div, _DIVERGED, _CONVERGED), _RUNNING
+        ).astype(i32)
+        consumed = jnp.where(any_stop, first + 1, n_active)
+        # Last-good checkpoint (chunked driver's replay rule): a drop at
+        # this chunk's first loglik blames the previous chunk's update.
+        cand_p = sel(first >= 1, p, c["p_prev"])
+        cand_it = jnp.where(first >= 1, it, c["prev_it"])
+        p_good = sel(stopped_div, cand_p, c["p_good"])
+        good_it = jnp.where(stopped_div, cand_it, c["good_it"])
+        return {
+            "p": p_end,
+            "p_prev": p,
+            "prev_it": it,
+            "p_good": p_good,
+            "good_it": good_it,
+            "lls": lax.dynamic_update_slice(c["lls"], lls_c, (it,)),
+            "ll_last": lls_c[n_active - 1],
+            "it": it + consumed,
+            "emb": it + n_active,
+            "status": status,
+        }
+
+    carry0 = {
+        "p": p0,
+        "p_prev": p0,
+        "prev_it": jnp.zeros((), i32),
+        "p_good": p0,
+        "good_it": jnp.zeros((), i32),
+        "lls": jnp.full((n_chunks * C,), jnp.nan, acc),
+        "ll_last": jnp.asarray(jnp.nan, acc),
+        "it": jnp.zeros((), i32),
+        "emb": jnp.zeros((), i32),
+        "status": jnp.asarray(_RUNNING, i32),
+    }
+    f = lax.while_loop(cond, step, carry0)
+    p_fit = f["p"]
+
+    # Smooth + forecast at the fitted params, same program.  ss/pit
+    # configs route through the info filter, matching api.smooth().
+    ff = kalman_filter if cfg.filter == "dense" else info_filter
+    kf = ff(Y, p_fit, mask=m)
+    sm = rts_smoother(kf, p_fit)
+    x_T, P_T = sm.x_sm[-1], sm.P_sm[-1]
+    nowcast = p_fit.Lam @ x_T
+
+    def fstep(carry, _):
+        x, P = carry
+        x1 = p_fit.A @ x
+        P1 = p_fit.A @ P @ p_fit.A.T + p_fit.Q
+        return (x1, P1), (x1, p_fit.Lam @ x1)
+
+    _, (f_fore, y_fore) = lax.scan(fstep, (x_T, P_T), None, length=opts.horizon)
+    di = _di_forecast_core(sm.x_sm, Y, opts.horizon) if opts.di else None
+    return {
+        "p": p_fit,
+        "p_good": f["p_good"],
+        "good_it": f["good_it"],
+        "lls": f["lls"],
+        "n_iters": f["it"],
+        "emb": f["emb"],
+        "status": f["status"],
+        "x_sm": sm.x_sm,
+        "P_sm": sm.P_sm,
+        "nowcast": nowcast,
+        "f_fore": f_fore,
+        "y_fore": y_fore,
+        "di": di,
+    }
+
+
+_STATICS = ("cfg", "has_mask", "max_iters", "chunk", "opts")
+
+
+@partial(jax.jit, static_argnames=_STATICS)
+def _fused_fit_impl(Y, mask, p0, tol, noise_floor, *, cfg, has_mask, max_iters, chunk, opts):
+    return _fused_fit_core(Y, mask, p0, tol, noise_floor, cfg, has_mask, max_iters, chunk, opts)
+
+
+# Donated twin for warm refits: the incoming params pytree (positional
+# index 2) is consumed in place.  Y/mask are never donated — they stay
+# device-resident across refits (TPUBackend._fused_panel).
+@partial(jax.jit, static_argnames=_STATICS, donate_argnums=(2,))
+def _fused_fit_impl_donated(Y, mask, p0, tol, noise_floor, *, cfg, has_mask, max_iters, chunk, opts):
+    return _fused_fit_core(Y, mask, p0, tol, noise_floor, cfg, has_mask, max_iters, chunk, opts)
+
+
+@dataclasses.dataclass
+class FusedRun:
+    """Host-side view of one fused fit (all fields materialized numpy)."""
+
+    params: object
+    p_good: object
+    good_it: int
+    lls: np.ndarray
+    n_iters: int
+    p_iters: int
+    converged: bool
+    diverged: bool
+    x_sm: np.ndarray
+    P_sm: np.ndarray
+    nowcast: np.ndarray
+    f_fore: np.ndarray
+    y_fore: np.ndarray
+    di: Optional[np.ndarray]
+
+
+def _read_run(out, max_iters):
+    n = min(int(out["n_iters"]), max_iters)
+    status = int(out["status"])
+    return FusedRun(
+        params=out["p"].to_numpy(),
+        p_good=out["p_good"].to_numpy(),
+        good_it=int(out["good_it"]),
+        lls=np.asarray(out["lls"], np.float64)[:n],
+        n_iters=n,
+        p_iters=int(out["emb"]),
+        converged=status == _CONVERGED,
+        diverged=status == _DIVERGED,
+        x_sm=np.asarray(out["x_sm"], np.float64),
+        P_sm=np.asarray(out["P_sm"], np.float64),
+        nowcast=np.asarray(out["nowcast"], np.float64),
+        f_fore=np.asarray(out["f_fore"], np.float64),
+        y_fore=np.asarray(out["y_fore"], np.float64),
+        di=np.asarray(out["di"], np.float64) if out["di"] is not None else None,
+    )
+
+
+def run_fused(Yj, mj, pj, cfg, max_iters, tol, noise_floor, opts, fused_chunk=8):
+    """Run the fused fit program; returns a host-materialized FusedRun.
+
+    All device→host reads happen inside one barrier'd dispatch span, so a
+    traced fused fit counts exactly one blocking transfer.
+    """
+    max_iters = max(1, int(max_iters))
+    C = max(1, int(fused_chunk))
+    # CPU backend: donation is unimplemented and warns; use the plain twin.
+    impl = _fused_fit_impl if jax.default_backend() == "cpu" else _fused_fit_impl_donated
+    acc = accum_dtype(Yj.dtype)
+    args = (Yj, mj, pj, jnp.asarray(tol, acc), jnp.asarray(noise_floor, acc))
+    kw = dict(cfg=cfg, has_mask=mj is not None, max_iters=max_iters, chunk=C, opts=opts)
+    tr = current_tracer()
+    key = shape_key(Yj, cfg.filter, f"chunk{C}", f"max{max_iters}")
+    if tr is None:
+        return _read_run(impl(*args, **kw), max_iters)
+    with tr.dispatch("fused_fit", key, barrier=True, fused=True, n_iters=max_iters) as rec:
+        out = impl(*args, **kw)
+        run = _read_run(out, max_iters)
+        if rec is not None:
+            rec["n_iters"] = int(run.n_iters)
+    drops = np.diff(run.lls)
+    tr.emit(
+        "chunk",
+        engine="fused",
+        iter0=0,
+        n=int(run.n_iters),
+        lls=[float(x) for x in run.lls],
+        noise_floor=float(noise_floor),
+        max_drop=float(-drops.min()) if drops.size else 0.0,
+        below_floor=bool(drops.size == 0 or np.abs(drops).max() < float(noise_floor)),
+    )
+    return run

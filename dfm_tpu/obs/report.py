@@ -73,7 +73,7 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
             "dispatches": 0, "first_calls": 0, "recompiles": 0, "errors": 0,
             "keys": set(), "first_durs": [], "steady_durs": [],
             "barrier_durs": [], "fused_iters": 0, "bucketed": 0,
-            "queue_depths": []})
+            "queue_depths": [], "fused_programs": 0})
         p["dispatches"] += 1
         p["keys"].add(e.get("key", ""))
         if e.get("error"):
@@ -82,6 +82,7 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
         p["first_calls"] += first
         p["recompiles"] += bool(e.get("recompile"))
         p["bucketed"] += e.get("bucket") is not None
+        p["fused_programs"] += bool(e.get("fused"))
         if e.get("queue_depth") is not None:
             p["queue_depths"].append(int(e["queue_depth"]))
         dur = e.get("dur")
@@ -99,6 +100,9 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
                  "shape_keys": sorted(p["keys"])}
         if p["bucketed"]:
             entry["bucketed_dispatches"] = p["bucketed"]
+        if p["fused_programs"]:
+            # A while-loop fit: the whole EM ran inside this one span.
+            entry["fused_programs"] = p["fused_programs"]
         if p["queue_depths"]:
             # Speculative (pipelined) launches: depth>1 means the host
             # issued this chunk while an older one was still in flight.
@@ -169,6 +173,12 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
     out["blocking_transfers"] = (
         sum(1 for e in disp if e.get("barrier"))
         + sum(1 for e in transfers if e.get("blocking")))
+    # While-loop (fused) fits: EM iterations that ran inside a single
+    # dispatch span — the dispatch-free serving path's headline count.
+    fused_iters = sum(int(e.get("n_iters") or 0) for e in disp
+                      if e.get("fused"))
+    if fused_iters:
+        out["fused_iterations"] = fused_iters
     if transfers:
         out["nonblocking_transfers"] = sum(
             1 for e in transfers if not e.get("blocking"))
@@ -264,6 +274,8 @@ def _print_text(s: dict) -> None:
         if p.get("speculative_dispatches"):
             line += (f", {p['speculative_dispatches']} speculative "
                      f"(queue depth {p.get('max_queue_depth')})")
+        if p.get("fused_programs"):
+            line += ", fused (1 program)"
         if "compile_proxy_s" in p:
             line += f", compile~{_fmt_s(max(p['compile_proxy_s'], 0.0))}"
         if "steady_s" in p:

@@ -43,7 +43,8 @@ RUNS_FILE = "runs.jsonl"
 # higher-is-better; walls / per-program costs are lower-is-better.
 _LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
                          "compile_s", "dispatch_s", "transfer_s", "host_s",
-                         "rel_err", "blocking_transfers")
+                         "rel_err", "blocking_transfers",
+                         "dispatches_per_fit")
 
 
 def lower_is_better(metric: str) -> bool:
@@ -250,6 +251,7 @@ _BENCH_NUMERIC_KEYS = (
     "dispatch_ms_per_program", "n_iters_fused", "loglik_rel_err_iter3",
     "loglik_rel_err_iter50", "speedup_vs_looped",
     "e2e_warm_fit_iters_per_sec", "blocking_transfers",
+    "e2e_fused_fit_iters_per_sec", "dispatches_per_fit",
 )
 
 

@@ -139,6 +139,12 @@ class Tracer:
         batched engine) wrap the low-level callables, which carry their own
         spans for direct use (bench, dryrun) — suppressing nested spans
         keeps each physical launch counted exactly once.
+
+        The owning span yields a mutable dict merged into the event at
+        exit, so values only known after the d2h read (e.g. the realized
+        iteration count of a fused while-loop fit) can be recorded:
+        ``with tr.dispatch(...) as rec: ...; rec["n_iters"] = n``.
+        Suppressed (nested) spans yield None.
         """
         if self._depth > 0:
             yield None
@@ -147,8 +153,9 @@ class Tracer:
         status = self._detector.note(program, key)
         t0 = time.perf_counter()
         err = None
+        extra: dict = {}
         try:
-            yield None
+            yield extra
         except BaseException as e:
             err = f"{type(e).__name__}: {e}"
             raise
@@ -163,6 +170,7 @@ class Tracer:
             if err is not None:
                 ev["error"] = err
             ev.update(payload)
+            ev.update(extra)
             self.emit("dispatch", t=t0, **ev)
 
     @contextmanager

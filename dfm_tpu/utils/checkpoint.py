@@ -26,7 +26,8 @@ import numpy as np
 
 from ..backends.cpu_ref import SSMParams
 
-__all__ = ["save_checkpoint", "load_checkpoint", "data_fingerprint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "data_fingerprint",
+           "warm_fingerprint"]
 
 _FIELDS = ("Lam", "A", "Q", "R", "mu0", "P0")
 
@@ -39,6 +40,21 @@ def data_fingerprint(Y: np.ndarray, mask, model) -> str:
         h.update(np.ascontiguousarray(
             np.asarray(mask, np.uint8)).tobytes())
     h.update(repr(model).encode())
+    return h.hexdigest()
+
+
+def warm_fingerprint(shape, model, has_missing: bool) -> str:
+    """STRUCTURAL fingerprint for ``fit(warm_start=...)`` validation.
+
+    Deliberately value-free (panel shape + model config + missing-data
+    presence, NOT data bytes): warm-refitting on *updated values* of the
+    same panel shape is the intended serving flow — recompiles only come
+    from structural change, which is exactly what this hash captures.
+    Contrast ``data_fingerprint`` (checkpoint/resume), which must reject
+    different *data*."""
+    h = hashlib.sha1()
+    h.update(repr((tuple(int(d) for d in shape), repr(model),
+                   bool(has_missing))).encode())
     return h.hexdigest()
 
 

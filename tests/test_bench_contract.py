@@ -159,6 +159,12 @@ def test_headline_bench_prints_one_json_line_with_telemetry(tmp_path):
     # field exists to catch UNEXPECTED churn in longitudinal runs.
     assert out["dispatches"] > 0
     assert out["recompiles"] >= 1
+    # Dispatch-free fused-fit metrics (ISSUE 6 satellite): the warm fused
+    # refit rate and its own dispatch count (one while-loop program + the
+    # cache-consuming smooth read => <= 2).
+    assert out["e2e_fused_fit_iters_per_sec"] > 0
+    assert out["dispatches_per_fit"] is not None
+    assert out["dispatches_per_fit"] <= 2
     events = [json.loads(ln) for ln in
               trace.read_text().splitlines() if ln.strip()]
     n_disp = sum(1 for e in events if e.get("kind") == "dispatch")

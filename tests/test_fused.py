@@ -1,0 +1,323 @@
+"""Dispatch-free fused fit (dfm_tpu/estim/fused.py + api wiring).
+
+The operative contracts of ``fit(fused=True)``, verified on the fake
+8-device CPU mesh (conftest):
+
+- CONVERGENCE PARITY: the on-device while-loop predicate mirrors the host
+  ``em_progress`` rule — with the stop disabled (tol=0) the loglik path
+  and params are byte-identical to the chunked driver (x64; f32 to
+  tolerance), and with tol>0 the fused fit stops within one chunk-length
+  of the chunked driver's stopping iteration.
+- ONE-PROGRAM BUDGET: a traced fused fit pays exactly ONE barrier'd
+  dispatch; reading factors afterwards consumes the in-program smooth as
+  a non-blocking cache hit, so ``blocking_transfers <= 2`` end to end
+  (the ISSUE 6 acceptance bound, also asserted by tools/fused_smoke.sh).
+- WARM REFIT: ``fit(warm_start=prev)`` on the same backend + panel object
+  re-enters the program with zero h2d panel upload (persistent
+  ``_fused_panel`` residency) and validates shape/model/fingerprint
+  compatibility with clear errors.
+- ROBUST FALLBACK: a diverged fused run (injected via the
+  ``FusedOptions(fault_chunk=...)`` test seam) falls back to the
+  health-monitored chunked driver from the last-good checkpoint and
+  reaches the same answer as a clean chunked fit.
+- FORECASTS: the in-graph diffusion-index port matches the host oracle
+  (``estim.diffusion.diffusion_index_forecast``) per column.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dfm_tpu.api import DynamicFactorModel, ShardedBackend, TPUBackend, fit
+from dfm_tpu.estim.diffusion import diffusion_index_forecast
+from dfm_tpu.estim.fused import FusedOptions, resolve_fused
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.report import summarize, _print_text
+from dfm_tpu.obs.trace import Tracer
+from dfm_tpu.robust import RobustPolicy
+from dfm_tpu.utils import dgp
+from dfm_tpu.utils.data import standardize
+
+MODEL = DynamicFactorModel(n_factors=2, standardize=False)
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(7)
+    p = dgp.dfm_params(N=16, k=2, rng=rng)
+    Y, _ = dgp.simulate(p, T=48, rng=rng)
+    return Y
+
+
+def _same_params(a, b, rtol=None):
+    for f in ("Lam", "A", "Q", "R", "mu0", "P0"):
+        x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        if rtol is None:
+            np.testing.assert_array_equal(x, y, err_msg=f)
+        else:
+            np.testing.assert_allclose(x, y, rtol=rtol, err_msg=f)
+
+
+def _fused_dispatches(tr):
+    return [e for e in tr.events if e.get("kind") == "dispatch"
+            and e.get("program") == "fused_fit"]
+
+
+def _blocking_counts(tr):
+    barr = sum(1 for e in tr.events if e.get("kind") == "dispatch"
+               and e.get("barrier"))
+    btr = sum(1 for e in tr.events if e.get("kind") == "transfer"
+              and e.get("blocking"))
+    return barr, btr
+
+
+# ---------------------------------------------------------------- units --
+
+def test_resolve_fused():
+    assert resolve_fused(False) is None
+    assert resolve_fused(None) is None
+    assert resolve_fused(True) == FusedOptions()
+    assert resolve_fused(3) == FusedOptions(horizon=3)
+    opts = FusedOptions(horizon=2, di=False)
+    assert resolve_fused(opts) is opts
+    with pytest.raises(TypeError, match="fused"):
+        resolve_fused("yes")
+
+
+def test_fused_rejects_debug(panel):
+    with pytest.raises(ValueError, match="debug"):
+        fit(MODEL, panel, backend=TPUBackend(), max_iters=2, tol=0.0,
+            fused=True, debug=True)
+
+
+def test_fused_ignores_progress_with_warning(panel):
+    with pytest.warns(RuntimeWarning, match="progress"):
+        r = fit(MODEL, panel, backend=TPUBackend(fused_chunk=3),
+                max_iters=3, tol=0.0, fused=True,
+                progress=lambda *a, **k: None)
+    assert r.n_iters == 3
+
+
+# ----------------------------------------------- convergence parity -----
+
+def test_fused_matches_chunked_x64(panel):
+    b = TPUBackend(fused_chunk=3)                  # 8 iters -> 3,3,2: a tail
+    r0 = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0)
+    rf = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, fused=True)
+    np.testing.assert_array_equal(rf.logliks, r0.logliks)
+    _same_params(rf.params, r0.params)
+    assert rf.n_iters == r0.n_iters == 8
+    assert rf.converged == r0.converged
+
+
+def test_fused_matches_chunked_f32(panel):
+    b = TPUBackend(dtype=jnp.float32, fused_chunk=3)
+    r0 = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0)
+    rf = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, fused=True)
+    np.testing.assert_allclose(rf.logliks, r0.logliks, rtol=2e-5)
+    _same_params(rf.params, r0.params, rtol=2e-4)
+
+
+def test_fused_stop_parity(panel):
+    """With tol>0 the fused while-loop exits at the end of the chunk whose
+    predicate fires — within one chunk-length of the host rule's stop."""
+    b = TPUBackend(fused_chunk=4)
+    r0 = fit(MODEL, panel, backend=b, max_iters=40, tol=1e-4)
+    rf = fit(MODEL, panel, backend=b, max_iters=40, tol=1e-4, fused=True)
+    assert r0.converged and rf.converged
+    assert abs(rf.n_iters - r0.n_iters) <= 4
+    np.testing.assert_allclose(rf.logliks[-1], r0.logliks[-1], rtol=1e-8)
+
+
+def test_fused_smoothed_factors_match(panel):
+    b = TPUBackend(fused_chunk=3)
+    r0 = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0)
+    rf = fit(MODEL, panel, backend=b, max_iters=8, tol=0.0, fused=True)
+    np.testing.assert_allclose(rf.factors, r0.factors, atol=1e-10)
+
+
+# ------------------------------------------------------------ forecasts --
+
+def test_fused_forecasts_match_host_oracle(panel):
+    rf = fit(MODEL, panel, backend=TPUBackend(fused_chunk=4), max_iters=12,
+             tol=0.0, fused=True)
+    assert rf.forecasts is not None and rf.nowcast is not None
+    N = panel.shape[1]
+    assert rf.nowcast.shape == (N,)
+    assert rf.forecasts["y"].shape == (1, N)
+    assert rf.forecasts["f"].shape == (1, 2)
+    np.testing.assert_allclose(
+        rf.nowcast, np.asarray(rf.params.Lam) @ rf.factors[-1], atol=1e-10)
+    # Diffusion-index port vs the host oracle, column by column.
+    oracle = np.array([
+        diffusion_index_forecast(rf.factors, panel[:, i], horizon=1).forecast
+        for i in range(N)])
+    np.testing.assert_allclose(rf.forecasts["di"], oracle, atol=1e-8)
+
+
+def test_fused_horizon_and_di_knobs(panel):
+    r3 = fit(MODEL, panel, backend=TPUBackend(fused_chunk=4), max_iters=4,
+             tol=0.0, fused=3)
+    assert r3.forecasts["y"].shape == (3, panel.shape[1])
+    assert r3.forecasts["f"].shape == (3, 2)
+    rnd = fit(MODEL, panel, backend=TPUBackend(fused_chunk=4), max_iters=4,
+              tol=0.0, fused=FusedOptions(horizon=1, di=False))
+    assert rnd.forecasts["di"] is None
+
+
+def test_fused_destandardizes_outputs(panel):
+    """nowcast/forecasts come back in ORIGINAL data units: a fused fit on
+    the standardized model must equal the plain-model fit on the
+    pre-standardized panel pushed through the inverse transform."""
+    Ys, std = standardize(panel)
+    r_plain = fit(MODEL, Ys, backend=TPUBackend(fused_chunk=4),
+                  max_iters=6, tol=0.0, fused=True)
+    r_std = fit(DynamicFactorModel(n_factors=2, standardize=True), panel,
+                backend=TPUBackend(fused_chunk=4), max_iters=6, tol=0.0,
+                fused=True)
+    np.testing.assert_allclose(
+        r_std.nowcast, std.inverse(r_plain.nowcast), atol=1e-8)
+    np.testing.assert_allclose(
+        r_std.forecasts["y"], std.inverse(r_plain.forecasts["y"]),
+        atol=1e-8)
+
+
+def test_nonfused_fit_has_no_forecast_fields(panel):
+    r = fit(MODEL, panel, backend=TPUBackend(fused_chunk=4), max_iters=3,
+            tol=0.0)
+    assert r.nowcast is None and r.forecasts is None
+
+
+# ---------------------------------------------- one-program budget ------
+
+def test_fused_blocking_transfer_budget(panel):
+    """Cold fused fit + factor read: one barrier'd dispatch, zero blocking
+    transfers — the ISSUE 6 ``blocking_transfers <= 2`` bound with room."""
+    tr = Tracer(detector=RecompileDetector())
+    r = fit(MODEL, panel, backend=TPUBackend(fused_chunk=3), max_iters=8,
+            tol=0.0, fused=True, telemetry=tr)
+    assert r.factors is not None               # smooth consumed from cache
+    barr, btr = _blocking_counts(tr)
+    assert barr == 1 and btr == 0
+    s = summarize(tr.events)
+    assert s["blocking_transfers"] <= 2
+    # The while-loop fit is ONE dispatch span carrying the realized
+    # iteration count, not the max_iters budget.
+    (d,) = _fused_dispatches(tr)
+    assert d["fused"] and d["n_iters"] == 8
+    assert s["fused_iterations"] == 8
+
+
+def test_warm_fused_refit_budget_and_panel_residency(panel):
+    b = TPUBackend(fused_chunk=3)
+    r1 = fit(MODEL, panel, backend=b, max_iters=6, tol=0.0, fused=True)
+    Yj_cold = b._fused_panel[2]
+    tr = Tracer(detector=RecompileDetector())
+    r2 = fit(MODEL, panel, backend=b, max_iters=6, tol=0.0, fused=True,
+             warm_start=r1, telemetry=tr)
+    # Same panel object on the same backend: the device buffers are reused
+    # (zero h2d upload), and the refit stays within the dispatch budget.
+    assert b._fused_panel[2] is Yj_cold
+    barr, btr = _blocking_counts(tr)
+    assert barr + btr <= 2
+    assert len(_fused_dispatches(tr)) == 1
+    # The warm seed actually took: refit resumes from the fitted params,
+    # so its first loglik is at least the cold fit's last.
+    assert r2.logliks[0] >= r1.logliks[-1] - 1e-8
+
+
+def test_warm_start_equals_init_seed(panel):
+    b = TPUBackend(fused_chunk=3)
+    r1 = fit(MODEL, panel, backend=b, max_iters=5, tol=0.0, fused=True)
+    r2 = fit(MODEL, panel, backend=b, max_iters=5, tol=0.0, fused=True,
+             warm_start=r1)
+    r2b = fit(MODEL, panel, backend=TPUBackend(fused_chunk=3), max_iters=5,
+              tol=0.0, fused=True, init=r1.params)
+    np.testing.assert_allclose(r2.logliks, r2b.logliks, rtol=1e-12)
+    _same_params(r2.params, r2b.params, rtol=1e-12)
+
+
+# ------------------------------------------------ warm_start validation --
+
+def test_warm_start_validation_errors(panel):
+    r = fit(MODEL, panel, backend=TPUBackend(fused_chunk=4), max_iters=3,
+            tol=0.0, fused=True)
+    assert r.fingerprint is not None
+    with pytest.raises(ValueError, match="not both"):
+        fit(MODEL, panel, max_iters=2, warm_start=r, init=r.params)
+    with pytest.raises(TypeError, match="FitResult"):
+        fit(MODEL, panel, max_iters=2, warm_start=r.params)
+    with pytest.raises(ValueError, match="Lam shape"):
+        fit(MODEL, panel[:, :10], max_iters=2, warm_start=r)
+    with pytest.raises(ValueError, match="fitted with"):
+        fit(DynamicFactorModel(n_factors=2, standardize=True), panel,
+            max_iters=2, warm_start=r)
+    # Same shape + model but different missingness structure: the
+    # fingerprint catches what the shape check cannot.
+    Ymiss = panel.copy()
+    Ymiss[3, 2] = np.nan
+    with pytest.raises(ValueError, match="fingerprint"):
+        fit(MODEL, Ymiss, max_iters=2, warm_start=r)
+
+
+# ------------------------------------------------------ robust fallback --
+
+def test_fused_divergence_unguarded(panel):
+    """No guard: the fused driver mirrors the chunked divergence return —
+    last-good params, truncated loglik path, converged=False."""
+    rf = fit(MODEL, panel, backend=TPUBackend(fused_chunk=4), max_iters=40,
+             tol=0.0, fused=FusedOptions(fault_chunk=2), robust=False)
+    assert not rf.converged
+    assert rf.n_iters < 40                         # stopped at the fault
+    assert rf.nowcast is None                      # no smooth of bad params
+
+
+def test_fused_divergence_robust_fallback(panel):
+    """Guarded: fall back to the chunked driver from the last-good
+    checkpoint and land on the same answer as a clean chunked fit."""
+    tr = Tracer(detector=RecompileDetector())
+    policy = RobustPolicy(backoff_base=1e-4, recover_divergence=True)
+    rf = fit(MODEL, panel, backend=TPUBackend(fused_chunk=4), max_iters=40,
+             tol=0.0, fused=FusedOptions(fault_chunk=2), robust=policy,
+             telemetry=tr)
+    r0 = fit(MODEL, panel, backend=TPUBackend(fused_chunk=4), max_iters=40,
+             tol=0.0)
+    assert rf.n_iters == 40
+    assert np.isfinite(rf.logliks).all()
+    np.testing.assert_allclose(rf.logliks[-1], r0.logliks[-1], rtol=1e-10)
+    _same_params(rf.params, r0.params, rtol=1e-8)
+    assert rf.health is not None
+    assert any(e.get("kind") == "fused_fallback" for e in tr.events)
+
+
+# ------------------------------------------------- telemetry & report ---
+
+def test_fused_report_text(capsys):
+    events = [dict(kind="dispatch", t=0.0, dur=0.5, program="fused_fit",
+                   key="x//chunk8max50", barrier=True, first_call=True,
+                   recompile=False, fused=True, n_iters=17)]
+    s = summarize(events)
+    assert s["fused_iterations"] == 17
+    assert s["programs"]["fused_fit"]["fused_programs"] == 1
+    _print_text(s)
+    assert "fused (1 program)" in capsys.readouterr().out
+
+
+def test_sharded_backend_falls_back_with_warning(panel):
+    b = ShardedBackend(n_devices=8, fused_chunk=3)
+    r0 = fit(MODEL, panel, backend=b, max_iters=6, tol=0.0)
+    with pytest.warns(RuntimeWarning, match="sharded"):
+        rf = fit(MODEL, panel, backend=b, max_iters=6, tol=0.0, fused=True)
+    np.testing.assert_array_equal(rf.logliks, r0.logliks)
+    assert rf.nowcast is None                      # chunked path ran
+
+
+def test_fused_callback_replay(panel):
+    seen = []
+    fit(MODEL, panel, backend=TPUBackend(fused_chunk=3), max_iters=6,
+        tol=0.0, fused=True, callback=lambda i, ll, p: seen.append((i, ll)))
+    assert [i for i, _ in seen] == list(range(6))
+    assert all(np.isfinite(ll) for _, ll in seen)

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# One-command dispatch-free-fit check: run a traced warm fused refit
+# (fit(fused=True) then fit(warm_start=..., fused=True) on the same
+# backend) and assert the warm fit stayed within the ISSUE 6 budget of
+# <= 2 blocking transfers, read back from the trace via the report CLI.
+# The quick way to answer "is the fused path still one program end to
+# end" without touching the real chip.
+#
+# Usage (from the repo root):
+#   tools/fused_smoke.sh [trace_path]        # default /tmp/dfm_fused.jsonl
+#
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time;
+# export JAX_PLATFORMS= (empty) to smoke the default backend instead.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRACE="${1:-/tmp/dfm_fused.jsonl}"
+rm -f "$TRACE"
+
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" python - "$TRACE" <<'PY'
+import sys
+
+import numpy as np
+
+from dfm_tpu.api import DynamicFactorModel, TPUBackend, fit
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(0)
+p_true = dgp.dfm_params(30, 2, rng)
+Y, _ = dgp.simulate(p_true, 80, rng)
+
+model = DynamicFactorModel(n_factors=2)
+b = TPUBackend(filter="info")
+cold = fit(model, Y, backend=b, max_iters=24, tol=1e-6, fused=True)
+print(f"cold fused fit: {cold.n_iters} iters, "
+      f"converged={bool(cold.converged)}, "
+      f"loglik={float(cold.logliks[-1]):.4f}")
+
+# Trace ONLY the warm refit: same backend + same panel object means the
+# device buffers are reused and the whole fit is one barrier'd program.
+tr = Tracer(path=sys.argv[1], detector=RecompileDetector())
+with activate(tr):
+    warm = fit(model, Y, backend=b, max_iters=24, tol=1e-6, fused=True,
+               warm_start=cold)
+    warm.factors  # consume the in-program smooth (cache read)
+tr.close()
+print(f"warm fused refit: {warm.n_iters} iters, "
+      f"nowcast[:3]={np.round(warm.nowcast[:3], 3).tolist()}")
+PY
+
+echo "--- fused smoke gate ($TRACE) ---"
+python -m dfm_tpu.obs.report "$TRACE"
+python -m dfm_tpu.obs.report "$TRACE" --json | python -c '
+import json, sys
+s = json.load(sys.stdin)
+bt = s.get("blocking_transfers", 99)
+fi = s.get("fused_iterations", 0)
+assert bt <= 2, f"fused smoke FAILED: {bt} blocking transfers (budget 2)"
+assert fi > 0, "fused smoke FAILED: no fused dispatch span in the trace"
+print(f"fused smoke OK: {bt} blocking transfer(s), "
+      f"{fi} fused iteration(s) in one program")'
