@@ -369,6 +369,51 @@ def main():
         f"{dispatches_per_fit} dispatches, "
         f"{fused_tel.get('blocking_transfers')} blocking transfers")
 
+    # --- auto-tuning advisor: seed ProfileRecords from the measurements
+    # this run already made (no extra profiling fits), then close the loop
+    # with one fit(auto=True) probe — its predicted-vs-realized wall is
+    # the advice_rel_err model-drift metric obs.regress gates.
+    from dfm_tpu.obs import store as obs_store
+    advice = None
+    runs_d = obs_store.runs_dir()
+    if runs_d is not None:
+        from dfm_tpu.obs.profile import profile_record
+        devstr = f"{dev.platform} ({dev.device_kind})"
+        reg = obs_store.RunStore(runs_d)
+        for rec in (
+            # Coefficients only (no warm_wall_s anchor): the two-point
+            # sustained rate + per-program dispatch cost.
+            profile_record(
+                "chunked", N, T, k, iters=n_iters, chunk=8,
+                metrics={"sustained_ms_per_iter": 1e3 * tpu_secs,
+                         "dispatch_ms_per_program": em_dispatch_ms},
+                device=devstr),
+            profile_record(
+                "pipelined", N, T, k, iters=e2e_iters, chunk=8, depth=2,
+                metrics={"warm_wall_s": t_warm, "cold_wall_s": t_cold,
+                         "ms_per_iter_warm": 1e3 * t_warm / e2e_iters},
+                device=devstr),
+            profile_record(
+                "fused", N, T, k, iters=e2e_iters, chunk=8,
+                metrics={"warm_wall_s": t_fwarm, "cold_wall_s": t_fcold,
+                         "ms_per_iter_warm": 1e3 * t_fwarm / e2e_iters},
+                device=devstr),
+        ):
+            reg.append(rec)
+        log("advisor: 3 profiles recorded; fit(auto=True) probe ...")
+        t0 = time.perf_counter()
+        r_auto = api_fit(e2e_model, Y, max_iters=e2e_iters, tol=0.0,
+                         init=p0, backend=fused_b, auto=True,
+                         telemetry=True)
+        t_auto = time.perf_counter() - t0
+        advice = r_auto.advice or {}
+        log(f"advisor: plan={advice.get('engine')} "
+            f"predicted {advice.get('predicted_wall_s', 0.0):.2f} s, "
+            f"realized {t_auto:.2f} s "
+            f"(rel err {advice.get('rel_err', float('nan')):.2f})")
+    else:
+        log("advisor: run registry disabled (DFM_RUNS=\"\"), skipping")
+
     # Telemetry roll-up (events flush eagerly, so no close needed before
     # process exit — and the ambient tracer may outlive this function).
     ts = tracer.summary()
@@ -418,6 +463,15 @@ def main():
         "e2e_fused_fit_iters_per_sec": round(
             float(fused_res.n_iters) / t_fwarm, 4),
         "dispatches_per_fit": dispatches_per_fit,
+        # Latency percentiles over this run's timed dispatch spans, and
+        # the advisor's prediction error (None when DFM_RUNS="" disabled
+        # the registry and no plan could be calibrated).
+        "p99_dispatch_ms": (round(ts["dispatch_percentiles_ms"]["p99"], 3)
+                            if ts.get("dispatch_percentiles_ms") else None),
+        "advice_rel_err": (round(float(advice["rel_err"]), 4)
+                           if advice and advice.get("rel_err") is not None
+                           else None),
+        "advice_engine": advice.get("engine") if advice else None,
         # Distinct fused lengths are distinct XLA programs, so the two-point
         # protocol itself compiles several: recompiles > 0 here is expected
         # and truthful (see obs/trace.py shape_key).

@@ -92,6 +92,11 @@ class FitResult:
     #                                  # units, "f": (h, k) factor path,
     #                                  # "di": (N,) diffusion-index h-step
     #                                  # forecast or None}
+    advice: Optional[dict] = None      # fit(auto=True) only: the applied
+    #                                  # plan {engine, fused_chunk, depth,
+    #                                  # bucket, predicted_wall_s, ...} +
+    #                                  # realized_wall_s / rel_err once
+    #                                  # the fit returns
 
     @property
     def loglik(self) -> float:
@@ -975,7 +980,8 @@ def fit(model,                     # DynamicFactorModel | family spec
         progress: Optional[Callable] = None,
         pipeline=None,
         fused=False,
-        warm_start=None):
+        warm_start=None,
+        auto=False):
     """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
 
     ``model`` may also be a family spec — ``MixedFreqSpec``, ``TVLSpec``,
@@ -1068,6 +1074,18 @@ def fit(model,                     # DynamicFactorModel | family spec
         ``fused=`` on the same backend instance and the same panel
         object, a warm refit re-enters the donated device program with
         zero h2d re-upload.  Mutually exclusive with ``init``.
+    auto : auto-tuned execution plan (``obs.advise``): rank the candidate
+        plans (fused vs chunked+pipeline, ``fused_chunk``, depth,
+        bucketing) with the cost model calibrated from the profile
+        records in the run registry (``python -m dfm_tpu.obs.profile``)
+        and apply the top one — exactly as if its knobs had been passed
+        explicitly, so the result is bit-identical to that fit.  Emits an
+        ``advice`` trace event (predicted vs realized wall; gated by
+        ``obs.regress`` as ``advice_rel_err``) and attaches the plan as
+        ``FitResult.advice``.  An empty/uncalibrated registry falls back
+        to the default knobs with a RuntimeWarning — ``auto`` never
+        profiles inside ``fit`` and never tunes on pure priors.
+        Mutually exclusive with explicit ``pipeline=``/``fused=``.
     """
     tracer, owned = fit_tracer(telemetry)
     cache_dir = setup_compile_cache(ambient_only=True)
@@ -1079,7 +1097,17 @@ def fit(model,                     # DynamicFactorModel | family spec
             res = _fit_impl(model, Y, mask, backend, max_iters, tol, init,
                             callback, checkpoint_path, checkpoint_every,
                             debug, robust, progress, pipeline, fused,
-                            warm_start)
+                            warm_start, auto)
+            if isinstance(res, FitResult) and res.advice is not None:
+                # Close the advisor's loop: realized wall next to the
+                # prediction (rel_err is the model-drift metric obs.regress
+                # gates as advice_rel_err).
+                realized = time.perf_counter() - t0
+                res.advice["realized_wall_s"] = realized
+                pred = res.advice.get("predicted_wall_s")
+                if isinstance(pred, (int, float)) and realized > 0:
+                    res.advice["rel_err"] = abs(float(pred)
+                                                - realized) / realized
             if tracer is not None and isinstance(res, FitResult):
                 if cache_dir is not None:
                     n1 = compile_cache_entries(cache_dir)
@@ -1089,6 +1117,8 @@ def fit(model,                     # DynamicFactorModel | family spec
                             shape=shape_key(Y), n_iters=res.n_iters,
                             converged=bool(res.converged),
                             wall=time.perf_counter() - t0)
+                if res.advice is not None:
+                    tracer.emit("advice", **res.advice)
     finally:
         if owned:
             tracer.close()
@@ -1173,9 +1203,46 @@ def _resolve_warm_start(ws, init, model, N, fp_now):
     return ws.params
 
 
+def _resolve_auto_plan(b, N, T, k, max_iters):
+    """Pick the top ``obs.advise`` plan for this fit, or None (defaults).
+
+    Reads the ambient run registry only — never profiles, never writes.
+    A backend without the fused/pipeline seams, or a registry without
+    profile records, falls back to the default knobs with a warning
+    (auto-tuning on pure priors would be guessing with extra steps).
+    """
+    import warnings
+    if not (hasattr(b, "_fused") and hasattr(b, "_pipeline")):
+        warnings.warn(
+            f"backend {b.name!r} has no fused/pipeline execution plans to "
+            "choose between; ignoring auto=", RuntimeWarning, stacklevel=4)
+        return None
+    from .obs.advise import advise
+    try:
+        import jax
+        dev = str(jax.devices()[0].platform)
+    except Exception:
+        dev = None
+    from .obs.store import device_kind
+    res = advise(N, T, k, max_iters=max_iters,
+                 chunk=int(getattr(b, "fused_chunk", 8)),
+                 device=device_kind(dev) if dev else None)
+    if not res.get("calibrated") or not res.get("plans"):
+        warnings.warn(
+            "auto=True found no profile records in the run registry — "
+            "running the default knobs.  Calibrate first: "
+            f"python -m dfm_tpu.obs.profile --shape {N},{T},{k}",
+            RuntimeWarning, stacklevel=4)
+        return None
+    plan = dict(res["plans"][0])
+    plan["n_profiles"] = res["n_profiles"]
+    return plan
+
+
 def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
               checkpoint_path, checkpoint_every, debug, robust,
-              progress=None, pipeline=None, fused=False, warm_start=None):
+              progress=None, pipeline=None, fused=False, warm_start=None,
+              auto=False):
     if warm_start is not None and not isinstance(model, DynamicFactorModel):
         raise TypeError(
             f"warm_start is only supported for DynamicFactorModel fits; "
@@ -1194,6 +1261,12 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             warnings.warn(
                 f"the {type(model).__name__} family has no fused "
                 "while-loop driver; ignoring fused=", RuntimeWarning,
+                stacklevel=3)
+        if auto:
+            import warnings
+            warnings.warn(
+                f"the {type(model).__name__} family has no auto-tunable "
+                "execution plans; ignoring auto=", RuntimeWarning,
                 stacklevel=3)
         return family
     max_iters = 50 if max_iters is None else max_iters
@@ -1222,6 +1295,30 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
         init = _resolve_warm_start(warm_start, init, model, N, fp_now)
 
     b = get_backend(backend)
+    # Auto-tuned plan (obs.advise): resolves to the SAME pipeline=/fused=/
+    # fused_chunk knobs an explicit call would pass, so everything below
+    # (and the result, bit for bit) is identical to the explicit-knob fit.
+    auto_plan = None
+    restore_chunk = None
+    if auto:
+        if pipeline is not None or fused:
+            raise ValueError(
+                "auto=True picks the execution plan itself — drop the "
+                "explicit pipeline=/fused= knobs (or drop auto=)")
+        auto_plan = _resolve_auto_plan(b, N, T, model.n_factors, max_iters)
+        if auto_plan is not None:
+            chunk = int(auto_plan.get("fused_chunk") or 0)
+            if chunk and getattr(b, "fused_chunk", chunk) != chunk:
+                restore_chunk = (b.fused_chunk,)
+                b.fused_chunk = chunk
+            if auto_plan["engine"] == "fused":
+                fused = True
+            elif (int(auto_plan.get("depth") or 1) > 1
+                    or auto_plan.get("bucket")):
+                from .pipeline import PipelineConfig
+                pipeline = PipelineConfig(
+                    depth=int(auto_plan.get("depth") or 1),
+                    bucket=bool(auto_plan.get("bucket")))
     std: Optional[Standardizer] = None
     dev_prep = None
     if mask is None and checkpoint_path is None:
@@ -1435,6 +1532,8 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             b._pipeline = restore_pipeline[0]
         if restore_fused is not None:
             b._fused = restore_fused[0]
+        if restore_chunk is not None:
+            b.fused_chunk = restore_chunk[0]
         if restore_gck is not None:
             b._guard_checkpoint = restore_gck[0]
     nowcast = forecasts = None
@@ -1452,7 +1551,7 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
                      backend=smooth_b.name if smooth_b is not b else b.name,
                      history=history, health=health,
                      fingerprint=fp_now, nowcast=nowcast,
-                     forecasts=forecasts)
+                     forecasts=forecasts, advice=auto_plan)
 
 
 def forecast(result, horizon: int):

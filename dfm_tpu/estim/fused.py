@@ -314,6 +314,11 @@ def run_fused(Yj, mj, pj, cfg, max_iters, tol, noise_floor, opts, fused_chunk=8)
     key = shape_key(Yj, cfg.filter, f"chunk{C}", f"max{max_iters}")
     if tr is None:
         return _read_run(impl(*args, **kw), max_iters)
+    # Static cost capture (DFM_TRACE_COST=1): lower+compile only — nothing
+    # executes, so the donated twin's buffers are untouched.  Both twins
+    # share the program name AND shape key, so the RecompileDetector sees
+    # the donated warm refit as the SAME logical program, not a recompile.
+    tr.maybe_cost("fused_fit", key, impl, *args, **kw)
     with tr.dispatch("fused_fit", key, barrier=True, fused=True, n_iters=max_iters) as rec:
         out = impl(*args, **kw)
         run = _read_run(out, max_iters)

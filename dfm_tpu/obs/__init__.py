@@ -7,6 +7,13 @@ Zero-overhead when off: every instrumentation site is gated on
 Perf observatory (PR 4): ``obs.store`` is the persistent run registry
 (``DFM_RUNS``), ``obs.regress`` the cross-run regression gate —
 ``python -m dfm_tpu.obs.regress`` / ``report --diff``.
+
+Self-calibrating cost observatory (PR 7): ``obs.profile`` measures
+per-variant program profiles into the registry
+(``python -m dfm_tpu.obs.profile --shape N,T,K``), ``obs.cost`` fits the
+calibrated cost model from them, and ``obs.advise`` ranks execution
+plans (``python -m dfm_tpu.obs.advise --shape N,T,K``) — applied by
+``fit(auto=True)``, drift-gated via the ``advice`` trace event.
 """
 
 from .cost import (RecompileDetector, global_detector, program_cost,

@@ -1,0 +1,134 @@
+"""Auto-tuning advisor: ranked fit plans from the calibrated cost model
+(``python -m dfm_tpu.obs.advise --shape N,T,K`` — jax-free CLI).
+
+Given a panel shape, enumerate the candidate execution plans the fit
+drivers expose (fused while-loop vs chunked EM, ``fused_chunk`` size,
+pipeline depth, tail bucketing), predict each plan's wall with the
+``obs.cost`` model calibrated from the profile records in the run
+registry (``obs.profile``), and rank them.  ``fit(auto=True)`` applies
+the top plan and emits an ``advice`` trace event with predicted vs
+realized wall, which ``obs.regress`` gates as ``advice_rel_err`` — the
+model drifts, the gate fires, you re-profile.
+
+With an empty registry the CLI still ranks (device priors, flagged
+``calibrated: false``); ``fit(auto=True)`` instead falls back to the
+default knobs with a warning — auto-tuning never runs on pure priors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+__all__ = ["advise", "candidate_plans", "main"]
+
+
+def candidate_plans(chunk: int = 8) -> List[dict]:
+    """The plan grid: every knob combination the advisor considers.
+    Kept small and structured — each row maps 1:1 onto fit() knobs
+    (``fused=``/``pipeline=``/backend ``fused_chunk``)."""
+    return [
+        {"engine": "fused", "fused_chunk": chunk, "depth": 1,
+         "bucket": False},
+        {"engine": "fused", "fused_chunk": 2 * chunk, "depth": 1,
+         "bucket": False},
+        {"engine": "chunked", "fused_chunk": chunk, "depth": 1,
+         "bucket": False},
+        {"engine": "chunked", "fused_chunk": chunk, "depth": 2,
+         "bucket": False},
+        {"engine": "chunked", "fused_chunk": chunk, "depth": 2,
+         "bucket": True},
+        {"engine": "chunked", "fused_chunk": chunk, "depth": 4,
+         "bucket": True},
+    ]
+
+
+def advise(N: int, T: int, k: int, *, max_iters: int = 50, chunk: int = 8,
+           runs: Optional[str] = None,
+           device: Optional[str] = None) -> dict:
+    """Rank candidate plans for shape (N, T, k); deterministic given a
+    fixed profile registry.  ``runs=None`` resolves the ambient registry
+    (``DFM_RUNS`` / ``.dfm_runs``); reading never creates anything."""
+    from .cost import fit_cost_model
+    from .store import RunStore, runs_dir
+
+    d = runs_dir(runs)
+    profiles: List[dict] = []
+    if d is not None:
+        profiles = [r for r in RunStore(d).load()
+                    if r.get("kind") == "profile"]
+    model = fit_cost_model(profiles, device=device)
+
+    plans = []
+    for cand in candidate_plans(chunk):
+        pred = model.predict(N, T, k, max_iters, engine=cand["engine"],
+                             chunk=cand["fused_chunk"],
+                             depth=cand["depth"], bucket=cand["bucket"])
+        plans.append({**cand, **pred})
+    # Deterministic rank: predicted wall, then the stable knob tuple.
+    plans.sort(key=lambda p: (p["predicted_wall_s"], p["engine"],
+                              p["depth"], p["fused_chunk"], p["bucket"]))
+    for i, p in enumerate(plans):
+        p["rank"] = i + 1
+    return {"shape": {"N": int(N), "T": int(T), "k": int(k)},
+            "max_iters": int(max_iters), "device": model.device,
+            "calibrated": model.calibrated,
+            "n_profiles": model.n_profiles, "plans": plans,
+            "model": model.to_dict()}
+
+
+def _plan_str(p: dict) -> str:
+    if p["engine"] == "fused":
+        return f"fused (chunk={p['fused_chunk']})"
+    s = f"chunked (chunk={p['fused_chunk']}, depth={p['depth']}"
+    return s + (", bucket)" if p["bucket"] else ")")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dfm_tpu.obs.advise",
+        description="Rank fit plans for a shape via the calibrated cost "
+                    "model (profiles from the run registry).")
+    ap.add_argument("--shape", required=True, metavar="N,T,K")
+    ap.add_argument("--max-iters", type=int, default=50)
+    ap.add_argument("--chunk", type=int, default=8,
+                    help="base fused_chunk for the plan grid")
+    ap.add_argument("--runs", default=None,
+                    help="registry dir (default: DFM_RUNS or .dfm_runs)")
+    ap.add_argument("--device", default=None,
+                    help="device class to calibrate for (tpu/cpu; "
+                         "default: the latest profile's)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    try:
+        N, T, k = (int(x) for x in args.shape.split(","))
+    except ValueError:
+        print(f"error: --shape wants N,T,K, got {args.shape!r}",
+              file=sys.stderr)
+        return 2
+    res = advise(N, T, k, max_iters=args.max_iters, chunk=args.chunk,
+                 runs=args.runs, device=args.device)
+    if not res["calibrated"]:
+        print("warning: no profile records in the registry — predictions "
+              "use device priors only; run `python -m dfm_tpu.obs.profile "
+              f"--shape {args.shape}` to calibrate", file=sys.stderr)
+    if args.json:
+        json.dump(res, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    sh = res["shape"]
+    cal = ("calibrated from %d profile(s)" % res["n_profiles"]
+           if res["calibrated"] else "PRIORS ONLY")
+    print(f"advise N={sh['N']} T={sh['T']} k={sh['k']} "
+          f"max_iters={res['max_iters']} [{res['device']}, {cal}]")
+    for p in res["plans"]:
+        mark = " (measured anchor)" if p.get("anchored") else ""
+        print(f"  #{p['rank']}: {_plan_str(p):34s} "
+              f"predicted {p['predicted_wall_s']:.3f}s{mark}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

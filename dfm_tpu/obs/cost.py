@@ -19,14 +19,29 @@ Two observability primitives that need nothing from the hot path:
   mirroring XLA's own process-level executable cache: a program+key pair
   compiled once in this process never recompiles, so a repeated
   same-shape fit must show zero first-calls and zero recompiles.
+
+Plus the decision half of the observatory (PR 7, jax-free):
+
+- ``CostModel`` / ``fit_cost_model``: per-device-class coefficients
+  (dispatch floor, per-flop / per-byte throughput, scan-step overhead)
+  CALIBRATED from the ``obs.profile`` records in the run registry —
+  measured walls scale a structured prior, and exact-config profiles
+  anchor predictions to their measured median.  ``predict`` turns a
+  candidate plan (engine, fused_chunk, pipeline depth) at an unseen
+  (N, T, k, iters) into a wall estimate; ``obs.advise`` ranks plans
+  with it and ``fit(auto=True)`` applies the winner.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Set
+import dataclasses
+import math
+from statistics import median
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 __all__ = ["RecompileDetector", "global_detector", "reset_global_detector",
-           "program_cost"]
+           "program_cost", "CostModel", "fit_cost_model", "em_iter_work",
+           "DEFAULT_COEFFS"]
 
 
 class RecompileDetector:
@@ -115,3 +130,239 @@ def program_cost(jitted, *args, **kwargs) -> Optional[dict]:
                 out[name] = float(v)
     out.update(_mem_stats(compiled))
     return out or None
+
+
+# --------------------------------------------------------------------------
+# Calibrated cost model: measured profiles -> per-device coefficients
+# --------------------------------------------------------------------------
+
+def em_iter_work(N: int, T: int, k: int) -> Tuple[float, float]:
+    """Closed-form (flops, bytes) proxy for ONE EM iteration of the
+    info-filter fit at panel shape (N, T, k): per time step the E-step
+    forms C'R^-1 y (Nk), C'R^-1 C (Nk^2) and a handful of k-by-k
+    factorizations/solves (k^3); the smoother and M-step sweeps are the
+    same order.  Constants don't matter — calibration scales them — the
+    proxy only has to get the SHAPE dependence right so profiles at one
+    shape extrapolate to another."""
+    flops = 2.0 * T * (N * k + N * k * k + 8.0 * k ** 3)
+    bytes_ = 8.0 * T * (N + N * k + 4.0 * k * k)
+    return float(flops), float(bytes_)
+
+
+# Structured priors per device class — the fallback when the registry has
+# no profiles, and the shape calibration scales.  The tpu row encodes the
+# axon-tunnel facts (CLAUDE.md): ~80 ms dispatch floor, MXU-fed matmuls.
+DEFAULT_COEFFS: Dict[str, Dict[str, float]] = {
+    "tpu": {"dispatch_floor_s": 0.08, "step_s": 2e-5,
+            "per_flop_s": 1.0 / 2e12, "per_byte_s": 1.0 / 4e10,
+            "overhead_s": 0.3},
+    "cpu": {"dispatch_floor_s": 1e-3, "step_s": 4e-5,
+            "per_flop_s": 1.0 / 5e9, "per_byte_s": 1.0 / 1e10,
+            "overhead_s": 0.05},
+}
+
+
+def _norm_plan(engine: str, chunk, depth, bucket) -> Tuple:
+    return (str(engine), int(chunk or 8), int(depth or 1), bool(bucket))
+
+
+def _profile_plan(config: dict) -> Optional[Tuple]:
+    """Map a ProfileRecord config to a normalized plan tuple (the
+    ``pipelined`` variant is the chunked engine at depth>1)."""
+    variant = config.get("profile")
+    if variant == "fused":
+        return _norm_plan("fused", config.get("chunk"), 1, False)
+    if variant in ("chunked", "pipelined"):
+        depth = config.get("depth") or (2 if variant == "pipelined" else 1)
+        return _norm_plan("chunked", config.get("chunk"), depth,
+                          config.get("bucket"))
+    return None
+
+
+@dataclasses.dataclass
+class CostModel:
+    """Wall-time predictor for a fit plan at shape (N, T, k).
+
+    ``predicted = overhead + n_program_dispatches * dispatch_floor +
+    iters * iter_s(N, T, k)`` where ``iter_s = T*step + flops*per_flop +
+    bytes*per_byte`` — and when the registry holds a profile at the EXACT
+    plan+shape, the prediction is anchored to that measured warm median
+    instead (extrapolated across iteration counts by the model's own
+    marginal rate)."""
+
+    device: str = "cpu"
+    dispatch_floor_s: float = 1e-3
+    step_s: float = 4e-5
+    per_flop_s: float = 2e-10
+    per_byte_s: float = 1e-10
+    overhead_s: float = 0.05
+    calibrated: bool = False
+    n_profiles: int = 0
+    anchors: List[dict] = dataclasses.field(default_factory=list)
+
+    def iter_s(self, N: int, T: int, k: int) -> float:
+        flops, bytes_ = em_iter_work(N, T, k)
+        return (self.step_s * T + self.per_flop_s * flops
+                + self.per_byte_s * bytes_)
+
+    def dispatches(self, iters: int, *, engine: str, chunk: int = 8,
+                   depth: int = 1) -> int:
+        """Program dispatches the host pays the tunnel floor for."""
+        if engine == "fused":
+            return 1
+        n_chunks = max(1, math.ceil(iters / max(1, chunk)))
+        return max(1, math.ceil(n_chunks / max(1, depth)))
+
+    def _anchor(self, plan: Tuple, N: int, T: int, k: int):
+        cands = [a for a in self.anchors
+                 if (a["plan"] == list(plan) or tuple(a["plan"]) == plan)
+                 and (a["N"], a["T"], a["k"]) == (N, T, k)]
+        return max(cands, key=lambda a: a["iters"]) if cands else None
+
+    def predict(self, N: int, T: int, k: int, iters: int, *,
+                engine: str, chunk: int = 8, depth: int = 1,
+                bucket: bool = False) -> dict:
+        plan = _norm_plan(engine, chunk, depth, bucket)
+        it = self.iter_s(N, T, k)
+        anchor = self._anchor(plan, N, T, k)
+        if anchor is not None:
+            # Measured wall at this exact config; the model only fills in
+            # the marginal cost of the iteration-count difference.
+            wall = (float(anchor["warm_wall_s"])
+                    + (iters - int(anchor["iters"])) * it
+                    + (self.dispatches(iters, engine=engine, chunk=chunk,
+                                       depth=depth)
+                       - self.dispatches(int(anchor["iters"]),
+                                         engine=engine, chunk=chunk,
+                                         depth=depth))
+                    * self.dispatch_floor_s)
+            return {"predicted_wall_s": max(wall, 1e-9), "anchored": True}
+        nd = self.dispatches(iters, engine=engine, chunk=chunk, depth=depth)
+        wall = self.overhead_s + nd * self.dispatch_floor_s + iters * it
+        return {"predicted_wall_s": max(wall, 1e-9), "anchored": False}
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["n_anchors"] = len(d.pop("anchors"))
+        return d
+
+
+def _solve3(A: List[List[float]], b: List[float]) -> Optional[List[float]]:
+    """Gaussian elimination for the 3x3 normal equations (jax/numpy-free)."""
+    m = [row[:] + [v] for row, v in zip(A, b)]
+    for col in range(3):
+        piv = max(range(col, 3), key=lambda r: abs(m[r][col]))
+        if abs(m[piv][col]) < 1e-30:
+            return None
+        m[col], m[piv] = m[piv], m[col]
+        for r in range(3):
+            if r != col:
+                f = m[r][col] / m[col][col]
+                m[r] = [a - f * c for a, c in zip(m[r], m[col])]
+    return [m[i][3] / m[i][i] for i in range(3)]
+
+
+def fit_cost_model(profiles: Iterable[dict],
+                   device: Optional[str] = None) -> CostModel:
+    """Calibrate a ``CostModel`` from ProfileRecords (``obs.profile``).
+
+    Coefficients come from measured walls: the dispatch floor is the
+    median measured per-dispatch cost; the per-iteration rate is a
+    3-parameter least squares over (scan steps, flops, bytes) features
+    when the profiles span enough distinct shapes, else a single measured
+    scale applied to the structured device prior.  Static ``program_cost``
+    flops/bytes captured by the profiler replace the closed-form proxy
+    for their observation.  With an empty registry the prior is returned
+    un-calibrated (``calibrated=False``)."""
+    profs = [p for p in profiles
+             if p.get("kind") == "profile" and isinstance(p.get("config"),
+                                                          dict)]
+    if device is None and profs:
+        device = profs[-1]["config"].get("device")
+    device = device or "cpu"
+    profs = [p for p in profs if p["config"].get("device") in (None, device)]
+    prior = DEFAULT_COEFFS.get(device, DEFAULT_COEFFS["cpu"])
+    model = CostModel(device=device, calibrated=False, n_profiles=len(profs),
+                      **prior)
+    if not profs:
+        return model
+
+    # Dispatch floor: median measured per-dispatch wall.
+    floors = [float(p["metrics"]["dispatch_ms_per_program"]) / 1e3
+              for p in profs
+              if isinstance(p.get("metrics", {}).get(
+                  "dispatch_ms_per_program"), (int, float))]
+    if floors:
+        model.dispatch_floor_s = max(median(floors), 0.0)
+
+    # Per-iteration observations: (features, measured iter seconds).
+    obs = []
+    for p in profs:
+        c, m = p["config"], p.get("metrics", {})
+        it_ms = m.get("sustained_ms_per_iter") or m.get("ms_per_iter_warm")
+        if not isinstance(it_ms, (int, float)) or it_ms <= 0:
+            continue
+        if not all(isinstance(c.get(x), int) for x in ("N", "T", "k")):
+            continue
+        N, T, k = c["N"], c["T"], c["k"]
+        flops, bytes_ = em_iter_work(N, T, k)
+        if isinstance(m.get("flops_per_iter"), (int, float)):
+            flops = float(m["flops_per_iter"])
+        if isinstance(m.get("bytes_per_iter"), (int, float)):
+            bytes_ = float(m["bytes_per_iter"])
+        obs.append(((float(T), flops, bytes_), float(it_ms) / 1e3,
+                    (N, T, k)))
+
+    if obs:
+        model.calibrated = True
+        coeffs = None
+        if len({shape for _, _, shape in obs}) >= 3:
+            # Enough shape diversity for a genuine 3-param fit (tiny ridge
+            # keeps the normal equations sane when features correlate).
+            A = [[0.0] * 3 for _ in range(3)]
+            rhs = [0.0] * 3
+            for f, y, _ in obs:
+                for i in range(3):
+                    rhs[i] += f[i] * y
+                    for j in range(3):
+                        A[i][j] += f[i] * f[j]
+            for i in range(3):
+                A[i][i] *= 1.0 + 1e-9
+            sol = _solve3(A, rhs)
+            if sol is not None and all(c >= 0.0 for c in sol):
+                coeffs = sol
+        if coeffs is None:
+            # Scaled prior: one measured scalar corrects the whole prior
+            # rate — robust down to a single profile.
+            def prior_it(f):
+                return (prior["step_s"] * f[0] + prior["per_flop_s"] * f[1]
+                        + prior["per_byte_s"] * f[2])
+            scale = median([y / prior_it(f) for f, y, _ in obs])
+            coeffs = [prior["step_s"] * scale, prior["per_flop_s"] * scale,
+                      prior["per_byte_s"] * scale]
+        model.step_s, model.per_flop_s, model.per_byte_s = coeffs
+
+    # Anchors + fixed overhead residual.
+    overheads = []
+    for p in profs:
+        c, m = p["config"], p.get("metrics", {})
+        plan = _profile_plan(c)
+        warm = m.get("warm_wall_s")
+        iters = c.get("iters")
+        if plan is None or not isinstance(warm, (int, float)) \
+                or not isinstance(iters, int):
+            continue
+        if not all(isinstance(c.get(x), int) for x in ("N", "T", "k")):
+            continue
+        N, T, k = c["N"], c["T"], c["k"]
+        model.anchors.append({"plan": list(plan), "N": N, "T": T, "k": k,
+                              "iters": iters,
+                              "warm_wall_s": float(warm)})
+        engine, chunk, depth, _ = plan
+        nd = model.dispatches(iters, engine=engine, chunk=chunk, depth=depth)
+        ov = (float(warm) - nd * model.dispatch_floor_s
+              - iters * model.iter_s(N, T, k))
+        overheads.append(max(ov, 0.0))
+    if overheads:
+        model.overhead_s = median(overheads)
+    return model

@@ -1,0 +1,235 @@
+"""Transfer-barriered micro-profiler: measured program profiles for the
+cost model (``python -m dfm_tpu.obs.profile --shape N,T,K``).
+
+Measures what the static ``program_cost`` numbers cannot — the REALIZED
+wall of each fit variant (chunked, pipelined, fused) at a concrete shape,
+split into the components the calibrated cost model (``obs.cost``) fits:
+
+- warm/cold walls: cold pass compiles, warm passes are a best-of-N
+  median of already-compiled fits (every wall is bounded by the fit's
+  own d2h reads — the only execution barrier on the axon tunnel, so
+  ``time.perf_counter`` around ``fit()`` measures execution, not
+  enqueue).
+- dispatch overhead vs sustained ms/iter (chunked variant): a two-point
+  iteration sweep (``iters`` vs ``2*iters``, same chunk size, so the
+  SAME executables serve both points) isolates the per-iteration slope,
+  and a chunk-halving probe (same iterations, double the dispatches)
+  isolates the per-dispatch cost; sustained = slope minus the amortized
+  dispatch share.
+- one traced pass per variant feeds dispatch counts, latency
+  percentiles, and (cost capture on) static flops/bytes into the record.
+
+Results persist as ``kind="profile"`` records in the ``.dfm_runs/``
+registry next to the bench RunRecords; ``obs.advise`` and
+``fit(auto=True)`` consume them from there.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from statistics import median
+from typing import Iterable, List, Optional, Tuple
+
+__all__ = ["profile_record", "profile_shape", "main", "PROFILE_KIND",
+           "VARIANTS"]
+
+PROFILE_KIND = "profile"
+VARIANTS = ("chunked", "pipelined", "fused")
+
+
+def profile_record(variant: str, N: int, T: int, k: int, *, iters: int,
+                   metrics: dict, chunk: Optional[int] = None,
+                   depth: Optional[int] = None,
+                   bucket: Optional[bool] = None,
+                   device: Optional[str] = None,
+                   run_id: Optional[str] = None) -> dict:
+    """Assemble one ProfileRecord (jax-free; a RunRecord with
+    ``kind="profile"`` and the plan baked into the config fingerprint)."""
+    from .store import device_kind, make_record
+    config = {"profile": str(variant), "N": int(N), "T": int(T),
+              "k": int(k), "iters": int(iters),
+              "device": device_kind(device)}
+    if chunk is not None:
+        config["chunk"] = int(chunk)
+    if depth is not None:
+        config["depth"] = int(depth)
+    if bucket is not None:
+        config["bucket"] = bool(bucket)
+    return make_record(PROFILE_KIND, config, metrics, device=device,
+                       run_id=run_id)
+
+
+def _cost_per_iter(summary: dict, program: str,
+                   iters_per_dispatch: float) -> dict:
+    c = (summary.get("costs") or {}).get(program) or {}
+    out = {}
+    if isinstance(c.get("flops"), (int, float)) and iters_per_dispatch > 0:
+        out["flops_per_iter"] = float(c["flops"]) / iters_per_dispatch
+    if isinstance(c.get("bytes_accessed"), (int, float)) \
+            and iters_per_dispatch > 0:
+        out["bytes_per_iter"] = float(c["bytes_accessed"]) / iters_per_dispatch
+    return out
+
+
+def profile_shape(N: int, T: int, k: int, *, iters: int = 24,
+                  repeats: int = 3, chunk: int = 8,
+                  variants: Iterable[str] = VARIANTS, seed: int = 0,
+                  capture_costs: bool = True,
+                  log=None) -> Tuple[List[dict], str]:
+    """Profile the fit variants at shape (N, T, k); returns
+    ``(records, device_str)`` — persisting is the caller's decision.
+
+    Probes run with the run registry masked (like ``bench.py``'s timing
+    probes): profiling must only ever APPEND profile records the caller
+    asked for, never leak per-probe fit records.
+    """
+    import numpy as np
+
+    import jax
+
+    from ..api import DynamicFactorModel, TPUBackend, fit
+    from ..backends import cpu_ref
+    from ..utils import dgp
+    from .cost import RecompileDetector
+    from .trace import Tracer
+
+    say = log or (lambda *_: None)
+    rng = np.random.default_rng(seed)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y, _ = dgp.simulate(p_true, T, rng)
+    Y = (Y - Y.mean(0)) / Y.std(0)
+    p0 = cpu_ref.pca_init(Y, k)
+    model = DynamicFactorModel(n_factors=k, standardize=False)
+    dev = jax.devices()[0]
+    device = f"{dev.platform} ({dev.device_kind})"
+
+    runs_env = os.environ.pop("DFM_RUNS", None)
+    try:
+        def timed(b, n, **kw):
+            t0 = time.perf_counter()
+            fit(model, Y, backend=b, max_iters=n, tol=0.0, init=p0, **kw)
+            return time.perf_counter() - t0
+
+        def traced(b, n, **kw):
+            tr = Tracer(capture_costs=capture_costs,
+                        detector=RecompileDetector())
+            fit(model, Y, backend=b, max_iters=n, tol=0.0, init=p0,
+                telemetry=tr, **kw)
+            return tr.summary()
+
+        records = []
+        for variant in variants:
+            if variant not in VARIANTS:
+                raise ValueError(f"unknown profile variant {variant!r} "
+                                 f"(want one of {VARIANTS})")
+            say(f"profile {variant} N={N} T={T} k={k} iters={iters} ...")
+            b = TPUBackend(fused_chunk=chunk)
+            kw = ({"fused": True} if variant == "fused"
+                  else {"pipeline": 2} if variant == "pipelined" else {})
+            cold = timed(b, iters, **kw)
+            summary = traced(b, iters, **kw)
+            warm = median(timed(b, iters, **kw) for _ in range(repeats))
+            metrics = {"cold_wall_s": cold, "warm_wall_s": warm,
+                       "ms_per_iter_warm": 1e3 * warm / iters,
+                       "dispatches": summary.get("dispatches"),
+                       "blocking_transfers":
+                           summary.get("blocking_transfers")}
+            dp = summary.get("dispatch_percentiles_ms")
+            if dp:
+                metrics["p99_dispatch_ms"] = dp["p99"]
+            if variant == "chunked":
+                # Two-point iteration sweep: same chunk size, so the same
+                # executables serve both points — the slope is pure
+                # per-iteration cost (incl. the amortized dispatch share).
+                hi = median(timed(b, 2 * iters, **kw)
+                            for _ in range(repeats))
+                slope = max((hi - warm) / iters, 1e-9)
+                # Chunk-halving probe: same iterations, ~double the
+                # dispatches — the wall delta is pure dispatch overhead.
+                c2 = max(1, chunk // 2)
+                b2 = TPUBackend(fused_chunk=c2)
+                timed(b2, iters, **kw)            # compile the c2 programs
+                half = median(timed(b2, iters, **kw)
+                              for _ in range(repeats))
+                n_lo = -(-iters // chunk)
+                extra = -(-iters // c2) - n_lo
+                disp_s = (max((half - warm) / extra, 0.0) if extra > 0
+                          else 0.0)
+                metrics.update(
+                    sustained_ms_per_iter=1e3 * max(slope - disp_s / chunk,
+                                                    1e-9),
+                    dispatch_ms_per_program=1e3 * disp_s,
+                    fit_overhead_s=max(warm - iters * slope, 0.0))
+                metrics.update(_cost_per_iter(summary, "em_fit_scan",
+                                              chunk))
+            elif variant == "fused":
+                metrics["dispatches_per_fit"] = summary.get("dispatches")
+                metrics.update(_cost_per_iter(summary, "fused_fit", iters))
+            metrics = {k_: v for k_, v in metrics.items() if v is not None}
+            records.append(profile_record(
+                variant, N, T, k, iters=iters, chunk=chunk,
+                depth=2 if variant == "pipelined" else None,
+                metrics=metrics, device=device))
+            say(f"  warm {warm:.3f}s ({1e3 * warm / iters:.2f} ms/iter), "
+                f"cold {cold:.3f}s")
+        return records, device
+    finally:
+        if runs_env is not None:
+            os.environ["DFM_RUNS"] = runs_env
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dfm_tpu.obs.profile",
+        description="Measure per-variant fit walls at a shape and persist "
+                    "ProfileRecords for the calibrated cost model.")
+    ap.add_argument("--shape", required=True, metavar="N,T,K",
+                    help="panel shape to profile")
+    ap.add_argument("--iters", type=int, default=24)
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="warm passes per measurement (median)")
+    ap.add_argument("--chunk", type=int, default=8,
+                    help="fused_chunk for the chunked/fused variants")
+    ap.add_argument("--variants", default=",".join(VARIANTS),
+                    help=f"comma list from {VARIANTS}")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--runs", default=None,
+                    help="registry dir (default: DFM_RUNS or .dfm_runs)")
+    ap.add_argument("--no-costs", action="store_true",
+                    help="skip the static program_cost capture pass")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the ProfileRecords as JSON on stdout")
+    args = ap.parse_args(argv)
+    try:
+        N, T, k = (int(x) for x in args.shape.split(","))
+    except ValueError:
+        print(f"error: --shape wants N,T,K, got {args.shape!r}",
+              file=sys.stderr)
+        return 2
+
+    from .store import RunStore, runs_dir
+    d = runs_dir(args.runs)
+    say = lambda *a: print(*a, file=sys.stderr)  # noqa: E731
+    records, device = profile_shape(
+        N, T, k, iters=args.iters, repeats=args.repeats, chunk=args.chunk,
+        variants=[v for v in args.variants.split(",") if v],
+        seed=args.seed, capture_costs=not args.no_costs, log=say)
+    if d is not None:
+        store = RunStore(d)
+        for rec in records:
+            store.append(rec)
+        say(f"recorded {len(records)} profile(s) for {device} in {d}")
+    else:
+        say("run registry disabled (DFM_RUNS=\"\"): profiles not persisted")
+    if args.json:
+        json.dump(records, sys.stdout, indent=2, default=str)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

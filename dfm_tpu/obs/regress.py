@@ -77,6 +77,14 @@ def record_from_trace_summary(summary: Dict[str, Any], *,
     for k, v in (summary.get("phases") or {}).items():
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             metrics[k] = float(v)
+    # Latency-percentile + advisor-drift metrics (PR 7): both
+    # lower-is-better with their own noise floors (store.py).
+    p99 = (summary.get("dispatch_percentiles_ms") or {}).get("p99")
+    if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+        metrics["p99_dispatch_ms"] = float(p99)
+    rel = (summary.get("advice") or {}).get("rel_err")
+    if isinstance(rel, (int, float)) and not isinstance(rel, bool):
+        metrics["advice_rel_err"] = float(rel)
     rec: Dict[str, Any] = {
         "run_id": source, "kind": "trace", "source": source,
         "config": {"kind": "trace"}, "fingerprint": "kind=trace",

@@ -15,6 +15,11 @@ What it computes from the event stream (schema: ``obs/trace.py``):
 - the convergence curve: per-chunk logliks, deltas vs the noise floor
 - per-problem freezes (batched engine) and health events
 - static flops/bytes per program when cost capture was on
+- p50/p90/p99 dispatch walls (all spans + per-program end-to-end), and
+  the advisor's predicted-vs-realized wall when ``fit(auto=True)`` ran
+
+``--chrome out.json`` additionally exports the raw event stream to
+Chrome/Perfetto trace-event format for visual pipeline inspection.
 """
 
 from __future__ import annotations
@@ -24,7 +29,7 @@ import json
 import sys
 from typing import List, Union
 
-__all__ = ["load", "summarize", "main"]
+__all__ = ["load", "summarize", "to_chrome", "main"]
 
 
 def load(path: str) -> List[dict]:
@@ -52,13 +57,23 @@ def load(path: str) -> List[dict]:
     return events
 
 
+def _pct(xs: List[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list (the 1e-9 nudge
+    keeps float fuzz like 0.9*10 == 9.000000000000002 from bumping the
+    rank)."""
+    import math
+    rank = max(1, math.ceil(q * len(xs) - 1e-9))
+    return xs[min(len(xs) - 1, rank - 1)]
+
+
 def _stats(xs: List[float]) -> dict:
     if not xs:
         return {}
     xs = sorted(xs)
     n = len(xs)
     return {"n": n, "min": xs[0], "max": xs[-1],
-            "mean": sum(xs) / n, "p50": xs[n // 2]}
+            "mean": sum(xs) / n, "p50": _pct(xs, 0.50),
+            "p90": _pct(xs, 0.90), "p99": _pct(xs, 0.99)}
 
 
 def summarize(events_or_path: Union[str, List[dict]]) -> dict:
@@ -115,6 +130,10 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
             entry["first_call_s"] = _stats(p["first_durs"])
         if p["steady_durs"]:
             entry["steady_s"] = _stats(p["steady_durs"])
+        if p["barrier_durs"]:
+            # End-to-end walls: spans the host actually waited out (d2h
+            # barrier inside the span) — the serving-latency view.
+            entry["e2e_s"] = _stats(p["barrier_durs"])
         # Compile proxy: how much slower the first call ran than steady state.
         if p["first_durs"] and p["steady_durs"]:
             entry["compile_proxy_s"] = (max(p["first_durs"])
@@ -196,6 +215,23 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
         fused = sum(int(e.get("n_iters") or 1) for e in disp
                     if e.get("barrier"))
         out["amortized_ms_per_iter"] = 1e3 * sum(walls) / max(fused, 1)
+    # Latency percentiles over ALL timed dispatch spans (barrier'd or
+    # enqueue-only) — the p50/p90/p99 the serving path will be scored on.
+    all_durs = [float(e["dur"]) for e in disp if e.get("dur") is not None]
+    if all_durs:
+        st = _stats(all_durs)
+        out["dispatch_percentiles_ms"] = {
+            "p50": 1e3 * st["p50"], "p90": 1e3 * st["p90"],
+            "p99": 1e3 * st["p99"], "n": st["n"]}
+    # Auto-tuning advisor: the last advice event wins (one per fit(auto=
+    # True)); predicted-vs-realized wall is the model-drift metric that
+    # obs.regress gates as ``advice_rel_err``.
+    advice_evs = [e for e in events if e.get("kind") == "advice"]
+    if advice_evs:
+        out["advice"] = {k: v for k, v in advice_evs[-1].items()
+                         if k not in ("kind", "t")}
+        if len(advice_evs) > 1:
+            out["advice"]["n_events"] = len(advice_evs)
     # Total wall + per-phase breakdown: dispatch (device walls measured
     # behind a barrier or async enqueue), transfer (h2d/d2h walls), host
     # (everything else — python driver, numpy, event emission).
@@ -241,6 +277,11 @@ def _print_text(s: dict) -> None:
         print(f"amortized tunnel latency: "
               f"{s['amortized_ms_per_iter']:.2f} ms/iter "
               f"(barrier'd wall / fused iters)")
+    dp = s.get("dispatch_percentiles_ms")
+    if dp:
+        print(f"dispatch walls: p50 {dp['p50']:.2f} ms, "
+              f"p90 {dp['p90']:.2f} ms, p99 {dp['p99']:.2f} ms "
+              f"(n={dp['n']})")
     if "wall_s" in s:
         ph = s.get("phases", {})
         print(f"wall: {_fmt_s(s['wall_s'])} "
@@ -316,6 +357,79 @@ def _print_text(s: dict) -> None:
         bits = [f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in f.items() if k != "t"]
         print(f"  fit: {' '.join(bits)}")
+    a = s.get("advice")
+    if a:
+        pred, real = a.get("predicted_wall_s"), a.get("realized_wall_s")
+        line = f"advice: {a.get('engine', '?')} plan"
+        if a.get("engine") == "fused" and a.get("fused_chunk") is not None:
+            line += f" (fused_chunk={a['fused_chunk']})"
+        elif a.get("depth") is not None:
+            line += (f" (depth={a['depth']}"
+                     f"{', bucket' if a.get('bucket') else ''})")
+        if isinstance(pred, (int, float)):
+            line += f", predicted {_fmt_s(float(pred))}"
+        if isinstance(real, (int, float)):
+            line += f", realized {_fmt_s(float(real))}"
+        if isinstance(a.get("rel_err"), (int, float)):
+            line += f", prediction error {100 * float(a['rel_err']):.0f}%"
+        print(line)
+
+
+_DEVICE_PID, _HOST_PID = 0, 1
+
+
+def to_chrome(events: List[dict]) -> dict:
+    """Convert an event stream to Chrome/Perfetto trace-event format
+    (load the result in chrome://tracing or ui.perfetto.dev): dispatch
+    spans land on a "device" track (one thread lane per program, so
+    pipeline overlap is visible as stacked in-flight spans), transfers
+    and host-side markers (chunk checks, fit/advice, health) on a "host"
+    track.  Timestamps are rebased to the first event; ts/dur in µs."""
+    timed = [e for e in events if isinstance(e.get("t"), (int, float))]
+    if not timed:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(float(e["t"]) for e in timed)
+    us = lambda t: 1e6 * (float(t) - t0)  # noqa: E731
+
+    tids: dict = {}
+
+    def tid(pid: int, lane: str) -> int:
+        return tids.setdefault((pid, lane), len(
+            [k for k in tids if k[0] == pid]))
+
+    out = []
+    _skip = ("t", "kind", "dur", "program")
+    for e in timed:
+        kind = e.get("kind")
+        args = {k: v for k, v in e.items() if k not in _skip
+                and v is not None}
+        if kind == "dispatch":
+            name = e.get("program", "?")
+            out.append({"name": name, "ph": "X", "ts": us(e["t"]),
+                        "dur": 1e6 * float(e.get("dur") or 0.0),
+                        "pid": _DEVICE_PID, "tid": tid(_DEVICE_PID, name),
+                        "cat": "dispatch", "args": args})
+        elif kind == "transfer":
+            name = ("transfer (blocking)" if e.get("blocking")
+                    else "transfer")
+            out.append({"name": name, "ph": "X", "ts": us(e["t"]),
+                        "dur": 1e6 * float(e.get("dur") or 0.0),
+                        "pid": _HOST_PID, "tid": tid(_HOST_PID, "transfer"),
+                        "cat": "transfer", "args": args})
+        else:
+            # Host-side markers: convergence checks, fit/advice summaries,
+            # cost captures, health — instants on their own host lane.
+            out.append({"name": str(kind), "ph": "i", "s": "t",
+                        "ts": us(e["t"]), "pid": _HOST_PID,
+                        "tid": tid(_HOST_PID, str(kind)),
+                        "cat": str(kind), "args": args})
+    meta = [{"ph": "M", "name": "process_name", "pid": _DEVICE_PID,
+             "args": {"name": "device (dispatch spans)"}},
+            {"ph": "M", "name": "process_name", "pid": _HOST_PID,
+             "args": {"name": "host (transfers + checks)"}}]
+    meta += [{"ph": "M", "name": "thread_name", "pid": pid, "tid": t,
+              "args": {"name": lane}} for (pid, lane), t in tids.items()]
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
 
 
 def main(argv=None) -> int:
@@ -325,12 +439,22 @@ def main(argv=None) -> int:
     ap.add_argument("trace", help="path to a trace.jsonl file")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object")
+    ap.add_argument("--chrome", default=None, metavar="OUT.json",
+                    help="also export the trace to Chrome/Perfetto "
+                         "trace-event format (chrome://tracing, "
+                         "ui.perfetto.dev)")
     ap.add_argument("--diff", default=None, metavar="RUN|FILE",
                     help="diff this trace against a baseline (another "
                          "trace.jsonl, a RunRecord/bench JSON file, or a "
                          "registry run_id) via obs.regress; exits nonzero "
                          "on a perf/convergence regression")
     args = ap.parse_args(argv)
+    if args.chrome is not None:
+        trace = to_chrome(load(args.trace))
+        with open(args.chrome, "w", encoding="utf-8") as fh:
+            json.dump(trace, fh, default=str)
+        n = sum(1 for e in trace["traceEvents"] if e.get("ph") != "M")
+        print(f"chrome trace: {n} events -> {args.chrome}", file=sys.stderr)
     s = summarize(args.trace)
     if args.diff is not None:
         return _diff(s, args.trace, args.diff, as_json=args.json)

@@ -171,10 +171,20 @@ def test_headline_bench_prints_one_json_line_with_telemetry(tmp_path):
     assert n_disp == out["dispatches"]
     # run_id + registry append (ISSUE 4), and the recorded run passes the
     # regression gate against itself-in-history trivially (nothing gated
-    # on the first same-fingerprint run).
+    # on the first same-fingerprint run).  Since ISSUE 7 the bench also
+    # seeds the advisor's calibration set: one profile record per variant
+    # rides along in the same registry.
     from dfm_tpu.obs.store import RunStore
-    (rec,) = RunStore(str(runs)).load()
+    recs = RunStore(str(runs)).load()
+    (rec,) = [r for r in recs if r["kind"] == "bench"]
     assert rec["run_id"] == out["run_id"]
+    profiles = [r for r in recs if r["kind"] == "profile"]
+    assert {p["config"]["profile"] for p in profiles} == \
+        {"chunked", "pipelined", "fused"}
+    # ... which is exactly what lets the in-bench fit(auto=True) produce
+    # a calibrated advice line (ISSUE 7 satellite).
+    assert out["advice_rel_err"] is not None
+    assert out["p99_dispatch_ms"] is not None
     gate = subprocess.run(
         [sys.executable, "-m", "dfm_tpu.obs.regress", out["run_id"]],
         cwd=repo, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,

@@ -1,0 +1,191 @@
+"""Micro-profiler (``obs.profile``), Chrome trace export
+(``obs.report --chrome``), latency percentiles, and the fused-path cost
+capture — on the fake 8-device mesh (conftest).
+
+The round-trip contract under test (ISSUE 7 satellites 1–2): a depth-2
+pipelined trace survives ``to_chrome`` with one "X" span per dispatch on
+the device track and blocking transfers on the host track; the fused
+path reports ``maybe_cost``/recompile telemetry, and the donated warm
+twin is the SAME logical program as the cold fit (no recompile).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dfm_tpu.api import DynamicFactorModel, TPUBackend, fit
+from dfm_tpu.obs import Tracer, summarize
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.profile import VARIANTS, profile_shape
+from dfm_tpu.obs.report import to_chrome
+from dfm_tpu.utils import dgp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, T, K = 16, 40, 2
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(7)
+    p = dgp.dfm_params(N, K, rng)
+    Y, _ = dgp.simulate(p, T, rng)
+    return (Y - Y.mean(0)) / Y.std(0)
+
+
+# -- profiler ----------------------------------------------------------------
+
+def test_profile_shape_measures_all_variants(monkeypatch, tmp_path):
+    # profile_shape masks DFM_RUNS itself; set one to prove it is restored
+    # and that the probes never leak fit records into it.
+    monkeypatch.setenv("DFM_RUNS", str(tmp_path / "masked"))
+    records, device = profile_shape(N, T, K, iters=8, repeats=1)
+    assert os.environ["DFM_RUNS"] == str(tmp_path / "masked")
+    assert not (tmp_path / "masked").exists()
+    assert device.startswith("cpu")
+    assert [r["config"]["profile"] for r in records] == list(VARIANTS)
+    by = {r["config"]["profile"]: r for r in records}
+    for variant, rec in by.items():
+        assert rec["kind"] == "profile"
+        cfg = rec["config"]
+        assert (cfg["N"], cfg["T"], cfg["k"]) == (N, T, K)
+        assert cfg["device"] == "cpu" and cfg["chunk"] == 8
+        m = rec["metrics"]
+        assert m["warm_wall_s"] > 0 and m["cold_wall_s"] > 0
+        assert m["ms_per_iter_warm"] == pytest.approx(
+            1e3 * m["warm_wall_s"] / 8)
+        assert m["dispatches"] >= 1
+    assert by["pipelined"]["config"]["depth"] == 2
+    m = by["chunked"]["metrics"]
+    assert m["sustained_ms_per_iter"] > 0
+    assert m["dispatch_ms_per_program"] >= 0
+    assert m["flops_per_iter"] > 0          # capture_costs fed the record
+    assert by["fused"]["metrics"]["dispatches_per_fit"] >= 1
+    assert by["fused"]["metrics"]["flops_per_iter"] > 0
+
+
+def test_profile_shape_rejects_unknown_variant():
+    with pytest.raises(ValueError, match="unknown profile variant"):
+        profile_shape(N, T, K, iters=4, repeats=1, variants=["turbo"])
+
+
+def test_profile_cli_persists_records(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.profile", "--shape",
+         f"{N},{T},{K}", "--iters", "6", "--repeats", "1",
+         "--variants", "chunked,fused", "--json"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env=dict(os.environ, DFM_RUNS=str(tmp_path),
+                 JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr
+    recs = json.loads(out.stdout)
+    assert [r["config"]["profile"] for r in recs] == ["chunked", "fused"]
+    from dfm_tpu.obs import store as obs_store
+    persisted = obs_store.RunStore(str(tmp_path)).load()
+    assert [r["config"]["profile"] for r in persisted
+            if r["kind"] == "profile"] == ["chunked", "fused"]
+
+
+# -- latency percentiles -----------------------------------------------------
+
+def test_summarize_dispatch_percentiles_exact():
+    evs = [{"kind": "dispatch", "program": "p", "t": float(i),
+            "dur": 0.001 * (i + 1)} for i in range(10)]
+    s = summarize(evs)
+    dp = s["dispatch_percentiles_ms"]
+    assert dp["n"] == 10
+    # Nearest-rank over durations 1..10 ms.
+    assert dp["p50"] == pytest.approx(5.0)
+    assert dp["p90"] == pytest.approx(9.0)
+    assert dp["p99"] == pytest.approx(10.0)
+    assert s["programs"]["p"]["steady_s"]["p99"] == pytest.approx(0.010)
+
+
+def test_summarize_e2e_percentiles_from_barriers():
+    evs = [{"kind": "dispatch", "program": "p", "t": 0.0, "dur": 0.5,
+            "barrier": True},
+           {"kind": "dispatch", "program": "p", "t": 0.6, "dur": 0.7,
+            "barrier": True}]
+    s = summarize(evs)
+    e2e = s["programs"]["p"]["e2e_s"]
+    assert e2e["n"] == 2
+    assert e2e["p99"] == pytest.approx(0.7)
+
+
+# -- Chrome export -----------------------------------------------------------
+
+def test_chrome_roundtrip_depth2_pipelined_trace(panel, tmp_path):
+    tr = Tracer()
+    b = TPUBackend(dtype=jnp.float64, filter="info")
+    fit(DynamicFactorModel(n_factors=K), panel, backend=b, max_iters=12,
+        tol=1e-8, pipeline=2, telemetry=tr)
+    trace_path = tmp_path / "trace.jsonl"
+    with open(trace_path, "w") as fh:
+        for e in tr.events:
+            fh.write(json.dumps(e, default=str) + "\n")
+
+    chrome = to_chrome(tr.events)
+    evs = chrome["traceEvents"]
+    dispatches = [e for e in tr.events if e.get("kind") == "dispatch"]
+    spans = [e for e in evs if e.get("ph") == "X"]
+    dev_spans = [e for e in spans if e["pid"] == 0]
+    assert len(dev_spans) == len(dispatches)      # one span per dispatch
+    assert all(e["cat"] == "dispatch" for e in dev_spans)
+    assert {e["name"] for e in dev_spans} == \
+        {e["program"] for e in dispatches}
+    # Blocking transfers land on the host track, flagged by name.
+    host_spans = [e for e in spans if e["pid"] == 1]
+    assert any(e["name"] == "transfer (blocking)" for e in host_spans)
+    # Timestamps are rebased and non-negative; durations in µs.
+    assert min(e["ts"] for e in spans) >= 0.0
+    assert all(e["dur"] >= 0.0 for e in spans)
+    # Both process tracks are named, plus one thread lane per program.
+    meta = [e for e in evs if e.get("ph") == "M"]
+    assert {m["pid"] for m in meta if m["name"] == "process_name"} == {0, 1}
+    lanes = {m["args"]["name"] for m in meta if m["name"] == "thread_name"}
+    assert {e["program"] for e in dispatches} <= lanes
+
+    # CLI round-trip: --chrome writes the same export, summary still prints.
+    out_json = tmp_path / "chrome.json"
+    cli = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.report", str(trace_path),
+         "--chrome", str(out_json)],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert cli.returncode == 0, cli.stderr
+    loaded = json.loads(out_json.read_text())
+    assert loaded["displayTimeUnit"] == "ms"
+    reload_spans = [e for e in loaded["traceEvents"]
+                    if e.get("ph") == "X" and e.get("pid") == 0]
+    assert len(reload_spans) == len(dev_spans)
+    assert "dispatch walls" in cli.stdout
+
+
+def test_chrome_empty_trace():
+    assert to_chrome([]) == {"traceEvents": [], "displayTimeUnit": "ms"}
+
+
+# -- fused cost capture + recompile telemetry (satellite 2) ------------------
+
+def test_fused_cost_captured_and_warm_twin_same_program(panel):
+    tr = Tracer(capture_costs=True, detector=RecompileDetector())
+    b = TPUBackend(dtype=jnp.float64, filter="info")
+    model = DynamicFactorModel(n_factors=K)
+    cold = fit(model, panel, backend=b, max_iters=12, tol=1e-8, fused=True,
+               telemetry=tr)
+    fit(model, panel, backend=b, max_iters=12, tol=1e-8, fused=True,
+        warm_start=cold, telemetry=tr)
+    s = tr.summary()
+    # maybe_cost fed static flops/bytes for the fused program.
+    assert s["costs"]["fused_fit"]["flops"] > 0
+    assert s["costs"]["fused_fit"]["bytes_accessed"] > 0
+    # The donated warm twin is the SAME logical program: two dispatches,
+    # one first_call, zero recompiles.
+    prog = s["programs"]["fused_fit"]
+    assert prog["dispatches"] == 2
+    assert prog["first_calls"] == 1
+    assert prog.get("recompiles", 0) == 0

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# One-command advisor check: profile a smoke shape into a scratch
+# registry -> rank plans with the calibrated model -> run a traced
+# fit(auto=True) -> assert the advice event exists and the predicted
+# wall landed within 50% of the realized one.  The quick answer to "is
+# the measurement-to-decision loop still closed".
+#
+# Usage (from the repo root):
+#   tools/advise_smoke.sh [trace_path]       # default /tmp/dfm_advise.jsonl
+#
+# The profile registry is a scratch dir (/tmp/dfm_advise_runs, wiped at
+# start) so the run is self-contained and deterministic; JAX_PLATFORMS
+# defaults to cpu so this never burns real-device time.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRACE="${1:-/tmp/dfm_advise.jsonl}"
+RUNS="${DFM_ADVISE_RUNS:-/tmp/dfm_advise_runs}"
+rm -f "$TRACE"
+rm -rf "$RUNS"
+export DFM_RUNS="$RUNS"
+
+SHAPE="60,80,2"
+ITERS=24
+
+echo "--- profile $SHAPE -> $RUNS ---" >&2
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" \
+    python -m dfm_tpu.obs.profile --shape "$SHAPE" --iters "$ITERS" \
+    --repeats 3
+
+echo "--- advise $SHAPE ---" >&2
+python -m dfm_tpu.obs.advise --shape "$SHAPE" --max-iters "$ITERS"
+
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" python - "$TRACE" "$ITERS" <<'PY'
+import sys
+
+import numpy as np
+
+from dfm_tpu.api import DynamicFactorModel, TPUBackend, fit
+from dfm_tpu.utils import dgp
+
+iters = int(sys.argv[2])
+rng = np.random.default_rng(0)
+p_true = dgp.dfm_params(60, 2, rng)
+Y, _ = dgp.simulate(p_true, 80, rng)
+
+model = DynamicFactorModel(n_factors=2)
+b = TPUBackend()
+# Warm-up pass compiles whatever plan the advisor picks; the traced pass
+# is then a warm fit, comparable to the profiler's warm medians.
+fit(model, Y, backend=b, max_iters=iters, tol=0.0, auto=True)
+r = fit(model, Y, backend=b, max_iters=iters, tol=0.0, auto=True,
+        telemetry=sys.argv[1])
+a = r.advice or {}
+print(f"auto fit: engine={a.get('engine')} "
+      f"predicted={a.get('predicted_wall_s', float('nan')):.3f}s "
+      f"realized={a.get('realized_wall_s', float('nan')):.3f}s "
+      f"rel_err={a.get('rel_err', float('nan')):.2f}")
+PY
+
+echo "--- advise smoke gate ($TRACE) ---"
+python -m dfm_tpu.obs.report "$TRACE"
+python -m dfm_tpu.obs.report "$TRACE" --json | python -c '
+import json, sys
+s = json.load(sys.stdin)
+a = s.get("advice")
+assert a, "advise smoke FAILED: no advice event in the trace"
+rel = a.get("rel_err")
+assert rel is not None and rel < 0.5, (
+    f"advise smoke FAILED: prediction error {rel} >= 50%")
+dp = s.get("dispatch_percentiles_ms")
+assert dp and dp.get("p99") is not None, (
+    "advise smoke FAILED: no dispatch percentiles in the summary")
+engine, p99 = a.get("engine"), dp["p99"]
+print(f"advise smoke OK: {engine} plan, prediction error "
+      f"{100 * rel:.0f}%, p99 dispatch {p99:.2f} ms")'

@@ -38,15 +38,17 @@ echo "$OUT"
 RUN_ID=$(printf '%s' "$OUT" | python -c \
     'import json,sys; print(json.loads(sys.stdin.readline())["run_id"])')
 
-# The pipelined-dispatch and fused-fit metrics must be present in the
-# bench line (and therefore in the recorded run, where obs.regress gates
-# them: the e2e/fused rates as higher-is-better, blocking_transfers and
-# dispatches_per_fit as lower-is-better).
+# The pipelined-dispatch, fused-fit, and advisor metrics must be present
+# in the bench line (and therefore in the recorded run, where obs.regress
+# gates them: the e2e/fused rates as higher-is-better; blocking_transfers,
+# dispatches_per_fit, p99_dispatch_ms and advice_rel_err as lower-is-
+# better — the last two with their own noise floors, see obs/store.py).
 printf '%s' "$OUT" | python -c '
 import json, sys
 d = json.loads(sys.stdin.readline())
 missing = [k for k in ("e2e_warm_fit_iters_per_sec", "blocking_transfers",
-                       "e2e_fused_fit_iters_per_sec", "dispatches_per_fit")
+                       "e2e_fused_fit_iters_per_sec", "dispatches_per_fit",
+                       "p99_dispatch_ms", "advice_rel_err")
            if d.get(k) is None]
 sys.exit(f"perf_gate: bench line missing {missing}" if missing else 0)'
 
