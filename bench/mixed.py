@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Mixed-shape multi-tenant benchmark: aggregate EM iters/sec for a
+heterogeneous (N, T, k) job mix through the shape-bucketed scheduler
+(``dfm_tpu.fit_jobs`` — one fused batched program per bucket) vs the
+loop-over-fits baseline (one ``api.fit`` per job — the only option before
+``sched/``, paying the ~60-100 ms tunnel dispatch stream PER job).
+Prints exactly ONE JSON line to stdout:
+
+    {"metric": ..., "value": N, "unit": "iters/sec",
+     "aggregate_mixed_iters_per_sec": N, "pad_waste_frac": N,
+     "scheduler_overhead_ms": N, "speedup_vs_looped": N, ...}
+
+``value`` is the scheduler's DISPATCH-INCLUSIVE aggregate rate (total
+EM iterations across all jobs / wall) — dispatch amortization across
+tenants is exactly what the scheduler buys.  ``pad_waste_frac`` is the
+bucket plan's padded-flop waste, ``scheduler_overhead_ms`` the host-side
+plan+pack+slice cost (wall minus in-bucket compute).
+
+Run on the real chip: ``python -m bench.mixed``.  Smoke-size via
+DFM_BENCH_MIX ("N,T,KxC;..." job groups, default 3 shape groups x 4),
+DFM_BENCH_ITERS, DFM_BENCH_SCHED_BACKEND (tpu|sharded), DFM_BENCH_CHUNK.
+Diagnostics on stderr.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _parse_mix(spec):
+    """Same grammar as ``obs.advise --jobs``: N,T,K[xC] joined by ';'."""
+    shapes = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        mult = 1
+        if "x" in part.rsplit(",", 1)[-1]:
+            part, m = part.rsplit("x", 1)
+            mult = int(m)
+        N, T, k = (int(x) for x in part.split(","))
+        shapes.extend([(N, T, k)] * mult)
+    return shapes
+
+
+def main():
+    mix = os.environ.get("DFM_BENCH_MIX",
+                         "20,64,2x12;14,40,1x12;26,96,2x12")
+    n_iters = int(os.environ.get("DFM_BENCH_ITERS", 20))
+    backend = os.environ.get("DFM_BENCH_SCHED_BACKEND", "tpu")
+    chunk = int(os.environ.get("DFM_BENCH_CHUNK", n_iters))
+    max_buckets = int(os.environ.get("DFM_BENCH_MAX_BUCKETS", 3))
+    shapes = _parse_mix(mix)
+    n_jobs = len(shapes)
+
+    import jax
+    jax.config.update("jax_enable_x64", True)  # f64 loglik assembly
+    import jax.numpy as jnp
+
+    from dfm_tpu import DynamicFactorModel, Job, TPUBackend, fit, fit_jobs
+    from dfm_tpu.obs.trace import Tracer, activate, current_tracer
+    from dfm_tpu.utils import dgp
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}); {n_jobs} jobs "
+        f"[{mix}], {n_iters} iters each, backend={backend}, chunk={chunk}")
+
+    # One DGP panel per job at its own shape; tol=0 pins every tenant to
+    # exactly n_iters EM iterations, so both sides time identical work.
+    dtype = jnp.float32
+    jobs = []
+    for i, (N, T, k) in enumerate(shapes):
+        rng = np.random.default_rng(2000 + i)
+        p_true = dgp.dfm_params(N, k, rng)
+        Y, _ = dgp.simulate(p_true, T, rng)
+        jobs.append(Job(Y=Y, model=DynamicFactorModel(n_factors=k),
+                        tenant=f"job{i}", max_iters=n_iters, tol=0.0))
+    total_iters = n_jobs * n_iters
+
+    tracer = current_tracer()
+    if tracer is None:
+        tracer = Tracer()
+
+    last_stats = {}
+
+    def run_sched():
+        last_stats.clear()
+        fit_jobs(jobs, backend=backend, max_buckets=max_buckets,
+                 dtype=dtype, fused_chunk=chunk, stats=last_stats)
+
+    # Baseline: one api.fit per job — a shared backend instance so the
+    # loop reuses compiled programs across same-shaped jobs (the best a
+    # non-batched caller can do).  filter="info" matches the scheduler's
+    # engine; telemetry hard-off keeps the loop lean.
+    be = TPUBackend(dtype=dtype, filter="info", fused_chunk=chunk)
+
+    def run_looped():
+        for job in jobs:
+            fit(job.model, job.Y, backend=be, max_iters=n_iters, tol=0.0,
+                telemetry=False)
+
+    def timed(f, reps=3):
+        f()  # warm-up / compile
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    with activate(tracer), jax.default_matmul_precision("highest"):
+        t_s = timed(run_sched)
+        agg = total_iters / t_s
+        waste = float(last_stats.get("pad_waste_frac", 0.0))
+        overhead_ms = 1e3 * max(t_s - float(last_stats.get("compute_s",
+                                                           0.0)), 0.0)
+        log(f"scheduler: {t_s:.3f} s ({agg:.1f} agg iters/sec, "
+            f"{last_stats.get('n_buckets')} buckets "
+            f"{last_stats.get('bucket_dims')}, pad waste "
+            f"{100 * waste:.1f}%, overhead {overhead_ms:.1f} ms)")
+
+        t_l = timed(run_looped, reps=2)
+        agg_l = total_iters / t_l
+        log(f"looped:    {t_l:.3f} s ({agg_l:.1f} agg iters/sec); "
+            f"speedup {t_l / t_s:.2f}x")
+
+    ts_sum = tracer.summary()
+    log(f"telemetry: {ts_sum['dispatches']} dispatches, "
+        f"{ts_sum['recompiles']} recompiles"
+        + (f" -> {tracer.path}" if tracer.path else ""))
+
+    from dfm_tpu.obs.store import new_run_id
+    payload = {
+        "metric": f"mixed_sched_agg_iters_per_sec_{n_jobs}jobs",
+        "value": round(agg, 2),
+        "unit": "iters/sec",
+        "value_definition": ("aggregate dispatch-inclusive EM iterations "
+                             "per second across a mixed-shape job mix "
+                             "(total iters / scheduler wall), one fused "
+                             "batched program per shape bucket"),
+        "aggregate_mixed_iters_per_sec": round(agg, 2),
+        "pad_waste_frac": round(waste, 4),
+        "scheduler_overhead_ms": round(overhead_ms, 2),
+        "speedup_vs_looped": round(t_l / t_s, 2),
+        "looped_agg_iters_per_sec": round(agg_l, 2),
+        "n_jobs": n_jobs,
+        "n_iters": n_iters,
+        "n_buckets": last_stats.get("n_buckets"),
+        "mix": mix,
+        "sched_backend": backend,
+        "dispatches": ts_sum["dispatches"],
+        "recompiles": ts_sum["recompiles"],
+        "run_id": new_run_id(),
+    }
+    print(json.dumps(payload))
+    _record_run(payload, dev)
+
+
+def _record_run(payload, dev):
+    """Append this run to the perf-observatory registry (obs.store);
+    stderr-only diagnostics, same contract as bench.py."""
+    from dfm_tpu.obs import store as obs_store
+    d = obs_store.runs_dir()
+    if d is None:
+        return
+    try:
+        rec = obs_store.record_from_bench_json(
+            payload, device=f"{dev.platform} ({dev.device_kind})",
+            kind="bench_mixed")
+        obs_store.RunStore(d).append(rec)
+        log(f"run {payload['run_id']} recorded in {d}/")
+    except Exception as e:  # registry failure must not fail the bench
+        log(f"WARNING: run registry append failed: {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,13 +9,14 @@ seam, with a NumPy float64 reference backend and a TPU-first execution path
 mesh).
 """
 
-from .api import (DynamicFactorModel, FitResult, fit, forecast,
+from .api import (DynamicFactorModel, FitResult, fit, fit_jobs, forecast,
                   Backend, CPUBackend, TPUBackend, ShardedBackend,
                   register_backend, get_backend)
 from .estim.select import (bai_ng_ic, select_n_factors, select_n_factors_em,
                            targeted_predictors)
 from .estim.evaluate import oos_evaluate
 from .estim.batched import DFMBatchSpec, BatchFitResult, fit_many
+from .sched import Job, JobResult
 
 __version__ = "0.1.0"
 
@@ -26,5 +27,6 @@ __all__ = [
     "bai_ng_ic", "select_n_factors", "select_n_factors_em",
     "targeted_predictors", "oos_evaluate",
     "DFMBatchSpec", "BatchFitResult", "fit_many",
+    "fit_jobs", "Job", "JobResult",
     "__version__",
 ]

@@ -27,7 +27,7 @@ from .pipeline import compile_cache_entries, setup_compile_cache
 from .utils.data import Standardizer, build_mask, standardize
 
 __all__ = [
-    "DynamicFactorModel", "FitResult", "fit", "forecast",
+    "DynamicFactorModel", "FitResult", "fit", "fit_jobs", "forecast",
     "Backend", "CPUBackend", "TPUBackend", "ShardedBackend",
     "register_backend", "get_backend",
 ]
@@ -1162,6 +1162,48 @@ def _maybe_record_fit_run(res: "FitResult", Y, wall: float) -> None:
         import warnings
         warnings.warn(f"DFM_RUNS append failed: {e}", RuntimeWarning,
                       stacklevel=2)
+
+
+def fit_jobs(jobs, *, backend: str = "tpu", max_buckets: int = 3,
+             dtype=None, fused_chunk: int = 8,
+             n_devices: Optional[int] = None, robust=True, pipeline=None,
+             cost_model=None, telemetry=None, stats: Optional[dict] = None):
+    """Fit heterogeneous (N, T, k) jobs as shape-bucketed fused batches.
+
+    The multi-tenant seam over ``sched.submit``: each element of ``jobs``
+    is a ``dfm_tpu.sched.Job`` (panel + model + per-tenant ``max_iters``/
+    ``tol``), assigned by the cost-model bucket planner to one of at most
+    ``max_buckets`` padded shapes, and every bucket runs as ONE fused
+    batched program (per-tenant convergence freezes inside).  Returns
+    per-tenant ``JobResult``s in submit order; each ``.fit`` is a full
+    ``FitResult`` numerically identical to ``fit()`` of that job alone
+    (x64 bit-exact, f32 within tolerance — pinned by tests/test_sched.py).
+
+    backend: "tpu" (single-device fused batches) or "sharded" (bucket
+    batch axes split across the mesh).  ``telemetry`` as in ``fit``;
+    traced runs emit one ``tenant`` event per job (queue wait / compute /
+    pad waste — ``obs.report`` renders the per-tenant table) and the
+    summary attaches to every ``JobResult.telemetry``.  ``stats`` (a
+    dict) receives plan/pack/compute accounting for benches.
+    """
+    from .sched import submit as _submit
+    tracer, owned = fit_tracer(telemetry)
+    try:
+        with activate(tracer):
+            results = _submit(jobs, backend=backend,
+                              max_buckets=max_buckets, dtype=dtype,
+                              fused_chunk=fused_chunk, n_devices=n_devices,
+                              robust=robust, pipeline=pipeline,
+                              cost_model=cost_model, stats=stats)
+    finally:
+        if owned:
+            tracer.close()
+    if tracer is not None and telemetry not in (None, False):
+        summary = tracer.summary()
+        for r in results:
+            r.telemetry = summary
+            r.fit.telemetry = summary
+    return results
 
 
 def _resolve_warm_start(ws, init, model, N, fp_now):

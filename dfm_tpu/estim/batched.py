@@ -42,7 +42,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from functools import partial
-from typing import List, Optional, Sequence
+from typing import List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -62,7 +62,9 @@ from .em import EMConfig, noise_floor_for
 
 __all__ = ["DFMBatchSpec", "BatchFitResult", "fit_many", "run_batched_em",
            "stack_params", "unstack_params", "pad_params_to_k",
-           "slice_params_to_k", "batched_m_step"]
+           "slice_params_to_k", "batched_m_step", "Hetero", "make_hetero",
+           "pad_panel_to_t", "pad_panel_to_n", "pad_params_to_n",
+           "slice_params_to_n"]
 
 _LOG2PI = 1.8378770664093453
 
@@ -170,6 +172,144 @@ def slice_params_to_k(p: "cpu_ref.SSMParams", k: int) -> "cpu_ref.SSMParams":
 
 
 # ---------------------------------------------------------------------------
+# Heterogeneous (N, T) padding: inert series rows + trailing time mask
+# ---------------------------------------------------------------------------
+#
+# The scheduler (dfm_tpu.sched) packs panels of DIFFERENT (N, T, k) into one
+# bucket-shaped batched program.  k-padding reuses pad_params_to_k above;
+# the two new axes each get an exactly-inert padding story:
+#
+# - N: pad SERIES are zero-observation / zero-loading / unit-variance rows.
+#   A zero Lam row keeps the series out of the k-dim observation reductions
+#   (its contribution to b and C is exactly 0), the zero Y column keeps it
+#   out of quad_R and the M-step moments, and pinning its R entry to 1.0
+#   keeps ldR unchanged (log 1.0 == 0).  The M-step preserves all three
+#   invariants exactly: S_yf pad rows are zero sums, so the unrolled
+#   triangular solves return exactly-zero Lam rows, and the R update is
+#   re-pinned by the hetero mask.
+#
+# - T: pad STEPS are trailing masked time indices.  At a pad step the
+#   filter's ``jnp.where`` selects freeze the state carry entirely (both
+#   the filtered moments and the next-step prediction), so the RTS backward
+#   corrections through the pad tail are exactly zero and the smoothed
+#   trajectory over the real prefix equals the unpadded run's.  The per-t
+#   loglik pieces and M-step moment sums are masked; denominators use the
+#   per-problem T_act.
+#
+# Both stories are equality-by-algebra, not approximation: the padded
+# problem's loglik trace, convergence decisions and params match the
+# unpadded problem's to fp-op-order tolerance (tests/test_sched.py pins
+# this per axis).
+
+
+def pad_panel_to_n(Y: np.ndarray, n_max: int) -> np.ndarray:
+    """Pad a (T, N) panel to (T, n_max) with exact-zero inert series
+    columns (pair with ``pad_params_to_n``; see the padding notes above)."""
+    T, N = Y.shape
+    if N > n_max:
+        raise ValueError(f"panel has N={N} > n_max={n_max}")
+    if N == n_max:
+        return Y
+    return np.concatenate([Y, np.zeros((T, n_max - N), Y.dtype)], axis=1)
+
+
+def pad_panel_to_t(Y: np.ndarray, t_max: int) -> np.ndarray:
+    """Pad a (T, N) panel to (t_max, N) with exact-zero trailing time steps
+    (masked out of the fit via ``Hetero.t_mask``; see the notes above)."""
+    T, N = Y.shape
+    if T > t_max:
+        raise ValueError(f"panel has T={T} > t_max={t_max}")
+    if T == t_max:
+        return Y
+    return np.concatenate([Y, np.zeros((t_max - T, N), Y.dtype)], axis=0)
+
+
+def pad_params_to_n(p: "cpu_ref.SSMParams", n_max: int) -> "cpu_ref.SSMParams":
+    """Pad an N-series param set to n_max with INERT trailing series: zero
+    loading rows (out of every k-dim reduction) and unit idiosyncratic
+    variance (log 1.0 == 0 keeps ldR unchanged).  The masked M-step
+    preserves both exactly; slice back with ``slice_params_to_n``."""
+    N = p.Lam.shape[0]
+    if N > n_max:
+        raise ValueError(f"params have N={N} > n_max={n_max}")
+    if N == n_max:
+        return p
+    m = n_max - N
+    k = p.Lam.shape[1]
+    return cpu_ref.SSMParams(
+        Lam=np.concatenate([np.asarray(p.Lam, np.float64),
+                            np.zeros((m, k))], axis=0),
+        A=np.asarray(p.A, np.float64), Q=np.asarray(p.Q, np.float64),
+        R=np.concatenate([np.asarray(p.R, np.float64), np.ones(m)]),
+        mu0=np.asarray(p.mu0, np.float64), P0=np.asarray(p.P0, np.float64))
+
+
+def slice_params_to_n(p: "cpu_ref.SSMParams", n: int) -> "cpu_ref.SSMParams":
+    """Drop the inert trailing series: leading-n slice of Lam rows and R."""
+    return cpu_ref.SSMParams(Lam=p.Lam[:n], A=p.A, Q=p.Q, R=p.R[:n],
+                             mu0=p.mu0, P0=p.P0)
+
+
+class Hetero(NamedTuple):
+    """Per-problem heterogeneity bundle for a mixed-shape batched fit.
+
+    Every leaf leads with the batch axis, so ONE ``P("batch")`` pytree-
+    prefix spec shards the whole bundle in the mesh twins — and per-problem
+    stopping knobs (tol / noise floor / iteration cap) ride in the same
+    pytree instead of widening the jitted signatures.
+
+    t_mask:      (B, T) compute dtype; 1.0 on real steps, 0.0 on the pad
+                 tail (trailing only — step 0 is always real).
+    n_mask:      (B, N) compute dtype; 1.0 on real series, 0.0 on pads.
+    n_act:       (B,) accum dtype; true series count (loglik constant).
+    t_act:       (B,) compute dtype; true step count (M-step denominators).
+    tol:         (B,) accum dtype; per-problem relative tolerance.
+    noise_floor: (B,) accum dtype; per-problem divergence floor, from the
+                 problem's OWN n_obs = T_act * N_act.
+    iter_cap:    (B,) int32; per-problem max EM iterations.
+    """
+
+    t_mask: jnp.ndarray
+    n_mask: jnp.ndarray
+    n_act: jnp.ndarray
+    t_act: jnp.ndarray
+    tol: jnp.ndarray
+    noise_floor: jnp.ndarray
+    iter_cap: jnp.ndarray
+
+
+def make_hetero(t_act, n_act, T: int, N: int, *, dtype, tol, iter_cap,
+                noise_floor_mult: float = 100.0) -> Hetero:
+    """Build a ``Hetero`` bundle for problems of true sizes (t_act, n_act)
+    padded into a (T, N) bucket.  ``tol`` / ``iter_cap`` broadcast from
+    scalars or per-problem sequences; per-problem noise floors come from
+    ``noise_floor_for(dtype, t*n)`` exactly as a lone fit would compute."""
+    t_act = np.asarray(t_act, np.int64).reshape(-1)
+    n_act = np.asarray(n_act, np.int64).reshape(-1)
+    B = len(t_act)
+    if len(n_act) != B:
+        raise ValueError("t_act and n_act lengths differ")
+    if (t_act < 1).any() or (t_act > T).any():
+        raise ValueError(f"t_act entries must lie in [1, {T}]")
+    if (n_act < 1).any() or (n_act > N).any():
+        raise ValueError(f"n_act entries must lie in [1, {N}]")
+    dt = jnp.dtype(dtype)
+    acc = accum_dtype(dt)
+    tols = np.broadcast_to(np.asarray(tol, np.float64), (B,))
+    caps = np.broadcast_to(np.asarray(iter_cap, np.int64), (B,))
+    nf = np.array([noise_floor_for(dt, int(t * n), mult=noise_floor_mult)
+                   for t, n in zip(t_act, n_act)])
+    return Hetero(
+        t_mask=jnp.asarray(np.arange(T)[None, :] < t_act[:, None], dt),
+        n_mask=jnp.asarray(np.arange(N)[None, :] < n_act[:, None], dt),
+        n_act=jnp.asarray(n_act, acc),
+        t_act=jnp.asarray(t_act, dt),
+        tol=jnp.asarray(tols, acc),
+        noise_floor=jnp.asarray(nf, acc),
+        iter_cap=jnp.asarray(caps, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
 # Batched information-form filter + RTS smoother (template: ssm.info_filter)
 # ---------------------------------------------------------------------------
 
@@ -185,17 +325,26 @@ def _batched_obs_stats(Y, Lam, R):
     return b, C, ldR
 
 
-def _batched_info_scan(b_seq, C, A, Q, mu0, P0):
+def _batched_info_scan(b_seq, C, A, Q, mu0, P0, t_seq=None):
     """k x k info-form time scan over B problems at once: every op in the
     body is an unrolled/VPU form over the (B,) batch (a batched (B, k, k)
     cholesky or dot_general here would be the whole wall — PERF.md 6a).
 
     b_seq is TIME-major (T, B, k); C/A/Q are static per problem (B, k, k).
-    Returns time-major (x_pred, P_pred, x_filt, P_filt, logdetG)."""
+    Returns time-major (x_pred, P_pred, x_filt, P_filt, logdetG).
+
+    ``t_seq`` (time-major (T, B), 1.0 real / 0.0 pad — ``Hetero.t_mask``
+    transposed) freezes a problem's state carry at its trailing pad steps:
+    both the filtered moments and the next-step prediction hold the values
+    entering the first pad step, so the RTS backward corrections through
+    the pad tail are EXACTLY zero (the smoothed real prefix is untouched)
+    and nothing in the frozen region can overflow.  ``None`` leaves the
+    traced program byte-identical to the homogeneous one."""
     k = A.shape[-1]
     I_k = jnp.eye(k, dtype=b_seq.dtype)
 
-    def step(carry, b_t):
+    def step(carry, inp):
+        b_t = inp if t_seq is None else inp[0]
         x, P = carry                                # (B, k), (B, k, k)
         Lp = bchol(P)
         CL = matmul_vpu(C, Lp)
@@ -204,36 +353,61 @@ def _batched_info_scan(b_seq, C, A, Q, mu0, P0):
         P_f = sym(matmul_vpu(Lp, bchol_solve(Lg, _bT(Lp))))
         u = b_t - matvec_vpu(C, x)
         x_f = x + matvec_vpu(P_f, u)
+        if t_seq is not None:
+            s = inp[1] > 0                          # (B,) real-step mask
+            x_f = jnp.where(s[:, None], x_f, x)
+            P_f = jnp.where(s[:, None, None], P_f, P)
         x_n = matvec_vpu(A, x_f)
         P_n = sym(matmul_vpu(matmul_vpu(A, P_f), _bT(A)) + Q)
+        if t_seq is not None:
+            x_n = jnp.where(s[:, None], x_n, x)
+            P_n = jnp.where(s[:, None, None], P_n, P)
         return (x_n, P_n), (x, P, x_f, P_f, chol_logdet(Lg))
 
-    return lax.scan(step, (mu0, P0), b_seq)[1]
+    seq = b_seq if t_seq is None else (b_seq, t_seq)
+    return lax.scan(step, (mu0, P0), seq)[1]
 
 
-def _batched_loglik(Y, p, b, C, ldR, x_pred, P_filt, logdetG):
+def _mask_t(a, t_mask):
+    """Zero a batch-major (B, T, ...) tensor at pad steps via where-select
+    (a select, not a multiply: pad-step junk must not reach the sums even
+    as 0 * inf)."""
+    m = t_mask.reshape(t_mask.shape + (1,) * (a.ndim - 2)) > 0
+    return jnp.where(m, a, jnp.zeros((), a.dtype))
+
+
+def _batched_loglik(Y, p, b, C, ldR, x_pred, P_filt, logdetG, hetero=None):
     """Per-problem loglik (B,), same cancellation-free assembly as
     ``info_filter.loglik_from_terms``: residual-pass quad_R, U from stats,
-    U'P_f U in compute dtype, (T,)-sized pieces assembled in accum dtype."""
+    U'P_f U in compute dtype, (T,)-sized pieces assembled in accum dtype.
+
+    With ``hetero``, the constant uses the per-problem true series count
+    (pad series contribute exact zeros to every other piece — zero Lam
+    rows, zero Y columns, log R = log 1 = 0) and the per-t pieces are
+    where-masked to the real time prefix."""
     acc = accum_dtype(Y.dtype)
-    N = Y.shape[-1]
     V = Y - jnp.einsum("btk,bnk->btn", x_pred, p.Lam)
     quad_R = jnp.sum((V * (V / p.R[:, None, :])).astype(acc), axis=-1)
     U = b - jnp.einsum("bkl,btl->btk", C, x_pred)   # C symmetric
     upu = jnp.einsum("btk,btkl,btl->bt", U, P_filt, U)
-    lls = -0.5 * (float(N) * _LOG2PI + ldR[:, None]
+    n_const = (float(Y.shape[-1]) if hetero is None
+               else hetero.n_act[:, None])
+    lls = -0.5 * (n_const * _LOG2PI + ldR[:, None]
                   + logdetG.astype(acc) + quad_R - upu.astype(acc))
+    if hetero is not None:
+        lls = jnp.where(hetero.t_mask > 0, lls, jnp.zeros((), acc))
     return jnp.sum(lls, axis=1)
 
 
-def _batched_filter(Y, p):
+def _batched_filter(Y, p, hetero=None):
     """Info-form filter over the batch: returns (loglik (B,), batch-major
     (x_pred, P_pred, x_filt, P_filt) with shapes (B, T, ...))."""
     b, C, ldR = _batched_obs_stats(Y, p.Lam, p.R)
+    t_seq = None if hetero is None else jnp.moveaxis(hetero.t_mask, 1, 0)
     outs = _batched_info_scan(jnp.moveaxis(b, 1, 0), C, p.A, p.Q,
-                              p.mu0, p.P0)
+                              p.mu0, p.P0, t_seq=t_seq)
     xp, Pp, xf, Pf, ldG = (jnp.moveaxis(o, 0, 1) for o in outs)
-    ll = _batched_loglik(Y, p, b, C, ldR, xp, Pf, ldG)
+    ll = _batched_loglik(Y, p, b, C, ldR, xp, Pf, ldG, hetero=hetero)
     return ll, (xp, Pp, xf, Pf)
 
 
@@ -270,32 +444,61 @@ def _batched_rts(xp, Pp, xf, Pf, A):
 # Batched M-step (closed forms of em._m_step, unmasked, per problem)
 # ---------------------------------------------------------------------------
 
-def batched_m_step(Y, x_sm, P_sm, P_lag, p: SSMParams, cfg: EMConfig, Ysq):
+def batched_m_step(Y, x_sm, P_sm, P_lag, p: SSMParams, cfg: EMConfig, Ysq,
+                   hetero=None):
     """Per-problem closed-form M-step from batched smoother moments.
 
     Same algebra as ``em.moment_sums`` + ``mstep_rows`` +
     ``mstep_dynamics_sums``; the k x k solves go through ``_bsolve_rows``
-    (unrolled) and the k x k products through ``matmul_vpu``."""
-    T = Y.shape[1]
-    S_ff = P_sm.sum(1) + jnp.einsum("bti,btj->bij", x_sm, x_sm)
-    last = P_sm[:, -1] + jnp.einsum("bi,bj->bij", x_sm[:, -1], x_sm[:, -1])
+    (unrolled) and the k x k products through ``matmul_vpu``.
+
+    With ``hetero`` (mixed-shape buckets), the moment sums run over the
+    where-masked real time prefix — the ``last`` terms select each
+    problem's own final step via the one-hot ``t_mask[t] - t_mask[t+1]`` —
+    the denominators use the per-problem T_act, pad series keep exactly
+    zero loading rows (their S_yf rows are zero sums through the zero-RHS
+    triangular solves), and pad R entries are re-pinned to 1.0."""
+    if hetero is None:
+        T = Y.shape[1]
+        x_m, P_m, Pl_m = x_sm, P_sm, P_lag
+        last = P_sm[:, -1] + jnp.einsum("bi,bj->bij",
+                                        x_sm[:, -1], x_sm[:, -1])
+        T_r, T_q = float(T), float(T - 1)
+    else:
+        tm = hetero.t_mask
+        x_m = _mask_t(x_sm, tm)
+        P_m = _mask_t(P_sm, tm)
+        Pl_m = _mask_t(P_lag, tm)
+        # One-hot of each problem's last real step (padding is trailing).
+        lw = tm - jnp.concatenate([tm[:, 1:], jnp.zeros_like(tm[:, :1])],
+                                  axis=1)
+        x_last = jnp.einsum("bt,bti->bi", lw, x_m)
+        last = (jnp.einsum("bt,btij->bij", lw, P_m)
+                + jnp.einsum("bi,bj->bij", x_last, x_last))
+        T_r = hetero.t_act[:, None]
+        T_q = (hetero.t_act - 1.0)[:, None, None]
+    S_ff = P_m.sum(1) + jnp.einsum("bti,btj->bij", x_m, x_m)
     first = P_sm[:, 0] + jnp.einsum("bi,bj->bij", x_sm[:, 0], x_sm[:, 0])
     S_lag, S_cur = S_ff - last, S_ff - first
-    S_cross = P_lag[:, 1:].sum(1) + jnp.einsum("bti,btj->bij",
-                                               x_sm[:, 1:], x_sm[:, :-1])
-    S_yf = jnp.einsum("btn,btk->bnk", Y, x_sm)      # (B, N, k)
+    S_cross = Pl_m[:, 1:].sum(1) + jnp.einsum("bti,btj->bij",
+                                              x_m[:, 1:], x_m[:, :-1])
+    S_yf = jnp.einsum("btn,btk->bnk", Y, x_m)       # (B, N, k)
     Lam = _bsolve_rows(S_ff, S_yf)
     R = jnp.maximum(
-        (Ysq - jnp.einsum("bnk,bnk->bn", Lam, S_yf)) / T, cfg.r_floor)
+        (Ysq - jnp.einsum("bnk,bnk->bn", Lam, S_yf)) / T_r, cfg.r_floor)
+    if hetero is not None:
+        nm = hetero.n_mask > 0
+        Lam = jnp.where(nm[..., None], Lam, jnp.zeros((), Lam.dtype))
+        R = jnp.where(nm, R, jnp.ones((), R.dtype))
     A, Q = p.A, p.Q
     if cfg.estimate_A:
         A = _bsolve_rows(S_lag, S_cross)
         if cfg.estimate_Q:
-            Q = sym((S_cur - matmul_vpu(A, _bT(S_cross))) / (T - 1))
+            Q = sym((S_cur - matmul_vpu(A, _bT(S_cross))) / T_q)
     elif cfg.estimate_Q:
         Q = sym((S_cur - matmul_vpu(A, _bT(S_cross))
                  - matmul_vpu(S_cross, _bT(A))
-                 + matmul_vpu(matmul_vpu(A, S_lag), _bT(A))) / (T - 1))
+                 + matmul_vpu(matmul_vpu(A, S_lag), _bT(A))) / T_q)
     mu0, P0 = p.mu0, p.P0
     if cfg.estimate_init:
         mu0, P0 = x_sm[:, 0], sym(P_sm[:, 0])
@@ -318,7 +521,7 @@ def _bmask(m, x):
 
 
 def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int,
-                   with_metrics: bool = False, n_active=None):
+                   with_metrics: bool = False, n_active=None, hetero=None):
     """n fused EM iterations over the batch.  Pure (jit/shard_map-able).
 
     carry = (p, p_prev, ll_prev (B,), state (B,) int32, n_lls (B,) int32):
@@ -340,18 +543,31 @@ def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int,
     machine already performs for converged problems — so a STATIC
     ``n_iters`` bucket serves every tail-chunk length (the host slices
     the scanned outputs to the active prefix).  ``None`` (default) leaves
-    the traced program untouched."""
+    the traced program untouched.
+
+    ``hetero`` (a ``Hetero`` bundle, static-None by default): mixed-shape
+    bucket mode.  The filter/loglik/M-step run their masked forms, the
+    per-problem tol / noise floor OVERRIDE the scalar arguments, and each
+    problem additionally freezes once its trace reaches its own
+    ``iter_cap`` — short jobs stop early inside the bucket with exactly
+    the stopping semantics a lone fit of that job would have."""
+    if hetero is not None:
+        tol = hetero.tol                             # (B,) overrides
+        noise_floor = hetero.noise_floor
     Ysq = jnp.einsum("btn,btn->bn", Y, Y)           # iteration-invariant
 
     def body(c, j):
         p, p_prev, ll_prev, state, n_lls = c
-        ll, (xp, Pp, xf, Pf) = _batched_filter(Y, p)
+        ll, (xp, Pp, xf, Pf) = _batched_filter(Y, p, hetero)
         x_sm, P_sm, P_lag = _batched_rts(xp, Pp, xf, Pf, p.A)
-        p_new = batched_m_step(Y, x_sm, P_sm, P_lag, p, cfg, Ysq)
+        p_new = batched_m_step(Y, x_sm, P_sm, P_lag, p, cfg, Ysq,
+                               hetero=hetero)
 
         active = state == RUNNING
         if n_active is not None:
             active = active & (j < n_active)
+        if hetero is not None:
+            active = active & (n_lls < hetero.iter_cap)
         n_new = n_lls + active.astype(n_lls.dtype)
         # em_progress on the device: rel-tol convergence, noise-floor
         # divergence, plateau-drop convergence; <2 lls -> continue.
@@ -397,36 +613,39 @@ def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int,
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_iters"))
-def _em_chunk_impl(Y, carry, tol, noise_floor, cfg, n_iters):
-    return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters)
+def _em_chunk_impl(Y, carry, tol, noise_floor, cfg, n_iters, hetero=None):
+    return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters,
+                          hetero=hetero)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_iters"))
-def _em_chunk_metrics_impl(Y, carry, tol, noise_floor, cfg, n_iters):
+def _em_chunk_metrics_impl(Y, carry, tol, noise_floor, cfg, n_iters,
+                           hetero=None):
     return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters,
-                          with_metrics=True)
+                          with_metrics=True, hetero=hetero)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_iters"))
 def _em_chunk_capped_impl(Y, carry, tol, noise_floor, n_active, cfg,
-                          n_iters):
+                          n_iters, hetero=None):
     """Bucketed chunk: STATIC ``n_iters`` fused length, TRACED ``n_active``
     cap — one executable serves every tail-chunk length (pipeline
     bucketing; the default program above stays byte-identical)."""
     return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters,
-                          n_active=n_active)
+                          n_active=n_active, hetero=hetero)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_iters"))
 def _em_chunk_capped_metrics_impl(Y, carry, tol, noise_floor, n_active, cfg,
-                                  n_iters):
+                                  n_iters, hetero=None):
     return _em_chunk_core(Y, carry, tol, noise_floor, cfg, n_iters,
-                          with_metrics=True, n_active=n_active)
+                          with_metrics=True, n_active=n_active,
+                          hetero=hetero)
 
 
-def _smooth_core(Y, p):
+def _smooth_core(Y, p, hetero=None):
     """Batched filter+smoother -> (x_sm (B, T, k), P_sm (B, T, k, k))."""
-    _, (xp, Pp, xf, Pf) = _batched_filter(Y, p)
+    _, (xp, Pp, xf, Pf) = _batched_filter(Y, p, hetero)
     x_sm, P_sm, _ = _batched_rts(xp, Pp, xf, Pf, p.A)
     return x_sm, P_sm
 
@@ -442,7 +661,8 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                    tol: float, fused_chunk: int = 8, policy=None,
                    scan_impl=None, state0=None, with_metrics: bool = False,
                    scan_impl_metrics=None, pipeline=None,
-                   scan_impl_capped=None, scan_impl_capped_metrics=None):
+                   scan_impl_capped=None, scan_impl_capped_metrics=None,
+                   hetero=None):
     """Chunked host driver around the fused batched-EM program.
 
     ``Y`` (B, T, N) and ``p0`` batched (device or host arrays).  Runs
@@ -473,6 +693,14 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
     per-iteration [loglik, delta, max param-update] block scanned out of
     the chunk programs (``scan_impl_metrics`` overrides the metrics twin
     the way ``scan_impl`` overrides the default program).
+
+    ``hetero`` (a ``Hetero`` bundle): mixed-shape bucket mode — the chunk
+    programs run their masked forms, each problem's tol / noise floor /
+    iteration cap come from the bundle (the scalar ``tol`` argument is
+    ignored), and the early-exit check also counts cap-reached problems
+    as done.  Custom ``scan_impl*`` twins must accept the ``hetero``
+    keyword (the sharded twins do); the default path is untouched when
+    ``hetero`` is None.
     """
     from ..pipeline import resolve_pipeline
     B, T, N = Y.shape
@@ -480,6 +708,10 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
     dt = Yj.dtype
     acc = accum_dtype(dt)
     nf = noise_floor_for(dt, T * N, mult=cfg.noise_floor_mult)
+    nf_b = (np.full((B,), float(nf)) if hetero is None
+            else np.asarray(hetero.noise_floor, np.float64))
+    cap_h = None if hetero is None else np.asarray(hetero.iter_cap)
+    hk = {} if hetero is None else {"hetero": hetero}
     if with_metrics:
         impl = (scan_impl_metrics if scan_impl_metrics is not None
                 else _em_chunk_metrics_impl)
@@ -514,8 +746,11 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
     retry_exc = policy.retry_exceptions if policy is not None else ()
 
     def _key(n):
-        return shape_key(Yj, prog_key,
-                         f"iters{n_bucket}b" if use_bucket else f"iters{n}")
+        parts = [Yj, prog_key,
+                 f"iters{n_bucket}b" if use_bucket else f"iters{n}"]
+        if hetero is not None:
+            parts.append("het")
+        return shape_key(*parts)
 
     def _payload(n):
         d = {"n_iters": int(n)}
@@ -526,8 +761,8 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
     def _call(carry_in, n):
         if use_bucket:
             return impl_c(Yj, carry_in, tol_j, nf_j,
-                          jnp.asarray(n, jnp.int32), cfg, n_bucket)
-        return impl(Yj, carry_in, tol_j, nf_j, cfg, n)
+                          jnp.asarray(n, jnp.int32), cfg, n_bucket, **hk)
+        return impl(Yj, carry_in, tol_j, nf_j, cfg, n, **hk)
 
     def _pull(new_carry, out, n):
         lls, mets = out if with_metrics else (out, None)
@@ -613,7 +848,13 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                     converged=int((state_h == CONVERGED).sum()),
                     diverged=int((state_h == DIVERGED).sum()), **extra)
             state_prev_h = state_h
-        return bool((state_h != RUNNING).all())
+        done = state_h != RUNNING
+        if cap_h is not None:
+            # Per-problem iteration caps: a still-RUNNING problem whose
+            # trace reached its own cap is done too (tiny post-barrier
+            # transfer — the blocking pull above already synced).
+            done = done | (np.asarray(new_carry[4]) >= cap_h)
+        return bool(done.all())
 
     if not pipe.active:
         while it < max_iters:
@@ -692,7 +933,8 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                        np.maximum(n_lls_h - 2, 0), n_lls_h)
     healths = []
     for b in range(B):
-        h = health_from_trace(lls_list[b], noise_floor=nf, engine=engine)
+        h = health_from_trace(lls_list[b], noise_floor=float(nf_b[b]),
+                              engine=engine)
         h.n_chunks = n_chunks
         h.n_dispatch_retries = n_retries
         for ev in dispatch_events:

@@ -22,7 +22,7 @@ import json
 import sys
 from typing import List, Optional
 
-__all__ = ["advise", "candidate_plans", "main"]
+__all__ = ["advise", "advise_jobs", "candidate_plans", "main"]
 
 
 def candidate_plans(chunk: int = 8) -> List[dict]:
@@ -79,6 +79,75 @@ def advise(N: int, T: int, k: int, *, max_iters: int = 50, chunk: int = 8,
             "model": model.to_dict()}
 
 
+def advise_jobs(shapes, *, max_iters: int = 50, chunk: int = 8,
+                runs: Optional[str] = None,
+                device: Optional[str] = None) -> dict:
+    """Rank bucket LAYOUTS for a mixed-shape job mix (the scheduler's
+    planning problem — see ``sched.buckets``): for each candidate bucket
+    count, run the cost-model DP and predict the mix's aggregate wall.
+    ``shapes`` is a list of (N, T, k) triples, one per job.  Deterministic
+    given a fixed profile registry: ties prefer fewer executables, then
+    the smaller bucket-dims tuple."""
+    from ..sched.buckets import plan_buckets
+    from .cost import fit_cost_model
+    from .store import RunStore, runs_dir
+
+    d = runs_dir(runs)
+    profiles: List[dict] = []
+    if d is not None:
+        profiles = [r for r in RunStore(d).load()
+                    if r.get("kind") == "profile"]
+    model = fit_cost_model(profiles, device=device)
+
+    tnk = [(int(T), int(N), int(k)) for (N, T, k) in shapes]
+    iters = [int(max_iters)] * len(tnk)
+    layouts, seen = [], set()
+    for mb in range(1, min(len(tnk), 4) + 1):
+        plan = plan_buckets(tnk, iters, max_buckets=mb, model=model,
+                            chunk=chunk)
+        sig = tuple(sorted((b.dims, b.jobs) for b in plan.buckets))
+        if sig in seen:     # a larger budget the DP declined to use
+            continue
+        seen.add(sig)
+        layouts.append({
+            "max_buckets": mb, "n_buckets": len(plan.buckets),
+            "buckets": [{"dims": {"T": b.dims[0], "N": b.dims[1],
+                                  "k": b.dims[2]},
+                         "jobs": list(b.jobs), "cap": b.cap}
+                        for b in plan.buckets],
+            "pad_waste_frac": plan.pad_waste_frac,
+            "predicted_wall_s": plan.predicted_wall_s})
+    layouts.sort(key=lambda l: (l["predicted_wall_s"], l["n_buckets"],
+                                tuple(tuple(b["dims"].values())
+                                      for b in l["buckets"])))
+    for i, l in enumerate(layouts):
+        l["rank"] = i + 1
+    return {"jobs": [{"N": N, "T": T, "k": k} for (N, T, k) in shapes],
+            "max_iters": int(max_iters), "device": model.device,
+            "calibrated": model.calibrated,
+            "n_profiles": model.n_profiles, "layouts": layouts,
+            "model": model.to_dict()}
+
+
+def _parse_jobs(spec: str):
+    """``N,T,K[xC]`` triples joined by ``;`` — e.g. ``20,60,2;26,80,2x3``
+    is one (20, 60, 2) job plus three (26, 80, 2) jobs."""
+    shapes = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        mult = 1
+        if "x" in part.rsplit(",", 1)[-1]:
+            part, m = part.rsplit("x", 1)
+            mult = int(m)
+        N, T, k = (int(x) for x in part.split(","))
+        shapes.extend([(N, T, k)] * mult)
+    if not shapes:
+        raise ValueError("empty job spec")
+    return shapes
+
+
 def _plan_str(p: dict) -> str:
     if p["engine"] == "fused":
         return f"fused (chunk={p['fused_chunk']})"
@@ -91,7 +160,12 @@ def main(argv=None) -> int:
         prog="python -m dfm_tpu.obs.advise",
         description="Rank fit plans for a shape via the calibrated cost "
                     "model (profiles from the run registry).")
-    ap.add_argument("--shape", required=True, metavar="N,T,K")
+    what = ap.add_mutually_exclusive_group(required=True)
+    what.add_argument("--shape", metavar="N,T,K")
+    what.add_argument("--jobs", metavar="N,T,K[xC];...",
+                      help="rank bucket layouts for a mixed-shape job mix "
+                           "(the sched.submit planning problem) instead of "
+                           "single-fit plans")
     ap.add_argument("--max-iters", type=int, default=50)
     ap.add_argument("--chunk", type=int, default=8,
                     help="base fused_chunk for the plan grid")
@@ -102,6 +176,40 @@ def main(argv=None) -> int:
                          "default: the latest profile's)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+    if args.jobs is not None:
+        try:
+            shapes = _parse_jobs(args.jobs)
+        except ValueError:
+            print(f"error: --jobs wants N,T,K[xC] triples joined by ';', "
+                  f"got {args.jobs!r}", file=sys.stderr)
+            return 2
+        res = advise_jobs(shapes, max_iters=args.max_iters,
+                          chunk=args.chunk, runs=args.runs,
+                          device=args.device)
+        if not res["calibrated"]:
+            big = max(shapes)
+            print("warning: no profile records in the registry — "
+                  "predictions use device priors only; run `python -m "
+                  "dfm_tpu.obs.profile --shape "
+                  f"{big[0]},{big[1]},{big[2]}` to calibrate",
+                  file=sys.stderr)
+        if args.json:
+            json.dump(res, sys.stdout, indent=2, default=str)
+            print()
+            return 0
+        cal = ("calibrated from %d profile(s)" % res["n_profiles"]
+               if res["calibrated"] else "PRIORS ONLY")
+        print(f"advise {len(res['jobs'])} jobs "
+              f"max_iters={res['max_iters']} [{res['device']}, {cal}]")
+        for l in res["layouts"]:
+            dims = " + ".join(
+                f"({b['dims']['T']},{b['dims']['N']},{b['dims']['k']})"
+                f"x{len(b['jobs'])}" for b in l["buckets"])
+            print(f"  #{l['rank']}: {l['n_buckets']} bucket"
+                  f"{'s' if l['n_buckets'] != 1 else ''} {dims:40s} "
+                  f"predicted {l['predicted_wall_s']:.3f}s, "
+                  f"pad waste {100 * l['pad_waste_frac']:.1f}%")
+        return 0
     try:
         N, T, k = (int(x) for x in args.shape.split(","))
     except ValueError:

@@ -175,6 +175,10 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
              for e in events if e.get("kind") == "cost"}
     fits = [{k: v for k, v in e.items() if k != "kind"}
             for e in events if e.get("kind") == "fit"]
+    # Multi-tenant scheduler (sched.submit / fit_jobs): one event per job
+    # with its bucket assignment and queue/compute/pad-waste accounting.
+    tenants = [{k: v for k, v in e.items() if k != "kind"}
+               for e in events if e.get("kind") == "tenant"]
 
     out = {
         "n_events": len(events),
@@ -262,6 +266,19 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
         out["costs"] = costs
     if fits:
         out["fits"] = fits
+    if tenants:
+        waits = [float(t["queue_wait_s"]) for t in tenants
+                 if isinstance(t.get("queue_wait_s"), (int, float))]
+        wastes = [float(t["pad_waste_frac"]) for t in tenants
+                  if isinstance(t.get("pad_waste_frac"), (int, float))]
+        out["tenants"] = tenants
+        out["tenant_fairness"] = {
+            "n_tenants": len(tenants),
+            "n_buckets": len({t.get("bucket") for t in tenants}),
+            "converged": sum(1 for t in tenants if t.get("converged")),
+            "queue_wait_s": _stats(waits),
+            "pad_waste_frac_mean": (sum(wastes) / len(wastes)
+                                    if wastes else None)}
     return out
 
 
@@ -357,6 +374,34 @@ def _print_text(s: dict) -> None:
         bits = [f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in f.items() if k != "t"]
         print(f"  fit: {' '.join(bits)}")
+    tf = s.get("tenant_fairness")
+    if tf:
+        qw = tf.get("queue_wait_s") or {}
+        line = (f"tenants: {tf['n_tenants']} across {tf['n_buckets']} "
+                f"bucket{'s' if tf['n_buckets'] != 1 else ''}, "
+                f"{tf['converged']} converged")
+        if qw:
+            line += (f"; queue wait p50 {_fmt_s(qw['p50'])} / "
+                     f"p99 {_fmt_s(qw['p99'])}")
+        if isinstance(tf.get("pad_waste_frac_mean"), (int, float)):
+            line += f"; mean pad waste {100 * tf['pad_waste_frac_mean']:.1f}%"
+        print(line)
+        for t in s.get("tenants", []):
+            shape = f"({t.get('T')}, {t.get('N')}, {t.get('k')})"
+            bshape = (f"({t.get('bucket_T')}, {t.get('bucket_N')}, "
+                      f"{t.get('bucket_k')})")
+            bits = [f"  {str(t.get('tenant', '?')):12s} {shape:>14s} -> "
+                    f"bucket {t.get('bucket')} {bshape}"]
+            if isinstance(t.get("queue_wait_s"), (int, float)):
+                bits.append(f"wait {_fmt_s(float(t['queue_wait_s']))}")
+            if isinstance(t.get("compute_s"), (int, float)):
+                bits.append(f"compute {_fmt_s(float(t['compute_s']))}")
+            if isinstance(t.get("pad_waste_frac"), (int, float)):
+                bits.append(f"waste {100 * float(t['pad_waste_frac']):.1f}%")
+            if t.get("n_iters") is not None:
+                bits.append(f"{t['n_iters']} iters")
+            bits.append("converged" if t.get("converged") else "NOT converged")
+            print(", ".join(bits))
     a = s.get("advice")
     if a:
         pred, real = a.get("predicted_wall_s"), a.get("realized_wall_s")

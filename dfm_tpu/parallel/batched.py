@@ -43,97 +43,145 @@ def make_batch_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devs), (BATCH_AXIS,))
 
 
-def _pad_batch(Y, p0, n_shards: int):
+def _pad_batch(Y, p0, n_shards: int, hetero=None):
     """Pad the batch axis to a multiple of n_shards with copies of problem
     0 (data AND params — a valid problem, so no NaN risk; the driver
-    freezes the pads via the PADDED state and the caller slices them off)."""
+    freezes the pads via the PADDED state and the caller slices them off).
+    A ``hetero`` bundle pads the same way: every leaf leads with B, and
+    pad rows never act (PADDED problems are frozen from the start)."""
     B = Y.shape[0]
     n_pad = (-B) % n_shards
     if n_pad == 0:
-        return Y, p0, 0
+        return Y, p0, hetero, 0
     rep = lambda x: jnp.concatenate(
         [x, jnp.repeat(x[:1], n_pad, axis=0)], axis=0)
-    return rep(Y), jax.tree_util.tree_map(rep, p0), n_pad
+    hp = (None if hetero is None
+          else jax.tree_util.tree_map(rep, hetero))
+    return rep(Y), jax.tree_util.tree_map(rep, p0), hp, n_pad
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_iters", "mesh"))
-def _sharded_chunk_impl(Y, carry, tol, noise_floor, cfg, n_iters, mesh):
+def _sharded_chunk_impl(Y, carry, tol, noise_floor, cfg, n_iters, mesh,
+                        hetero=None):
     """shard_map'd twin of ``estim.batched._em_chunk_impl``: the same pure
     chunk core, batch axis split over the mesh, NO collectives (the
     problems are independent; specs are pytree prefixes, so P("batch")
-    covers every SSMParams leaf)."""
+    covers every SSMParams leaf).  ``hetero`` (mixed-shape bucket mode)
+    shards with the same prefix spec — every ``Hetero`` leaf leads with B
+    — in a separate trace so the default program stays untouched."""
     Pb = P(BATCH_AXIS)
-    body = lambda Yb, c, t, nf: _em_chunk_core(Yb, c, t, nf, cfg, n_iters)
+    if hetero is None:
+        body = lambda Yb, c, t, nf: _em_chunk_core(Yb, c, t, nf, cfg,
+                                                   n_iters)
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P()),
+            out_specs=((Pb, Pb, Pb, Pb, Pb), P(None, BATCH_AXIS)),
+        )(Y, carry, tol, noise_floor)
+    body = lambda Yb, c, t, nf, h: _em_chunk_core(Yb, c, t, nf, cfg,
+                                                  n_iters, hetero=h)
     return shard_map(
         body, mesh=mesh,
-        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P()),
+        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P(), Pb),
         out_specs=((Pb, Pb, Pb, Pb, Pb), P(None, BATCH_AXIS)),
-    )(Y, carry, tol, noise_floor)
+    )(Y, carry, tol, noise_floor, hetero)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_iters", "mesh"))
 def _sharded_chunk_metrics_impl(Y, carry, tol, noise_floor, cfg, n_iters,
-                                mesh):
+                                mesh, hetero=None):
     """Metrics twin of ``_sharded_chunk_impl``: the chunk core with its
     per-iteration (B, 3) metrics block scanned out.  Both scan outputs are
     time-major with the batch on axis 1, hence the P(None, "batch") specs;
     still no collectives (the per-problem max param-update is local to each
     problem's shard)."""
     Pb = P(BATCH_AXIS)
-    body = lambda Yb, c, t, nf: _em_chunk_core(Yb, c, t, nf, cfg, n_iters,
-                                               with_metrics=True)
+    if hetero is None:
+        body = lambda Yb, c, t, nf: _em_chunk_core(Yb, c, t, nf, cfg,
+                                                   n_iters,
+                                                   with_metrics=True)
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P()),
+            out_specs=((Pb, Pb, Pb, Pb, Pb),
+                       (P(None, BATCH_AXIS), P(None, BATCH_AXIS))),
+        )(Y, carry, tol, noise_floor)
+    body = lambda Yb, c, t, nf, h: _em_chunk_core(
+        Yb, c, t, nf, cfg, n_iters, with_metrics=True, hetero=h)
     return shard_map(
         body, mesh=mesh,
-        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P()),
+        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P(), Pb),
         out_specs=((Pb, Pb, Pb, Pb, Pb),
                    (P(None, BATCH_AXIS), P(None, BATCH_AXIS))),
-    )(Y, carry, tol, noise_floor)
+    )(Y, carry, tol, noise_floor, hetero)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_iters", "mesh"))
 def _sharded_chunk_capped_impl(Y, carry, tol, noise_floor, n_active, cfg,
-                               n_iters, mesh):
+                               n_iters, mesh, hetero=None):
     """Bucketed twin of ``_sharded_chunk_impl``: STATIC ``n_iters`` fused
     length, TRACED ``n_active`` cap (replicated scalar, P() spec) — one
     executable per mesh size serves every tail-chunk length."""
     Pb = P(BATCH_AXIS)
-    body = lambda Yb, c, t, nf, na: _em_chunk_core(Yb, c, t, nf, cfg,
-                                                   n_iters, n_active=na)
+    if hetero is None:
+        body = lambda Yb, c, t, nf, na: _em_chunk_core(Yb, c, t, nf, cfg,
+                                                       n_iters, n_active=na)
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P(), P()),
+            out_specs=((Pb, Pb, Pb, Pb, Pb), P(None, BATCH_AXIS)),
+        )(Y, carry, tol, noise_floor, n_active)
+    body = lambda Yb, c, t, nf, na, h: _em_chunk_core(
+        Yb, c, t, nf, cfg, n_iters, n_active=na, hetero=h)
     return shard_map(
         body, mesh=mesh,
-        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P(), P()),
+        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P(), P(), Pb),
         out_specs=((Pb, Pb, Pb, Pb, Pb), P(None, BATCH_AXIS)),
-    )(Y, carry, tol, noise_floor, n_active)
+    )(Y, carry, tol, noise_floor, n_active, hetero)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_iters", "mesh"))
 def _sharded_chunk_capped_metrics_impl(Y, carry, tol, noise_floor, n_active,
-                                       cfg, n_iters, mesh):
+                                       cfg, n_iters, mesh, hetero=None):
     Pb = P(BATCH_AXIS)
-    body = lambda Yb, c, t, nf, na: _em_chunk_core(
-        Yb, c, t, nf, cfg, n_iters, with_metrics=True, n_active=na)
+    if hetero is None:
+        body = lambda Yb, c, t, nf, na: _em_chunk_core(
+            Yb, c, t, nf, cfg, n_iters, with_metrics=True, n_active=na)
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P(), P()),
+            out_specs=((Pb, Pb, Pb, Pb, Pb),
+                       (P(None, BATCH_AXIS), P(None, BATCH_AXIS))),
+        )(Y, carry, tol, noise_floor, n_active)
+    body = lambda Yb, c, t, nf, na, h: _em_chunk_core(
+        Yb, c, t, nf, cfg, n_iters, with_metrics=True, n_active=na,
+        hetero=h)
     return shard_map(
         body, mesh=mesh,
-        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P(), P()),
+        in_specs=(Pb, (Pb, Pb, Pb, Pb, Pb), P(), P(), P(), Pb),
         out_specs=((Pb, Pb, Pb, Pb, Pb),
                    (P(None, BATCH_AXIS), P(None, BATCH_AXIS))),
-    )(Y, carry, tol, noise_floor, n_active)
+    )(Y, carry, tol, noise_floor, n_active, hetero)
 
 
 def run_batched_em_sharded(Y, p0, cfg, max_iters: int, tol: float,
                            fused_chunk: int = 8,
                            n_devices: Optional[int] = None, policy=None,
-                           with_metrics: bool = False, pipeline=None):
+                           with_metrics: bool = False, pipeline=None,
+                           hetero=None):
     """Sharded batched-EM driver: same contract as ``run_batched_em``
     (params, per-problem traces, converged, p_iters, healths — plus the
     metrics block when ``with_metrics``), with the batch axis laid across
     the mesh so B also scales across chips.  ``pipeline`` passes through
     to the shared driver with this module's capped twins, so speculative
-    issue and bucketed reuse work identically here."""
+    issue and bucketed reuse work identically here.  ``hetero`` (a
+    ``Hetero`` bundle) rides the same batch padding as Y/p0 — pad rows
+    are PADDED-frozen copies of problem 0 — and the shared driver routes
+    it into the hetero branch of the twins."""
     mesh = make_batch_mesh(n_devices)
     D = mesh.devices.size
     B = Y.shape[0]
-    Yp, pp, n_pad = _pad_batch(jnp.asarray(Y), p0, D)
+    Yp, pp, hp, n_pad = _pad_batch(jnp.asarray(Y), p0, D, hetero=hetero)
     state0 = np.concatenate([np.zeros(B, np.int32),
                              np.full(n_pad, PADDED, np.int32)])
     impl = partial(_sharded_chunk_impl, mesh=mesh)
@@ -152,7 +200,8 @@ def run_batched_em_sharded(Y, p0, cfg, max_iters: int, tol: float,
         Yp, pp, cfg, max_iters, tol, fused_chunk=fused_chunk, policy=policy,
         scan_impl=impl, state0=state0, with_metrics=with_metrics,
         scan_impl_metrics=impl_m, pipeline=pipeline,
-        scan_impl_capped=impl_c, scan_impl_capped_metrics=impl_cm)
+        scan_impl_capped=impl_c, scan_impl_capped_metrics=impl_cm,
+        hetero=hp)
     if with_metrics:
         p, lls_list, conv, p_iters, healths, metrics = out
     else:
@@ -170,18 +219,23 @@ def run_batched_em_sharded(Y, p0, cfg, max_iters: int, tol: float,
 
 
 @partial(jax.jit, static_argnames=("mesh",))
-def _sharded_smooth_impl(Y, p, mesh):
+def _sharded_smooth_impl(Y, p, mesh, hetero=None):
     Pb = P(BATCH_AXIS)
-    return shard_map(_smooth_core, mesh=mesh, in_specs=(Pb, Pb),
-                     out_specs=(Pb, Pb))(Y, p)
+    if hetero is None:
+        return shard_map(_smooth_core, mesh=mesh, in_specs=(Pb, Pb),
+                         out_specs=(Pb, Pb))(Y, p)
+    body = lambda Yb, pb, h: _smooth_core(Yb, pb, hetero=h)
+    return shard_map(body, mesh=mesh, in_specs=(Pb, Pb, Pb),
+                     out_specs=(Pb, Pb))(Y, p, hetero)
 
 
-def batched_smooth_sharded(Y, p, n_devices: Optional[int] = None):
+def batched_smooth_sharded(Y, p, n_devices: Optional[int] = None,
+                           hetero=None):
     """Batched filter+smoother with the batch axis across the mesh."""
     mesh = make_batch_mesh(n_devices)
     D = mesh.devices.size
-    Yp, pp, n_pad = _pad_batch(jnp.asarray(Y), p, D)
-    x_sm, P_sm = _sharded_smooth_impl(Yp, pp, mesh)
+    Yp, pp, hp, n_pad = _pad_batch(jnp.asarray(Y), p, D, hetero=hetero)
+    x_sm, P_sm = _sharded_smooth_impl(Yp, pp, mesh, hp)
     if n_pad:
         B = Y.shape[0]
         x_sm, P_sm = x_sm[:B], P_sm[:B]

@@ -1,0 +1,15 @@
+"""Multi-tenant batch scheduler: mixed-shape panels packed into shape
+buckets, each bucket ONE fused batched-EM program (see ``sched.scheduler``).
+
+    from dfm_tpu.sched import Job, submit
+    results = submit([Job(Y1, model1), Job(Y2, model2), ...])
+
+or through the public API seam, ``dfm_tpu.fit_jobs(...)``.
+"""
+
+from .buckets import Bucket, BucketPlan, plan_buckets
+from .jobs import Job, JobResult
+from .scheduler import submit
+
+__all__ = ["Job", "JobResult", "Bucket", "BucketPlan", "plan_buckets",
+           "submit"]

@@ -1,0 +1,59 @@
+"""Job / JobResult containers for the multi-tenant batch scheduler."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+__all__ = ["Job", "JobResult"]
+
+
+@dataclass(frozen=True)
+class Job:
+    """One tenant's fit request: a panel plus its model and stop knobs.
+
+    ``Y`` is a fully-observed (T, N) panel (the batched engine has no
+    missing-data path; NaNs surface as a per-tenant DIVERGED health, they
+    never contaminate bucket-mates).  ``model`` is a
+    :class:`dfm_tpu.DynamicFactorModel`; ``init`` optionally overrides the
+    PCA initializer with explicit ``DFMParams``-shaped values (already in
+    the standardized scale).  ``max_iters``/``tol`` stop this tenant
+    independently of everyone else sharing its bucket.
+    """
+
+    Y: Any
+    model: Any
+    tenant: Optional[str] = None
+    init: Any = None
+    max_iters: int = 50
+    tol: float = 1e-6
+
+
+@dataclass
+class JobResult:
+    """Per-tenant outcome: the sliced-back fit plus queue telemetry.
+
+    ``fit`` is a full :class:`dfm_tpu.FitResult` (params / factors /
+    logliks / health), numerically identical to running ``fit()`` on the
+    job alone.  ``queue_wait_s`` measures submit -> bucket-launch,
+    ``compute_s`` the bucket's device wall (shared by bucket-mates), and
+    ``pad_waste_frac`` the fraction of this tenant's padded flops that
+    were pure padding.
+    """
+
+    tenant: str
+    fit: Any
+    bucket: int
+    shape: Tuple[int, int, int]  # (T, N, k)
+    queue_wait_s: float
+    compute_s: float
+    pad_waste_frac: float
+    telemetry: Any = field(default=None)
+
+    @property
+    def converged(self) -> bool:
+        return bool(self.fit.converged)
+
+    @property
+    def loglik(self) -> float:
+        return self.fit.loglik

@@ -1,0 +1,281 @@
+"""Multi-tenant submit(): mixed-shape jobs -> bucketed fused batch fits.
+
+The serving path for heterogeneous traffic: every job is padded into its
+cost-model-chosen bucket (k via inert factors, N via inert zero-weight
+series, T via the info-form trailing mask — all three exactness-proven
+seams from ``estim.batched``) and each bucket runs as ONE fused chunked
+program with per-tenant convergence freezes, so B tenants pay
+2 + ceil(cap/chunk) tunnel dispatches per BUCKET instead of per job.
+Results slice back per tenant, numerically identical to a lone
+``fit()`` of the same job (x64 bit-exact; pinned by tests/test_sched.py).
+
+Jobs whose models differ structurally (estimate_A / estimate_Q /
+estimate_init — static branches of the jitted program) can never share an
+executable, so they are grouped first and bucketed within each group.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..backends import cpu_ref
+from ..estim.batched import (_smooth_impl, make_hetero, pad_panel_to_n,
+                             pad_panel_to_t, pad_params_to_k,
+                             pad_params_to_n, run_batched_em,
+                             slice_params_to_k, slice_params_to_n,
+                             stack_params, unstack_params)
+from ..estim.em import EMConfig
+from ..obs.cost import CostModel, em_iter_work, fit_cost_model
+from ..obs.trace import current_tracer, shape_key
+from ..ops.precision import default_compute_dtype
+from ..utils.data import standardize, validate_panel
+from .buckets import BucketPlan, plan_buckets
+from .jobs import Job, JobResult
+
+__all__ = ["submit", "registry_cost_model"]
+
+
+def registry_cost_model(runs: Optional[str] = None,
+                        device: Optional[str] = None) -> CostModel:
+    """The scheduler's default planner input: a ``CostModel`` calibrated
+    from the ambient run registry's profile records (``obs.profile``),
+    falling back to device priors when the registry is empty/absent —
+    bucketing only needs relative rankings, which priors preserve."""
+    from ..obs.store import RunStore, device_kind, runs_dir
+    if device is None:
+        try:
+            device = device_kind(str(jax.devices()[0].platform))
+        except Exception:
+            device = "cpu"
+    d = runs_dir(runs)
+    profiles: list = []
+    if d is not None:
+        profiles = [r for r in RunStore(d).load()
+                    if r.get("kind") == "profile"]
+    return fit_cost_model(profiles, device=device)
+
+
+def _cfg_key(model) -> tuple:
+    """Static program identity: jobs differing here need different
+    executables regardless of shape, so they can't share a bucket."""
+    return (bool(model.estimate_A), bool(model.estimate_Q),
+            bool(model.estimate_init))
+
+
+def _prep_job(i: int, job: Job):
+    """Host prep mirroring ``fit_many`` (itself mirroring ``api.fit``):
+    validate, standardize, PCA warm start in the standardized scale."""
+    Y = np.asarray(job.Y, np.float64)
+    if Y.ndim != 2:
+        raise ValueError(f"job {i}: Y must be (T, N); got shape {Y.shape}")
+    T, N = Y.shape
+    model = job.model
+    if model.n_factors > min(T, N):
+        raise ValueError(f"job {i}: n_factors={model.n_factors} exceeds "
+                         f"min(T, N)={min(T, N)}")
+    if T < 2 and model.dynamics == "ar1":
+        raise ValueError(f"job {i}: ar1 dynamics needs T >= 2")
+    if not np.isfinite(Y).all():
+        raise ValueError(f"job {i}: batched fits require fully-observed "
+                         "panels (no NaN/mask support); use api.fit")
+    validate_panel(Y, check_variance=model.standardize)
+    std = None
+    if model.standardize:
+        Yz, std = standardize(Y)
+    else:
+        Yz = Y
+    if job.init is not None:
+        init = job.init
+    else:
+        init = cpu_ref.pca_init(Yz, model.n_factors,
+                                static=(model.dynamics == "static"))
+    return Yz, std, init
+
+
+def submit(jobs: Sequence[Job], *, backend: str = "tpu",
+           max_buckets: int = 3, dtype=None, fused_chunk: int = 8,
+           n_devices: Optional[int] = None, robust=True, pipeline=None,
+           cost_model: Optional[CostModel] = None,
+           stats: Optional[dict] = None) -> List[JobResult]:
+    """Fit heterogeneous (N, T, k) jobs as a small set of fused batches.
+
+    backend: "tpu" (single-device fused batches) or "sharded" (each
+    bucket's batch axis split across the mesh — ``parallel.batched``).
+    ``max_buckets`` caps executables per model-structure group;
+    ``cost_model`` overrides the registry-calibrated planner input;
+    ``pipeline`` / ``robust`` / ``fused_chunk`` ride through to the chunk
+    driver exactly as in ``fit_many``.  ``stats`` (a dict, optional) is
+    filled with plan/pack/compute accounting for benches.
+
+    Returns per-tenant ``JobResult``s in submit order; each ``.fit`` is a
+    full ``FitResult`` numerically identical to fitting that job alone.
+    """
+    from ..api import FitResult, _resolve_policy
+    from ..utils.checkpoint import warm_fingerprint
+    t_submit = time.perf_counter()
+    jobs = list(jobs)
+    if not jobs:
+        return []
+    for i, j in enumerate(jobs):
+        if not isinstance(j, Job):
+            raise TypeError(f"jobs[{i}] must be a sched.Job, "
+                            f"got {type(j).__name__}")
+    prepped = [_prep_job(i, j) for i, j in enumerate(jobs)]
+    shapes = [(p[0].shape[0], p[0].shape[1], j.model.n_factors)
+              for p, j in zip(prepped, jobs)]
+    its = [max(1, int(j.max_iters)) for j in jobs]
+
+    m = cost_model if cost_model is not None else registry_cost_model()
+    # Structural groups first (incompatible executables), then the
+    # cost-model DP packs shapes within each group.
+    groups: dict = {}
+    for i, j in enumerate(jobs):
+        groups.setdefault(_cfg_key(j.model), []).append(i)
+    plans: List[tuple] = []       # (job indices, BucketPlan)
+    for key in sorted(groups):
+        idx = groups[key]
+        plans.append((idx, plan_buckets([shapes[i] for i in idx],
+                                        [its[i] for i in idx],
+                                        max_buckets=max_buckets, model=m,
+                                        chunk=fused_chunk)))
+    t_planned = time.perf_counter()
+
+    dt = dtype or default_compute_dtype()
+    policy = _resolve_policy(robust)
+    tr = current_tracer()
+    results: List[Optional[JobResult]] = [None] * len(jobs)
+    agg_waste_num = agg_waste_den = 0.0
+    bucket_dims: List[tuple] = []
+    compute_total = 0.0
+    n_bucket_global = 0
+
+    for idx, plan in plans:
+        for b_local, bucket in enumerate(plan.buckets):
+            bi = n_bucket_global
+            n_bucket_global += 1
+            members = [idx[x] for x in bucket.jobs]
+            T_b, N_b, k_b = bucket.dims
+            bucket_dims.append((T_b, N_b, k_b))
+            model0 = jobs[members[0]].model
+            cfg = EMConfig(estimate_A=model0.estimate_A,
+                           estimate_Q=model0.estimate_Q,
+                           estimate_init=model0.estimate_init,
+                           filter="info")
+            Yp = np.stack([
+                pad_panel_to_t(pad_panel_to_n(prepped[i][0], N_b), T_b)
+                for i in members])
+            inits = [pad_params_to_n(
+                pad_params_to_k(prepped[i][2], k_b), N_b)
+                for i in members]
+            het = make_hetero(
+                t_act=[shapes[i][0] for i in members],
+                n_act=[shapes[i][1] for i in members],
+                T=T_b, N=N_b, dtype=dt,
+                tol=[float(jobs[i].tol) for i in members],
+                iter_cap=[its[i] for i in members],
+                noise_floor_mult=cfg.noise_floor_mult)
+            Yj = jnp.asarray(Yp, dt)
+            p0 = stack_params(inits, dt)
+            cap = max(its[i] for i in members)
+            t_launch = time.perf_counter()
+            queue_wait = t_launch - t_submit
+
+            with jax.default_matmul_precision("highest"):
+                if backend == "sharded":
+                    from ..parallel.batched import (batched_smooth_sharded,
+                                                    run_batched_em_sharded)
+                    p, lls_list, conv, p_iters, healths = \
+                        run_batched_em_sharded(
+                            Yj, p0, cfg, cap, 0.0, fused_chunk=fused_chunk,
+                            n_devices=n_devices, policy=policy,
+                            pipeline=pipeline, hetero=het)
+
+                    def _smooth(Yj=Yj, p=p, het=het):
+                        return batched_smooth_sharded(
+                            Yj, p, n_devices=n_devices, hetero=het)
+                elif backend == "tpu":
+                    p, lls_list, conv, p_iters, healths = run_batched_em(
+                        Yj, p0, cfg, cap, 0.0, fused_chunk=fused_chunk,
+                        policy=policy, pipeline=pipeline, hetero=het)
+
+                    def _smooth(Yj=Yj, p=p, het=het):
+                        return _smooth_impl(Yj, p, het)
+                else:
+                    raise ValueError(f"unknown scheduler backend "
+                                     f"{backend!r} (use 'tpu' or 'sharded')")
+                if tr is None:
+                    x_sm, P_sm = _smooth()
+                    x_h = np.asarray(x_sm, np.float64)
+                    P_h = np.asarray(P_sm, np.float64)
+                else:
+                    with tr.dispatch("batched_smooth",
+                                     shape_key(Yj, backend, "het"),
+                                     barrier=True):
+                        x_sm, P_sm = _smooth()
+                        x_h = np.asarray(x_sm, np.float64)
+                        P_h = np.asarray(P_sm, np.float64)
+            compute_s = time.perf_counter() - t_launch
+            compute_total += compute_s
+
+            p_list = unstack_params(p)
+            for slot, i in enumerate(members):
+                T_j, N_j, k_j = shapes[i]
+                job = jobs[i]
+                waste = plan.job_pad_waste[idx.index(i)]
+                pj = slice_params_to_n(
+                    slice_params_to_k(p_list[slot], k_j), N_j)
+                lls = np.asarray(lls_list[slot])
+                fit = FitResult(
+                    params=pj, logliks=lls,
+                    factors=x_h[slot, :T_j, :k_j],
+                    factor_cov=P_h[slot, :T_j, :k_j, :k_j],
+                    converged=bool(conv[slot]), n_iters=len(lls),
+                    standardizer=prepped[i][1], model=job.model,
+                    backend=f"sched:{backend}", history=[],
+                    health=healths[slot],
+                    fingerprint=warm_fingerprint((T_j, N_j), job.model,
+                                                 False))
+                tenant = job.tenant if job.tenant is not None else f"job{i}"
+                if tr is not None:
+                    tr.emit("tenant", tenant=tenant, bucket=bi,
+                            T=T_j, N=N_j, k=k_j,
+                            bucket_T=T_b, bucket_N=N_b, bucket_k=k_b,
+                            queue_wait_s=float(queue_wait),
+                            compute_s=float(compute_s),
+                            pad_waste_frac=float(waste),
+                            n_iters=int(len(lls)),
+                            converged=bool(conv[slot]))
+                results[i] = JobResult(
+                    tenant=tenant, fit=fit, bucket=bi,
+                    shape=(T_j, N_j, k_j),
+                    queue_wait_s=float(queue_wait),
+                    compute_s=float(compute_s),
+                    pad_waste_frac=float(waste))
+        # Aggregate pad waste across groups (flop-weighted, from the
+        # per-group plans' own accounting).
+        for pos, i in enumerate(idx):
+            T_j, N_j, k_j = shapes[i]
+            bT, bN, bk = plan.buckets[plan.bucket_of[pos]].dims
+            agg_waste_num += em_iter_work(N_j, T_j, k_j)[0] * its[i]
+            agg_waste_den += em_iter_work(bN, bT, bk)[0] * its[i]
+
+    if stats is not None:
+        stats.update({
+            "n_jobs": len(jobs),
+            "n_buckets": n_bucket_global,
+            "bucket_dims": bucket_dims,
+            "plan_s": t_planned - t_submit,
+            "compute_s": compute_total,
+            "pad_waste_frac": (1.0 - agg_waste_num / agg_waste_den
+                               if agg_waste_den > 0 else 0.0),
+            "predicted_wall_s": sum(pl.predicted_wall_s
+                                    for _, pl in plans),
+            "calibrated": m.calibrated,
+        })
+    return results  # type: ignore[return-value]

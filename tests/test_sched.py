@@ -1,0 +1,309 @@
+"""Multi-tenant scheduler equivalence + bucket-packing properties.
+
+The operative contract (ISSUE 8): ``fit_jobs`` over heterogeneous
+(N, T, k) jobs must reproduce each job's lone ``fit()`` — loglik traces,
+params, factors, convergence, health — while running ONE fused batched
+program per shape bucket.  Verified here on the fake 8-device CPU mesh
+(conftest), x64-exact and f32-tolerance variants, on both the
+single-device and sharded scheduler backends; plus the jax-free planner
+properties (every job in exactly one bucket, dominating dims,
+determinism, degenerate mixes), per-axis padding-seam inertness through
+the public helpers, NaN-poisoned tenant isolation, and the
+``obs.advise --jobs`` layout ranking.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dfm_tpu import DynamicFactorModel, Job, fit, fit_jobs
+from dfm_tpu.api import TPUBackend
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.estim.batched import (pad_panel_to_n, pad_panel_to_t,
+                                   pad_params_to_k, pad_params_to_n,
+                                   slice_params_to_k, slice_params_to_n)
+from dfm_tpu.obs.advise import advise_jobs
+from dfm_tpu.sched import plan_buckets
+from dfm_tpu.utils import dgp
+
+
+def _panel(T, N, k, seed=0):
+    rng = np.random.default_rng(seed)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y, _ = dgp.simulate(p_true, T, rng)
+    return Y
+
+
+def _jobs(shapes, seed=0, **kw):
+    return [Job(Y=_panel(T, N, k, seed=seed + i),
+                model=DynamicFactorModel(n_factors=k), tenant=f"t{i}",
+                **kw)
+            for i, (T, N, k) in enumerate(shapes)]
+
+
+def _ref(job, dtype="float64"):
+    """The lone-fit oracle: same engine (info filter) as the scheduler."""
+    return fit(job.model, job.Y,
+               backend=TPUBackend(dtype=dtype, filter="info"),
+               max_iters=job.max_iters, tol=job.tol)
+
+
+def _assert_job_matches(r, ref, rtol=1e-9, atol=1e-7, p_rtol=1e-7):
+    assert len(r.fit.logliks) == len(ref.logliks)
+    np.testing.assert_allclose(r.fit.logliks, ref.logliks,
+                               rtol=rtol, atol=atol)
+    np.testing.assert_allclose(r.fit.factors, ref.factors,
+                               rtol=p_rtol, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(r.fit.params.Lam),
+                               np.asarray(ref.params.Lam),
+                               rtol=p_rtol, atol=1e-8)
+    assert r.fit.converged == ref.converged
+    assert r.fit.health.ok == ref.health.ok
+
+
+# ---------------------------------------------------------------------------
+# Padding helpers (the public N/T seams the scheduler is built on)
+# ---------------------------------------------------------------------------
+
+def test_pad_panel_helpers_shapes_and_zeros():
+    Y = _panel(20, 6, 2, seed=0)
+    Yn = pad_panel_to_n(Y, 9)
+    assert Yn.shape == (20, 9)
+    np.testing.assert_array_equal(Yn[:, :6], Y)
+    assert np.all(Yn[:, 6:] == 0.0)
+    Yt = pad_panel_to_t(Y, 25)
+    assert Yt.shape == (25, 6)
+    np.testing.assert_array_equal(Yt[:20], Y)
+    assert np.all(Yt[20:] == 0.0)
+    # No-op at the target size; refuse to "pad" downward.
+    assert pad_panel_to_n(Y, 6).shape == Y.shape
+    assert pad_panel_to_t(Y, 20).shape == Y.shape
+    with pytest.raises(ValueError):
+        pad_panel_to_n(Y, 5)
+    with pytest.raises(ValueError):
+        pad_panel_to_t(Y, 19)
+
+
+def test_pad_params_slice_roundtrip():
+    Y = _panel(40, 8, 2, seed=1)
+    p = cpu_ref.pca_init(Y, 2)
+    for pad, sl, to in ((pad_params_to_n, slice_params_to_n, 12),
+                        (pad_params_to_k, slice_params_to_k, 4)):
+        q = sl(pad(p, to), p.Lam.shape[0] if pad is pad_params_to_n else 2)
+        for f in ("Lam", "A", "Q", "R", "mu0", "P0"):
+            np.testing.assert_array_equal(np.asarray(getattr(q, f)),
+                                          np.asarray(getattr(p, f)))
+
+
+@pytest.mark.parametrize("other_shape", [(64, 12, 2),   # pads T only
+                                         (50, 16, 2),   # pads N only
+                                         (50, 12, 3)],  # pads k only
+                         ids=["T", "N", "k"])
+def test_padding_inert_per_axis(other_shape):
+    """Force the (50, 12, 2) job into a bucket that pads exactly one axis
+    (max_buckets=1 with a dominating partner); its result must still be
+    the lone fit, axis by axis — the inertness proofs in estim.batched
+    composed through the scheduler."""
+    base = Job(Y=_panel(50, 12, 2, seed=7),
+               model=DynamicFactorModel(n_factors=2), tenant="small",
+               max_iters=40, tol=1e-6)
+    T, N, k = other_shape
+    big = Job(Y=_panel(T, N, k, seed=8),
+              model=DynamicFactorModel(n_factors=k), tenant="big",
+              max_iters=40, tol=1e-6)
+    stats = {}
+    res = fit_jobs([base, big], max_buckets=1, dtype="float64",
+                   stats=stats)
+    assert stats["n_buckets"] == 1
+    assert stats["bucket_dims"] == [(max(50, T), max(12, N), max(2, k))]
+    _assert_job_matches(res[0], _ref(base))
+    _assert_job_matches(res[1], _ref(big))
+    assert res[0].shape == (50, 12, 2)
+    assert res[0].fit.params.Lam.shape == (12, 2)
+    assert res[0].fit.factors.shape[1] == 2
+
+
+# ---------------------------------------------------------------------------
+# Bucket planner properties (jax-free)
+# ---------------------------------------------------------------------------
+
+_MIXES = [
+    [(64, 20, 2), (40, 14, 1), (96, 26, 2), (64, 20, 2)],
+    [(30, 8, 1)] * 5,                                   # all same shape
+    [(20, 6, 1), (400, 80, 6)],                         # pathological spread
+    [(50, 10, 2)],                                      # single job
+    [(64, 20, 2), (65, 20, 2), (66, 20, 2), (200, 40, 4)],
+]
+
+
+@pytest.mark.parametrize("shapes", _MIXES)
+@pytest.mark.parametrize("max_buckets", [1, 2, 3])
+def test_plan_partitions_jobs_exactly_once(shapes, max_buckets):
+    its = [10 + 3 * i for i in range(len(shapes))]
+    plan = plan_buckets(shapes, its, max_buckets=max_buckets)
+    assert 1 <= len(plan.buckets) <= max_buckets
+    covered = sorted(j for b in plan.buckets for j in b.jobs)
+    assert covered == list(range(len(shapes)))          # exactly once
+    for bi, b in enumerate(plan.buckets):
+        for j in b.jobs:
+            assert plan.bucket_of[j] == bi
+            assert all(d >= s for d, s in zip(b.dims, shapes[j]))
+        assert b.cap == max(its[j] for j in b.jobs)
+    assert 0.0 <= plan.pad_waste_frac < 1.0
+    assert all(0.0 <= w < 1.0 for w in plan.job_pad_waste)
+
+
+@pytest.mark.parametrize("shapes", _MIXES)
+def test_plan_deterministic(shapes):
+    a = plan_buckets(shapes, max_buckets=3)
+    b = plan_buckets(shapes, max_buckets=3)
+    assert a.buckets == b.buckets
+    assert a.bucket_of == b.bucket_of
+    assert a.predicted_wall_s == b.predicted_wall_s
+
+
+def test_plan_degenerate_mixes():
+    # Same-shape jobs always collapse into one zero-waste bucket.
+    plan = plan_buckets([(30, 8, 1)] * 4, max_buckets=3)
+    assert len(plan.buckets) == 1 and plan.pad_waste_frac == 0.0
+    # A single job is its own exact bucket.
+    plan = plan_buckets([(50, 10, 2)], [25], max_buckets=3)
+    assert plan.buckets[0].dims == (50, 10, 2)
+    assert plan.buckets[0].cap == 25
+    # Pathological spread at max_buckets>=2 refuses to merge: padding the
+    # tiny job into the huge bucket costs more than a second executable.
+    plan = plan_buckets([(20, 6, 1), (400, 80, 6)], max_buckets=2)
+    assert len(plan.buckets) == 2 and plan.pad_waste_frac == 0.0
+    # Empty input, empty plan.
+    assert plan_buckets([]).buckets == []
+    with pytest.raises(ValueError):
+        plan_buckets([(30, 8, 1)], [0])
+    with pytest.raises(ValueError):
+        plan_buckets([(30, 8, 1)], [5, 5])
+
+
+# ---------------------------------------------------------------------------
+# Scheduler end-to-end equivalence
+# ---------------------------------------------------------------------------
+
+_PARITY_SHAPES = [(60, 20, 2), (44, 14, 1), (80, 26, 2), (60, 20, 2),
+                  (30, 10, 1)]
+
+
+@pytest.mark.parametrize("backend", ["tpu", "sharded"])
+def test_fit_jobs_matches_lone_fits_x64(backend):
+    jobs = _jobs(_PARITY_SHAPES, seed=100, max_iters=40, tol=1e-6)
+    stats = {}
+    res = fit_jobs(jobs, backend=backend, max_buckets=3, dtype="float64",
+                   stats=stats)
+    assert stats["n_jobs"] == len(jobs)
+    assert 1 <= stats["n_buckets"] <= 3
+    for i, (r, job) in enumerate(zip(res, jobs)):
+        _assert_job_matches(r, _ref(job))
+        assert r.tenant == f"t{i}"
+        assert r.fit.backend == f"sched:{backend}"
+        assert r.queue_wait_s >= 0.0 and r.compute_s > 0.0
+        assert 0.0 <= r.pad_waste_frac < 1.0
+
+
+def test_fit_jobs_f32_fixed_iters():
+    """f32 variant at tol=0 (fixed iteration count — the convergence
+    decision is f32-noise-sensitive, the trajectory is not)."""
+    jobs = _jobs([(60, 12, 2), (40, 9, 1), (60, 12, 2)], seed=200,
+                 max_iters=10, tol=0.0)
+    res = fit_jobs(jobs, max_buckets=2, dtype=np.float32)
+    for r, job in zip(res, jobs):
+        ref = _ref(job, dtype=np.float32)
+        assert len(r.fit.logliks) == len(ref.logliks) == 10
+        # Same math, different reduction order: f32 rounding only.
+        np.testing.assert_allclose(r.fit.logliks, ref.logliks,
+                                   rtol=2e-3, atol=0.5)
+        np.testing.assert_allclose(np.asarray(r.fit.params.Lam),
+                                   np.asarray(ref.params.Lam),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_per_tenant_iteration_caps():
+    """Tenants sharing one bucket keep their OWN budgets: at tol=0 each
+    runs exactly its max_iters, frozen in-carry past its cap."""
+    shapes = [(50, 10, 2)] * 3
+    jobs = [Job(Y=_panel(T, N, k, seed=300 + i),
+                model=DynamicFactorModel(n_factors=k), tenant=f"t{i}",
+                max_iters=m, tol=0.0)
+            for i, ((T, N, k), m) in enumerate(zip(shapes, [5, 12, 9]))]
+    stats = {}
+    res = fit_jobs(jobs, max_buckets=1, dtype="float64", stats=stats)
+    assert stats["n_buckets"] == 1
+    assert [len(r.fit.logliks) for r in res] == [5, 12, 9]
+    for r, job in zip(res, jobs):
+        _assert_job_matches(r, _ref(job))
+
+
+def test_nan_poisoned_tenant_is_isolated():
+    """A tenant whose init is NaN-poisoned diverges ALONE: it runs to its
+    cap unconverged while its bucket-mates stay bit-identical to their
+    lone fits (independent batch lanes — the multi-tenant safety story)."""
+    jobs = _jobs([(50, 12, 2)] * 3, seed=400, max_iters=15, tol=1e-6)
+    bad_init = cpu_ref.pca_init(
+        np.asarray(jobs[1].Y) / np.asarray(jobs[1].Y).std(axis=0), 2)
+    bad_init = dataclasses.replace(
+        bad_init, Lam=np.full_like(bad_init.Lam, np.nan))
+    jobs[1] = Job(Y=jobs[1].Y, model=jobs[1].model, tenant="poisoned",
+                  init=bad_init, max_iters=15, tol=1e-6)
+    res = fit_jobs(jobs, max_buckets=1, dtype="float64")
+    assert not res[1].fit.converged
+    assert len(res[1].fit.logliks) == 15          # ran to cap
+    assert not np.isfinite(np.asarray(res[1].fit.logliks)).all()
+    for i in (0, 2):                              # mates unperturbed
+        _assert_job_matches(res[i], _ref(jobs[i]))
+
+
+def test_fit_jobs_validation_and_empty():
+    assert fit_jobs([]) == []
+    with pytest.raises(TypeError):
+        fit_jobs([object()])
+    Y = _panel(30, 8, 1, seed=5)
+    Y[3, 2] = np.nan
+    with pytest.raises(ValueError, match="fully-observed"):
+        fit_jobs([Job(Y=Y, model=DynamicFactorModel(n_factors=1))])
+    with pytest.raises(ValueError, match="backend"):
+        fit_jobs(_jobs([(30, 8, 1)], seed=6), backend="gpu")
+
+
+def test_tenant_telemetry_and_fairness_summary():
+    jobs = _jobs([(50, 12, 2), (40, 9, 1)], seed=500, max_iters=8,
+                 tol=0.0)
+    res = fit_jobs(jobs, max_buckets=2, dtype="float64", telemetry=True)
+    s = res[0].telemetry
+    assert s is not None and s is res[1].telemetry is res[0].fit.telemetry
+    tenants = {e["tenant"]: e for e in s["tenants"]}
+    assert set(tenants) == {"t0", "t1"}
+    for i, job in enumerate(jobs):
+        e = tenants[f"t{i}"]
+        assert (e["T"], e["N"]) == job.Y.shape
+        assert e["n_iters"] == 8 and e["queue_wait_s"] >= 0.0
+        assert e["bucket_T"] >= e["T"] and e["bucket_N"] >= e["N"]
+    fair = s["tenant_fairness"]
+    assert fair["n_tenants"] == 2
+    assert 1 <= fair["n_buckets"] <= 2
+    assert 0.0 <= fair["pad_waste_frac_mean"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# Layout advisor (obs.advise --jobs)
+# ---------------------------------------------------------------------------
+
+def test_advise_jobs_ranks_layouts_deterministically(tmp_path):
+    shapes = [(20, 64, 2), (14, 40, 1), (26, 96, 2), (20, 64, 2)]  # N,T,k
+    a = advise_jobs(shapes, max_iters=20, runs=str(tmp_path))
+    b = advise_jobs(shapes, max_iters=20, runs=str(tmp_path))
+    assert a == b                                   # fully deterministic
+    assert a["calibrated"] is False                 # empty registry
+    walls = [l["predicted_wall_s"] for l in a["layouts"]]
+    assert walls == sorted(walls)
+    assert [l["rank"] for l in a["layouts"]] == list(
+        range(1, len(a["layouts"]) + 1))
+    for l in a["layouts"]:
+        covered = sorted(j for bk in l["buckets"] for j in bk["jobs"])
+        assert covered == list(range(len(shapes)))

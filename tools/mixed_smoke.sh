@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# One-command multi-tenant-scheduler check: run the mixed-shape job-mix
+# bench (bench.mixed — fit_jobs vs loop-over-fits) on the fake 8-device
+# mesh, assert the scheduler metrics are present and the aggregate
+# speedup clears the 3x bar, then gate the recorded run against history
+# via obs.regress.  The quick answer to "is shape-bucketed batching
+# still paying for itself".
+#
+# Usage (from the repo root):
+#   tools/mixed_smoke.sh                     # gate vs best-of-history
+#   DFM_BENCH_SCHED_BACKEND=sharded \
+#     DFM_MIXED_MIN_SPEEDUP=0 tools/mixed_smoke.sh   # mesh-sharded leg
+#
+# The registry lives in .dfm_runs/ (override with DFM_RUNS) — the first
+# smoke run records a baseline, later ones are gated.  JAX_PLATFORMS
+# defaults to cpu so this never burns real-device time; the fake mesh
+# makes the sharded scheduler backend available without real chips.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUNS="${DFM_RUNS:-.dfm_runs}"
+export DFM_RUNS="$RUNS"
+MIN_SPEEDUP="${DFM_MIXED_MIN_SPEEDUP:-3.0}"
+
+# Seed history from the checked-in bench artifacts (idempotent).
+python -m dfm_tpu.obs.store backfill --runs "$RUNS" >/dev/null
+
+OUT=$(JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" \
+      XLA_FLAGS="${XLA_FLAGS---xla_force_host_platform_device_count=8}" \
+      python -m bench.mixed)
+echo "$OUT"
+
+RUN_ID=$(printf '%s' "$OUT" | python -c \
+    'import json,sys; print(json.loads(sys.stdin.readline())["run_id"])')
+
+# The scheduler metrics must be present in the bench line (and therefore
+# in the recorded run, where obs.regress gates them: the aggregate rate
+# as higher-is-better; pad_waste_frac and scheduler_overhead_ms as
+# lower-is-better with their own noise floors, see obs/store.py) — and
+# the batched programs must actually beat the loop-over-fits baseline.
+printf '%s' "$OUT" | MIN_SPEEDUP="$MIN_SPEEDUP" python -c '
+import json, os, sys
+d = json.loads(sys.stdin.readline())
+missing = [k for k in ("aggregate_mixed_iters_per_sec", "pad_waste_frac",
+                       "scheduler_overhead_ms", "speedup_vs_looped")
+           if d.get(k) is None]
+assert not missing, f"mixed smoke FAILED: bench line missing {missing}"
+need = float(os.environ["MIN_SPEEDUP"])
+got = float(d["speedup_vs_looped"])
+assert got >= need, (
+    f"mixed smoke FAILED: scheduler speedup {got}x < {need}x vs looped")
+print("mixed smoke OK: %d jobs in %d buckets, %.2fx vs looped, "
+      "pad waste %.1f%%, overhead %.1f ms"
+      % (d["n_jobs"], d["n_buckets"], got,
+         100 * d["pad_waste_frac"], d["scheduler_overhead_ms"]))'
+
+echo "--- mixed gate (run $RUN_ID vs ${*:-history}) ---" >&2
+python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"

@@ -52,5 +52,19 @@ missing = [k for k in ("e2e_warm_fit_iters_per_sec", "blocking_transfers",
            if d.get(k) is None]
 sys.exit(f"perf_gate: bench line missing {missing}" if missing else 0)'
 
+# The multi-tenant scheduler metrics (bench.mixed / tools/mixed_smoke.sh)
+# must stay registered in the observatory with their directions + noise
+# floors, or recorded mixed runs silently stop being gated.
+python -c '
+from dfm_tpu.obs import store
+need = ("aggregate_mixed_iters_per_sec", "pad_waste_frac",
+        "scheduler_overhead_ms")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+for k in ("pad_waste_frac", "scheduler_overhead_ms"):
+    assert store.lower_is_better(k), \
+        f"perf_gate: {k} lost its lower-is-better marker"
+assert not store.lower_is_better("aggregate_mixed_iters_per_sec")'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"
