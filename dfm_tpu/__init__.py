@@ -17,6 +17,7 @@ from .estim.select import (bai_ng_ic, select_n_factors, select_n_factors_em,
 from .estim.evaluate import oos_evaluate
 from .estim.batched import DFMBatchSpec, BatchFitResult, fit_many
 from .sched import Job, JobResult
+from .serve import NowcastSession, SessionUpdate, open_session
 
 __version__ = "0.1.0"
 
@@ -28,5 +29,6 @@ __all__ = [
     "targeted_predictors", "oos_evaluate",
     "DFMBatchSpec", "BatchFitResult", "fit_many",
     "fit_jobs", "Job", "JobResult",
+    "NowcastSession", "SessionUpdate", "open_session",
     "__version__",
 ]

@@ -97,6 +97,10 @@ class FitResult:
     #                                  # bucket, predicted_wall_s, ...} +
     #                                  # realized_wall_s / rel_err once
     #                                  # the fit returns
+    session: Optional[object] = None   # fit(keep_session=True) only: a
+    #                                  # serve.NowcastSession holding this
+    #                                  # fit's params + panel device-
+    #                                  # resident for streaming updates
 
     @property
     def loglik(self) -> float:
@@ -453,9 +457,30 @@ class TPUBackend(Backend):
         # Panel residency for warm refits: unlike _panel_cache (one-shot),
         # this cache persists across fits on the same backend instance, so
         # fit(warm_start=prev) re-enters the program with zero h2d upload.
+        # Identity hit is free; on an identity miss, CONTENT equality of
+        # host panels (utils.checkpoint.panel_mismatch) still reuses the
+        # device buffers — a serving loop that copies the panel between
+        # refits keeps the zero-upload path.  A content mismatch re-uploads
+        # and names the differing field in a panel_reupload trace event
+        # (updated values are the normal serving flow, not a warning).
         fp = self._fused_panel
-        if (fp is not None and fp[0] is Y and fp[1] is mask
-                and fp[2].dtype == dt):
+        reuse = False
+        if fp is not None and fp[2].dtype == dt:
+            if fp[0] is Y and fp[1] is mask:
+                reuse = True
+            elif isinstance(Y, np.ndarray) and isinstance(fp[0], np.ndarray):
+                # Never content-compare device arrays: that would force
+                # the d2h transfer the cache exists to avoid.
+                from .utils.checkpoint import panel_mismatch
+                diff = panel_mismatch(Y, mask, fp[0], fp[1])
+                if diff is None:
+                    reuse = True
+                    self._fused_panel = (Y, mask, fp[2], fp[3])
+                else:
+                    tr = current_tracer()
+                    if tr is not None:
+                        tr.emit("panel_reupload", reason=diff)
+        if reuse:
             Yj, mj = fp[2], fp[3]
         else:
             Yj = self._device_panel(Y, mask, dt)
@@ -981,7 +1006,8 @@ def fit(model,                     # DynamicFactorModel | family spec
         pipeline=None,
         fused=False,
         warm_start=None,
-        auto=False):
+        auto=False,
+        keep_session=False):
     """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
 
     ``model`` may also be a family spec — ``MixedFreqSpec``, ``TVLSpec``,
@@ -1086,6 +1112,15 @@ def fit(model,                     # DynamicFactorModel | family spec
         to the default knobs with a RuntimeWarning — ``auto`` never
         profiles inside ``fit`` and never tunes on pure priors.
         Mutually exclusive with explicit ``pipeline=``/``fused=``.
+    keep_session : open a streaming ``serve.NowcastSession`` on the fitted
+        model (``FitResult.session``): params AND panel stay device-
+        resident in a capacity-padded buffer, and every
+        ``session.update(new_rows)`` runs ONE fused program dispatch (m
+        warm EM iterations + smooth + nowcast/forecast) with zero
+        recompiles after warmup.  ``True`` uses the session defaults; a
+        dict passes ``open_session`` keywords (capacity,
+        max_update_rows, max_iters, tol, horizon, di).
+        DynamicFactorModel fits on JAX backends only.
     """
     tracer, owned = fit_tracer(telemetry)
     cache_dir = setup_compile_cache(ambient_only=True)
@@ -1098,6 +1133,14 @@ def fit(model,                     # DynamicFactorModel | family spec
                             callback, checkpoint_path, checkpoint_every,
                             debug, robust, progress, pipeline, fused,
                             warm_start, auto)
+            if keep_session and isinstance(res, FitResult):
+                # Session open uses the ORIGINAL-units panel from this
+                # scope (the session re-applies res.standardizer itself).
+                from .serve import open_session
+                skw = (dict(keep_session) if isinstance(keep_session, dict)
+                       else {})
+                res.session = open_session(res, Y, mask=mask,
+                                           backend=backend, **skw)
             if isinstance(res, FitResult) and res.advice is not None:
                 # Close the advisor's loop: realized wall next to the
                 # prediction (rel_err is the model-drift metric obs.regress

@@ -156,9 +156,14 @@ def mstep_rows(Y, mask, Ef, EffT, P_sm, S_ff, r_floor: float, Ysq=None):
 
 
 def mstep_dynamics_sums(sm: SmootherResult, S_ff_lag, S_ff_cur, S_cross,
-                        p: SSMParams, cfg: EMConfig):
-    """Replicated k x k M-step updates (A, Q, mu0, P0) from SUMMED moments."""
-    T = sm.x_sm.shape[0]
+                        p: SSMParams, cfg: EMConfig, n_steps=None):
+    """Replicated k x k M-step updates (A, Q, mu0, P0) from SUMMED moments.
+
+    ``n_steps`` (optional, traced): effective panel length when ``Y`` is
+    capacity-padded past the live data (serve sessions) — the transition
+    count divisor becomes ``n_steps - 1`` instead of the static ``T - 1``.
+    """
+    T = sm.x_sm.shape[0] if n_steps is None else n_steps
     A, Q = p.A, p.Q
     if cfg.estimate_A:
         A = solve_psd(S_ff_lag, S_cross.T).T
@@ -181,9 +186,34 @@ def mstep_dynamics(sm: SmootherResult, EffT, cross, p: SSMParams,
                                cross.sum(0), p, cfg)
 
 
+def mstep_dynamics_tmasked(sm: SmootherResult, EffT, cross, p: SSMParams,
+                           cfg: EMConfig, n_steps):
+    """``mstep_dynamics`` for a capacity-padded panel: only the first
+    ``n_steps`` (traced) time steps are live data; the trailing pad rows are
+    zero-masked in the observation model, so their smoother moments must be
+    excluded from the transition sums.  The sums become {0,1}-weighted
+    reductions (weights exact, so pad entries contribute exact zeros) with
+    a traced ``n_steps - 1`` transition-count divisor — ONE executable then
+    serves every live length a session can reach."""
+    Tc = EffT.shape[0]
+    dt = EffT.dtype
+    t_idx = jnp.arange(Tc)
+    w_lag = (t_idx < n_steps - 1).astype(dt)
+    w_cur = ((t_idx >= 1) & (t_idx < n_steps)).astype(dt)
+    w_x = (jnp.arange(Tc - 1) < n_steps - 1).astype(dt)
+    S_lag = jnp.einsum("t,tkl->kl", w_lag, EffT)
+    S_cur = jnp.einsum("t,tkl->kl", w_cur, EffT)
+    S_cross = jnp.einsum("t,tkl->kl", w_x, cross)
+    return mstep_dynamics_sums(sm, S_lag, S_cur, S_cross, p, cfg,
+                               n_steps=n_steps)
+
+
 def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig,
-            Ysq=None):
+            Ysq=None, n_steps=None):
     if mask is None:
+        if n_steps is not None:
+            raise ValueError("n_steps (capacity-padded panels) requires a "
+                             "mask: the pad tail must be zero-masked")
         S_ff, S_lag, S_cur, S_cross = moment_sums(sm)
         Lam, R = mstep_rows(Y, None, sm.x_sm, None, None, S_ff, cfg.r_floor,
                             Ysq=Ysq)
@@ -193,7 +223,11 @@ def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig,
         S_ff = EffT.sum(0)
         Lam, R = mstep_rows(Y, mask, sm.x_sm, EffT, sm.P_sm, S_ff,
                             cfg.r_floor)
-        A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p, cfg)
+        if n_steps is None:
+            A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p, cfg)
+        else:
+            A, Q, mu0, P0 = mstep_dynamics_tmasked(sm, EffT, cross, p, cfg,
+                                                   n_steps)
     return SSMParams(Lam, A, Q, R, mu0, P0)
 
 
@@ -790,16 +824,18 @@ def _em_scan_core_metrics(Y, mask, p0, cfg, has_mask, n_iters):
     return p, lls, deltas, metrics
 
 
-def _em_chunk_body(Y, m, cfg, sumsq, Ysq, n_active):
+def _em_chunk_body(Y, m, cfg, sumsq, Ysq, n_active, n_steps=None):
     """Shared live-capped EM chunk body: one (E-step, M-step) per scanned
     index ``j``, holding the param carry via where-selects once
     ``j >= n_active`` (the batched engine's convergence-freeze idiom).
     Used by both the bucketed chunk scan (`_em_scan_core_active`) and the
-    fused while-loop driver (`estim.fused`)."""
+    fused while-loop driver (`estim.fused`).  ``n_steps`` (traced,
+    optional): live time-step count for capacity-padded panels — threads
+    into the t-masked M-step dynamics (serve sessions)."""
 
     def body(p, j):
         kf, sm, delta = cfg.e_step(Y, m, p, sumsq=sumsq)
-        p_new = _m_step(Y, m, sm, p, cfg, Ysq=Ysq)
+        p_new = _m_step(Y, m, sm, p, cfg, Ysq=Ysq, n_steps=n_steps)
         live = j < n_active
         p_out = jax.tree_util.tree_map(
             lambda a, b: jnp.where(live, a, b), p_new, p)

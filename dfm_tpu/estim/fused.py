@@ -120,9 +120,58 @@ def _di_forecast_core(F, Y, horizon, ridge=1e-8):
     return jnp.einsum("nd,nd->n", x_last, beta)
 
 
-def _fused_fit_core(Y, mask, p0, tol, noise_floor, cfg, has_mask, max_iters, chunk, opts):
-    m = mask if has_mask else None
-    sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
+def _di_forecast_core_masked(F, Y, t_new, horizon, ridge=1e-8):
+    """``_di_forecast_core`` for a capacity-padded panel: only the first
+    ``t_new`` (traced) time steps are live.  The regression rows past the
+    live prefix get exact {0,1} zero weights (pad-tail smoother states are
+    finite predictions, so weighted products stay finite), and the "last"
+    rows are dynamic gathers at ``t_new - 1`` / ``t_new - 2`` — static
+    shapes throughout, so ONE executable serves every live length."""
+    T, k = F.shape
+    N = Y.shape[1]
+    d = k + 2
+    dt = F.dtype
+    L = max(T - 1 - horizon, 0)
+    n_fit = jnp.maximum(t_new - 1 - horizon, 0)
+    w = (jnp.arange(L) < n_fit).astype(dt)
+    Xf = jnp.concatenate([jnp.ones((L, 1), dt), F[1 : 1 + L]], axis=1)
+    Ylag = Y[:L]
+    Z = Y[1 + horizon : 1 + horizon + L]
+    Xw = Xf * w[:, None]
+    Gff = Xw.T @ Xf
+    Gfy = Xw.T @ Ylag
+    Gyy = jnp.einsum("t,ti,ti->i", w, Ylag, Ylag)
+    bf = Xw.T @ Z
+    by = jnp.einsum("t,ti,ti->i", w, Ylag, Z)
+    XtX = jnp.zeros((N, d, d), dt)
+    XtX = XtX.at[:, : d - 1, : d - 1].set(Gff[None])
+    XtX = XtX.at[:, : d - 1, d - 1].set(Gfy.T)
+    XtX = XtX.at[:, d - 1, : d - 1].set(Gfy.T)
+    XtX = XtX.at[:, d - 1, d - 1].set(Gyy)
+    XtX = XtX + ridge * jnp.eye(d, dtype=dt)[None]
+    Xtz = jnp.concatenate([bf.T, by[:, None]], axis=1)
+    beta = jnp.linalg.solve(XtX, Xtz[..., None])[..., 0]
+    f_last = jnp.take(F, t_new - 1, axis=0, mode="clip")
+    y_prev = jnp.take(Y, t_new - 2, axis=0, mode="clip")
+    x_last = jnp.concatenate(
+        [jnp.ones((N, 1), dt), jnp.broadcast_to(f_last, (N, k)),
+         y_prev[:, None]],
+        axis=1,
+    )
+    return jnp.einsum("nd,nd->n", x_last, beta)
+
+
+def _em_while_core(Y, m, p0, tol, noise_floor, cfg, max_iters, chunk, opts,
+                   sumsq=None, Ysq=None, n_steps=None):
+    """EM-to-convergence while-loop shared by the fused fit and the serve
+    session program.  Returns the final while-loop carry dict (params,
+    last-good checkpoint, loglik path, iteration counters, status).
+
+    ``n_steps`` (traced, optional): live time-step count for
+    capacity-padded panels (serve sessions) — threads into the t-masked
+    M-step dynamics via ``_em_chunk_body``; the zero-masked pad tail is
+    exactly inert in the E-step, so ONE executable serves every live
+    length a session can reach."""
     C = chunk
     n_chunks = -(-max_iters // C)
     acc = accum_dtype(Y.dtype)
@@ -141,7 +190,8 @@ def _fused_fit_core(Y, mask, p0, tol, noise_floor, cfg, has_mask, max_iters, chu
         # Tail chunks reuse the same executable: always scan C iterations
         # with a traced live-cap, exactly like _em_scan_core_active.
         n_active = jnp.minimum(C, max_iters - it).astype(i32)
-        body = _em_chunk_body(Y, m, cfg, sumsq, Ysq, n_active)
+        body = _em_chunk_body(Y, m, cfg, sumsq, Ysq, n_active,
+                              n_steps=n_steps)
         p_end, (lls_c, _) = lax.scan(body, p, jnp.arange(C))
         lls_c = lls_c.astype(acc)
         if opts.fault_chunk is not None:  # static test seam
@@ -204,7 +254,14 @@ def _fused_fit_core(Y, mask, p0, tol, noise_floor, cfg, has_mask, max_iters, chu
         "emb": jnp.zeros((), i32),
         "status": jnp.asarray(_RUNNING, i32),
     }
-    f = lax.while_loop(cond, step, carry0)
+    return lax.while_loop(cond, step, carry0)
+
+
+def _fused_fit_core(Y, mask, p0, tol, noise_floor, cfg, has_mask, max_iters, chunk, opts):
+    m = mask if has_mask else None
+    sumsq, Ysq = _panel_consts(Y, has_mask, cfg)
+    f = _em_while_core(Y, m, p0, tol, noise_floor, cfg, max_iters, chunk,
+                       opts, sumsq=sumsq, Ysq=Ysq)
     p_fit = f["p"]
 
     # Smooth + forecast at the fitted params, same program.  ss/pit

@@ -179,6 +179,10 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
     # with its bucket assignment and queue/compute/pad-waste accounting.
     tenants = [{k: v for k, v in e.items() if k != "kind"}
                for e in events if e.get("kind") == "tenant"]
+    # Streaming nowcast sessions (serve.NowcastSession): one event per
+    # query with its end-to-end wall, row counts and convergence flags.
+    queries = [{k: v for k, v in e.items() if k != "kind"}
+               for e in events if e.get("kind") == "query"]
 
     out = {
         "n_events": len(events),
@@ -279,6 +283,37 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
             "queue_wait_s": _stats(waits),
             "pad_waste_frac_mean": (sum(wastes) / len(wastes)
                                     if wastes else None)}
+    if queries:
+        per_session: dict = {}
+        for q in queries:
+            sid = str(q.get("session", "?"))
+            ps = per_session.setdefault(
+                sid, {"queries": 0, "walls": [], "t_rows": None})
+            ps["queries"] += 1
+            if isinstance(q.get("wall"), (int, float)):
+                ps["walls"].append(float(q["wall"]))
+            if q.get("t_rows") is not None:
+                ps["t_rows"] = int(q["t_rows"])
+        for ps in per_session.values():
+            st = _stats(ps.pop("walls"))
+            if st:
+                ps["query_wall_s"] = st
+        walls = [float(q["wall"]) for q in queries
+                 if isinstance(q.get("wall"), (int, float))]
+        # Warm-path health: any serve_update recompile past each
+        # executable's first call means the session's one-program promise
+        # broke (shape drift / cache eviction) — should be 0.
+        out["queries"] = {
+            "n_queries": len(queries),
+            "n_sessions": len(per_session),
+            "converged": sum(1 for q in queries if q.get("converged")),
+            "diverged": sum(1 for q in queries if q.get("diverged")),
+            "query_wall_s": _stats(walls),
+            "recompiles_after_warmup": sum(
+                1 for e in disp if e.get("program") == "serve_update"
+                and e.get("recompile")),
+            "per_session": per_session,
+        }
     return out
 
 
@@ -401,6 +436,31 @@ def _print_text(s: dict) -> None:
             if t.get("n_iters") is not None:
                 bits.append(f"{t['n_iters']} iters")
             bits.append("converged" if t.get("converged") else "NOT converged")
+            print(", ".join(bits))
+    qs = s.get("queries")
+    if qs:
+        qw = qs.get("query_wall_s") or {}
+        line = (f"queries: {qs['n_queries']} across {qs['n_sessions']} "
+                f"session{'s' if qs['n_sessions'] != 1 else ''}, "
+                f"{qs['converged']} converged")
+        if qs.get("diverged"):
+            line += f", {qs['diverged']} DIVERGED"
+        if qw:
+            line += (f"; wall p50 {_fmt_s(qw['p50'])} / "
+                     f"p99 {_fmt_s(qw['p99'])}")
+        r = qs.get("recompiles_after_warmup", 0)
+        line += (f"; recompiles after warmup {r}"
+                 + (" (!!)" if r else ""))
+        print(line)
+        for sid, ps in qs.get("per_session", {}).items():
+            bits = [f"  session {sid}: {ps['queries']} "
+                    f"quer{'ies' if ps['queries'] != 1 else 'y'}"]
+            if ps.get("t_rows") is not None:
+                bits.append(f"{ps['t_rows']} rows held")
+            pw = ps.get("query_wall_s") or {}
+            if pw:
+                bits.append(f"wall p50 {_fmt_s(pw['p50'])} / "
+                            f"p99 {_fmt_s(pw['p99'])}")
             print(", ".join(bits))
     a = s.get("advice")
     if a:

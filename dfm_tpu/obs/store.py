@@ -263,6 +263,7 @@ _BENCH_NUMERIC_KEYS = (
     "p99_dispatch_ms", "advice_rel_err",
     "aggregate_mixed_iters_per_sec", "pad_waste_frac",
     "scheduler_overhead_ms",
+    "serve_p50_ms", "serve_p99_ms", "serve_blocking_transfers_per_query",
 )
 
 

@@ -1,0 +1,14 @@
+"""Streaming nowcast service: device-resident incremental panel updates.
+
+``open_session(res, Y)`` (or ``fit(..., keep_session=True)``) turns a
+fitted model into a persistent ``NowcastSession`` whose params AND panel
+stay device-resident in a capacity-padded buffer; every
+``session.update(new_rows)`` uploads only the new rows and runs ONE fused
+jitted program — in-graph panel append, m warm EM iterations, RTS smooth,
+nowcast + forecasts — with zero recompiles across updates and at most one
+blocking device->host read per query.
+"""
+
+from .session import NowcastSession, SessionUpdate, open_session
+
+__all__ = ["NowcastSession", "SessionUpdate", "open_session"]

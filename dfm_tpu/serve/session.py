@@ -1,0 +1,423 @@
+"""Persistent nowcast sessions: one fused program dispatch per query.
+
+The production query loop (ROADMAP item 1; PAPERS.md arXiv 1910.08615's
+online-update-and-impute use of a fitted smoother) is "here is this
+month's ragged-edge panel update, give me the nowcast now".  A cold
+``fit()`` pays the whole-panel h2d upload plus a stream of ~60-100 ms
+tunnel dispatches per query; a ``NowcastSession`` pays them ONCE at open:
+
+- The standardized panel and its {0,1} observation mask live on device in
+  a capacity-padded (T_cap, N) buffer (``estim.batched.pad_panel_to_t``
+  zero rows + zero-mask tail — the masked filter/M-step are exactly inert
+  there, the PR 8 scheduler's proven seam).
+- ``update(new_rows)`` uploads only the new rows (tiny h2d), then runs
+  ONE jitted program: in-graph scatter append + mask flip, m warm EM
+  iterations (``estim.fused._em_while_core`` with a traced live-length
+  ``n_steps`` — the t-masked M-step divides by the true transition
+  count), RTS smooth, nowcast and state-space + diffusion-index
+  forecasts.  The live length and row count are traced scalars, so every
+  update of the session's lifetime reuses the SAME executable: zero
+  recompiles after warmup.
+- Host reads happen inside one barrier'd dispatch span (``serve_update``
+  trace program): at most one blocking d2h per query.  The panel buffers
+  and params are donated back in place on real devices.
+
+Numerics: an update is the same program a cold ``fit(fused=True)`` on
+the concatenated panel would run at the same iteration budget — pinned
+by tests/test_serve.py (x64-exact for the dense small-N filter, where
+the pad algebra is bitwise inert; fp-tolerance for info-form/f32).
+Capacity overflow and row-budget violations raise on host BEFORE any
+dispatch.  A diverged update keeps the on-device last-good params (the
+fused driver's replay rule) and warns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+import warnings
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..estim.batched import pad_panel_to_t
+from ..estim.em import EMConfig, noise_floor_for
+from ..estim.fused import (FusedOptions, _CONVERGED, _DIVERGED,
+                           _di_forecast_core_masked, _em_while_core)
+from ..obs.trace import current_tracer, shape_key
+from ..ops.precision import accum_dtype
+from ..ssm.info_filter import info_filter
+from ..ssm.kalman import kalman_filter, rts_smoother
+from ..ssm.params import SSMParams as JaxParams
+from ..utils.data import build_mask
+
+__all__ = ["NowcastSession", "SessionUpdate", "open_session"]
+
+_SESSION_IDS = itertools.count(1)
+
+
+def _session_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol, floor,
+                  cfg, max_iters, chunk, opts):
+    """One query: append rows, m warm EM iters, smooth, nowcast/forecast.
+
+    ``rows``/``rmask`` are (r_max, N) with exact-zero rows past ``n_new``
+    (host-padded), so the scatter lands zeros on zero-masked tail slots —
+    the buffer invariant (pad region exactly zero) is preserved for every
+    ragged row count.  ``mode="drop"`` discards rim-adjacent writes past
+    capacity (the host raised on real overflow before dispatch).
+    """
+    r_max = rows.shape[0]
+    idx = t_cur + jnp.arange(r_max)
+    Ybuf = Ybuf.at[idx].set(rows, mode="drop")
+    Wbuf = Wbuf.at[idx].set(rmask, mode="drop")
+    t_new = t_cur + n_new
+    f = _em_while_core(Ybuf, Wbuf, p0, tol, floor, cfg, max_iters, chunk,
+                       opts, n_steps=t_new)
+    p_fit = f["p"]
+    # Smooth + forecast at the fitted params, same program — the exact
+    # filter/smoother pair the fused fit uses (ss never reaches masked
+    # panels: _filter_for(masked=True) returns dense or info only).
+    ff = kalman_filter if cfg.filter == "dense" else info_filter
+    kf = ff(Ybuf, p_fit, mask=Wbuf)
+    sm = rts_smoother(kf, p_fit)
+    x_T = jnp.take(sm.x_sm, t_new - 1, axis=0, mode="clip")
+    P_T = jnp.take(sm.P_sm, t_new - 1, axis=0, mode="clip")
+    nowcast = p_fit.Lam @ x_T
+
+    def fstep(carry, _):
+        x, P = carry
+        x1 = p_fit.A @ x
+        P1 = p_fit.A @ P @ p_fit.A.T + p_fit.Q
+        return (x1, P1), (x1, p_fit.Lam @ x1)
+
+    _, (f_fore, y_fore) = lax.scan(fstep, (x_T, P_T), None,
+                                   length=opts.horizon)
+    di = (_di_forecast_core_masked(sm.x_sm, Ybuf, t_new, opts.horizon)
+          if opts.di else None)
+    return {
+        "Ybuf": Ybuf,
+        "Wbuf": Wbuf,
+        "p": p_fit,
+        "p_good": f["p_good"],
+        "good_it": f["good_it"],
+        "lls": f["lls"],
+        "n_iters": f["it"],
+        "status": f["status"],
+        "x_sm": sm.x_sm,
+        "P_sm": sm.P_sm,
+        "nowcast": nowcast,
+        "f_fore": f_fore,
+        "y_fore": y_fore,
+        "di": di,
+    }
+
+
+_STATICS = ("cfg", "max_iters", "chunk", "opts")
+
+
+@partial(jax.jit, static_argnames=_STATICS)
+def _session_impl(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol, floor, *,
+                  cfg, max_iters, chunk, opts):
+    return _session_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
+                         floor, cfg, max_iters, chunk, opts)
+
+
+# Donated twin: panel buffers (0, 1) and params (6) are consumed in place
+# — the session immediately rebinds the returned arrays, so device memory
+# stays one buffer set deep.  CPU backends use the plain twin (donation is
+# unimplemented there and warns).
+@partial(jax.jit, static_argnames=_STATICS, donate_argnums=(0, 1, 6))
+def _session_impl_donated(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
+                          floor, *, cfg, max_iters, chunk, opts):
+    return _session_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
+                         floor, cfg, max_iters, chunk, opts)
+
+
+@dataclasses.dataclass
+class SessionUpdate:
+    """Host-side view of one ``NowcastSession.update`` (original units)."""
+
+    nowcast: np.ndarray        # (N,) end-of-sample nowcast, original units
+    forecasts: dict            # {"y": (h, N), "f": (h, k), "di": (N,)|None}
+    logliks: np.ndarray        # per-iteration loglik path of this update
+    n_iters: int               # EM iterations this update consumed
+    converged: bool
+    diverged: bool
+    factors: np.ndarray        # (t, k) smoothed factor means, live prefix
+    factor_cov: np.ndarray     # (t, k, k) smoothed covariances
+    t: int                     # live panel length after this update
+    wall_s: float
+
+
+class NowcastSession:
+    """Device-resident streaming nowcast session (see module docstring).
+
+    Open via ``open_session(res, Y)`` or ``fit(..., keep_session=True)``;
+    then each ``update(new_rows, mask=None)`` appends the rows and
+    returns a ``SessionUpdate``.  The first update compiles the program
+    (warmup); every later update reuses the same executable.
+    """
+
+    def __init__(self, res, Y, mask=None, *, capacity: Optional[int] = None,
+                 max_update_rows: int = 8, max_iters: int = 5,
+                 tol: float = 1e-6, horizon: Optional[int] = None,
+                 di: Optional[bool] = None, backend=None):
+        from ..api import (CPUBackend, DynamicFactorModel, FitResult,
+                           get_backend)
+        if not isinstance(res, FitResult):
+            raise TypeError(
+                f"open_session needs a FitResult; got {type(res).__name__}")
+        if not isinstance(res.model, DynamicFactorModel):
+            raise TypeError(
+                f"sessions support DynamicFactorModel fits only; got "
+                f"{type(res.model).__name__}")
+        b = get_backend(backend if backend is not None else "tpu")
+        if isinstance(b, CPUBackend) or not hasattr(b, "_fused_panel"):
+            raise ValueError(
+                f"backend {b.name!r} has no fused device programs; "
+                "sessions need a single-device JAX backend "
+                "(backend=\"tpu\" or a TPUBackend instance)")
+        Y = np.asarray(Y, dtype=np.float64)
+        if Y.ndim != 2:
+            raise ValueError(f"Y must be (T, N); got shape {Y.shape}")
+        T0, N = Y.shape
+        Lam = np.asarray(res.params.Lam)
+        if Lam.shape[0] != N:
+            raise ValueError(
+                f"FitResult params are for N={Lam.shape[0]} series but the "
+                f"panel has N={N}")
+        self._opts = FusedOptions(
+            horizon=1 if horizon is None else max(1, int(horizon)),
+            di=True if di is None else bool(di))
+        if T0 < self._opts.horizon + 3:
+            raise ValueError(
+                f"session needs T >= horizon + 3 = {self._opts.horizon + 3} "
+                f"live rows to anchor the forecast regressions; got T={T0}")
+        capacity = 2 * T0 if capacity is None else int(capacity)
+        if capacity < T0:
+            raise ValueError(f"capacity={capacity} < panel length T={T0}")
+        # Frozen standardizer: incoming rows are transformed with the
+        # OPEN-time stats (re-standardizing per query would re-unit the
+        # device-resident params).  NaNs stay NaN through the affine map.
+        self._std = res.standardizer
+        Yz = self._std.transform(Y) if self._std is not None else Y
+        W = build_mask(Y, mask)
+        Yz = np.where(W > 0, np.nan_to_num(Yz), 0.0)
+        dt = b._dtype()
+        with b._precision_ctx():
+            self._Ybuf = jnp.asarray(pad_panel_to_t(Yz, capacity), dt)
+            self._Wbuf = jnp.asarray(pad_panel_to_t(W, capacity), dt)
+            self._p = JaxParams.from_numpy(res.params, dtype=dt)
+        flt = b._filter_for(N, True)   # masked: dense or info, never ss
+        self._cfg = EMConfig(estimate_A=res.model.estimate_A,
+                             estimate_Q=res.model.estimate_Q,
+                             estimate_init=res.model.estimate_init,
+                             filter=flt, debug=False)
+        self._backend = b
+        self._model = res.model
+        self._dt = dt
+        self._acc = accum_dtype(dt)
+        self._N = N
+        self._t = T0
+        self._capacity = capacity
+        self._r_max = max(1, int(max_update_rows))
+        self._max_iters = max(1, int(max_iters))
+        self._tol = float(tol)
+        self._chunk = max(1, int(getattr(b, "fused_chunk", 8)))
+        self._closed = False
+        self._n_queries = 0
+        self._sid = f"s{next(_SESSION_IDS)}"
+        self._key = shape_key(self._Ybuf, flt, f"rows{self._r_max}",
+                              f"chunk{self._chunk}",
+                              f"max{self._max_iters}")
+
+    # -- introspection -------------------------------------------------
+    @property
+    def t(self) -> int:
+        """Live panel length (rows appended so far + the open panel)."""
+        return self._t
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def remaining(self) -> int:
+        """Rows that can still be appended before capacity overflow."""
+        return self._capacity - self._t
+
+    @property
+    def session_id(self) -> str:
+        return self._sid
+
+    def params(self):
+        """Current device-resident params as host numpy (one transfer)."""
+        self._check_open()
+        return self._p.to_numpy()
+
+    def _check_open(self):
+        if self._closed:
+            raise RuntimeError("session is closed")
+
+    # -- the query path ------------------------------------------------
+    def update(self, new_rows, mask=None) -> SessionUpdate:
+        """Append ``new_rows`` ((n, N) or (N,), original units; NaN =
+        missing, ``mask`` optional {0,1}) and re-estimate: m warm EM
+        iterations + smooth + nowcast/forecast in ONE program dispatch.
+
+        All capacity/shape validation happens on host BEFORE any device
+        work — an oversized update raises without touching the session.
+        """
+        self._check_open()
+        rows = np.asarray(new_rows, dtype=np.float64)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.ndim != 2 or rows.shape[1] != self._N:
+            raise ValueError(
+                f"new_rows must be (n, {self._N}) or ({self._N},); got "
+                f"shape {np.asarray(new_rows).shape}")
+        n_new = rows.shape[0]
+        if n_new == 0:
+            raise ValueError("new_rows is empty")
+        if n_new > self._r_max:
+            raise ValueError(
+                f"update has {n_new} rows but the session was opened with "
+                f"max_update_rows={self._r_max}; open with a larger row "
+                "budget (one executable serves every count up to it)")
+        if self._t + n_new > self._capacity:
+            raise ValueError(
+                f"capacity overflow: session holds {self._t} rows of "
+                f"{self._capacity} and cannot take {n_new} more; open a "
+                "fresh session with a larger capacity")
+        W_rows = build_mask(rows, mask)
+        rz = self._std.transform(rows) if self._std is not None else rows
+        rz = np.where(W_rows > 0, np.nan_to_num(rz), 0.0)
+        pad = self._r_max - n_new
+        if pad:   # exact-zero fill past n_new: lands on zero-masked slots
+            rz = np.concatenate(
+                [rz, np.zeros((pad, self._N), rz.dtype)], axis=0)
+            W_rows = np.concatenate(
+                [W_rows, np.zeros((pad, self._N), W_rows.dtype)], axis=0)
+        t_new = self._t + n_new
+        # Per-update absolute loglik noise floor at the LIVE panel size —
+        # the same floor a cold fit of the extended panel would use.
+        floor = noise_floor_for(self._dt, t_new * self._N,
+                                mult=self._cfg.noise_floor_mult)
+        args = (self._Ybuf, self._Wbuf,
+                jnp.asarray(rz, self._dt), jnp.asarray(W_rows, self._dt),
+                jnp.asarray(n_new, jnp.int32),
+                jnp.asarray(self._t, jnp.int32),
+                self._p,
+                jnp.asarray(self._tol, self._acc),
+                jnp.asarray(floor, self._acc))
+        kw = dict(cfg=self._cfg, max_iters=self._max_iters,
+                  chunk=self._chunk, opts=self._opts)
+        impl = (_session_impl if jax.default_backend() == "cpu"
+                else _session_impl_donated)
+        tr = current_tracer()
+        t0 = time.perf_counter()
+        with self._backend._precision_ctx():
+            if tr is None:
+                out = impl(*args, **kw)
+                host = self._read(out)
+            else:
+                tr.maybe_cost("serve_update", self._key, impl, *args, **kw)
+                with tr.dispatch("serve_update", self._key, barrier=True,
+                                 fused=True,
+                                 n_iters=self._max_iters) as rec:
+                    out = impl(*args, **kw)
+                    host = self._read(out)
+                    if rec is not None:
+                        rec["n_iters"] = host["n_iters"]
+        wall = time.perf_counter() - t0
+        # Rebind device state from the program's outputs (the donated
+        # inputs are gone on real devices).
+        self._Ybuf, self._Wbuf = out["Ybuf"], out["Wbuf"]
+        self._t = t_new
+        self._n_queries += 1
+        diverged = host["status"] == _DIVERGED
+        if diverged:
+            # Fused replay rule: keep the on-device last-good checkpoint
+            # as the resident params — no host round-trip, no re-upload.
+            self._p = out["p_good"]
+            warnings.warn(
+                f"session update diverged after {host['good_it']} good "
+                "iterations; keeping the last-good params (this update's "
+                "nowcast/forecasts reflect the pre-divergence state only "
+                "loosely — consider a cold refit)", RuntimeWarning,
+                stacklevel=2)
+        else:
+            self._p = out["p"]
+        if tr is not None:
+            tr.emit("query", session=self._sid, t_rows=int(t_new),
+                    n_new=int(n_new), wall=wall,
+                    n_iters=int(host["n_iters"]),
+                    converged=bool(host["status"] == _CONVERGED),
+                    diverged=bool(diverged))
+        inv = (self._std.inverse if self._std is not None
+               else (lambda a: a))
+        di = host["di"]
+        n = min(int(host["n_iters"]), self._max_iters)
+        return SessionUpdate(
+            nowcast=np.asarray(inv(host["nowcast"])),
+            forecasts={"y": np.asarray(inv(host["y_fore"])),
+                       "f": host["f_fore"],
+                       "di": np.asarray(inv(di)) if di is not None else None},
+            logliks=host["lls"][:n],
+            n_iters=n,
+            converged=bool(host["status"] == _CONVERGED),
+            diverged=bool(diverged),
+            factors=host["x_sm"][:t_new],
+            factor_cov=host["P_sm"][:t_new],
+            t=t_new,
+            wall_s=wall)
+
+    def _read(self, out):
+        """Materialize the small host-bound outputs (inside the dispatch
+        span, so a traced query counts exactly one blocking transfer)."""
+        return {
+            "status": int(out["status"]),
+            "n_iters": int(out["n_iters"]),
+            "good_it": int(out["good_it"]),
+            "lls": np.asarray(out["lls"], np.float64),
+            "nowcast": np.asarray(out["nowcast"], np.float64),
+            "f_fore": np.asarray(out["f_fore"], np.float64),
+            "y_fore": np.asarray(out["y_fore"], np.float64),
+            "di": (np.asarray(out["di"], np.float64)
+                   if out["di"] is not None else None),
+            "x_sm": np.asarray(out["x_sm"], np.float64),
+            "P_sm": np.asarray(out["P_sm"], np.float64),
+        }
+
+    def close(self):
+        """Release the device buffers; further updates raise."""
+        self._Ybuf = self._Wbuf = self._p = None
+        self._closed = True
+
+    def __repr__(self):
+        state = "closed" if self._closed else (
+            f"t={self._t}/{self._capacity}, {self._n_queries} queries")
+        return (f"NowcastSession({self._sid}, N={self._N}, "
+                f"filter={self._cfg.filter}, {state})")
+
+
+def open_session(res, Y, mask=None, **kwargs) -> NowcastSession:
+    """Open a streaming ``NowcastSession`` from a fitted model.
+
+    res  : the ``FitResult`` of a ``DynamicFactorModel`` fit of ``Y``.
+    Y    : (T, N) panel the model was fitted on (original units; NaNs =
+           missing), ``mask`` as in ``fit``.
+    capacity        : padded time budget (default 2*T) — updates can
+                      append ``capacity - T`` rows before overflow.
+    max_update_rows : largest per-update row count (default 8); ONE
+                      executable serves every count up to it.
+    max_iters / tol : warm EM budget per query (default 5 / 1e-6).
+    horizon / di    : forecast steps and diffusion-index toggle.
+    backend         : "tpu" (default) or a TPUBackend instance.
+    """
+    return NowcastSession(res, Y, mask=mask, **kwargs)

@@ -27,7 +27,7 @@ import numpy as np
 from ..backends.cpu_ref import SSMParams
 
 __all__ = ["save_checkpoint", "load_checkpoint", "data_fingerprint",
-           "warm_fingerprint"]
+           "warm_fingerprint", "panel_fingerprint", "panel_mismatch"]
 
 _FIELDS = ("Lam", "A", "Q", "R", "mu0", "P0")
 
@@ -56,6 +56,46 @@ def warm_fingerprint(shape, model, has_missing: bool) -> str:
     h.update(repr((tuple(int(d) for d in shape), repr(model),
                    bool(has_missing))).encode())
     return h.hexdigest()
+
+
+def panel_fingerprint(Y: np.ndarray, mask=None) -> str:
+    """CONTENT fingerprint of one (panel, mask) pair.
+
+    Value-sensitive, model-free: two host copies of the same data hash
+    equal, so the fused warm-refit device-panel cache can survive a
+    ``Y.copy()`` between fits (the serving flow ``warm_fingerprint``
+    deliberately ignores values for).  NaN patterns hash via the f64
+    byte image (all payloads normalized by the asarray cast)."""
+    Y = np.ascontiguousarray(np.asarray(Y, np.float64))
+    h = hashlib.sha1()
+    h.update(repr(Y.shape).encode())
+    h.update(Y.tobytes())
+    if mask is not None:
+        h.update(b"mask")
+        h.update(np.ascontiguousarray(np.asarray(mask, np.uint8)).tobytes())
+    return h.hexdigest()
+
+
+def panel_mismatch(Y_a, mask_a, Y_b, mask_b) -> Optional[str]:
+    """Name the first differing field between two (panel, mask) pairs.
+
+    Returns None when they are content-equal (NaNs compare equal — both
+    encode "missing"), else a short human-readable reason — "panel shape",
+    "panel dtype", "mask presence", "mask pattern", or "panel values" —
+    used by the fused warm-refit cache to say WHY a re-upload happened."""
+    A, B = np.asarray(Y_a), np.asarray(Y_b)
+    if A.shape != B.shape:
+        return f"panel shape ({A.shape} vs {B.shape})"
+    if A.dtype != B.dtype:
+        return f"panel dtype ({A.dtype} vs {B.dtype})"
+    if (mask_a is None) != (mask_b is None):
+        return "mask presence (one fit passed mask=, the other did not)"
+    if mask_a is not None and not np.array_equal(np.asarray(mask_a),
+                                                 np.asarray(mask_b)):
+        return "mask pattern"
+    if not np.array_equal(A, B, equal_nan=A.dtype.kind == "f"):
+        return "panel values"
+    return None
 
 
 def save_checkpoint(path: str, params, it: int, logliks,

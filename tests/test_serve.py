@@ -1,0 +1,361 @@
+"""Streaming nowcast sessions (dfm_tpu/serve/ + api/checkpoint wiring).
+
+The operative contracts of ``open_session`` / ``fit(keep_session=True)``,
+verified on the fake 8-device CPU mesh (conftest):
+
+- NUMERICS PARITY: a session ``update`` runs the same program a cold
+  ``fit(fused=True)`` of the concatenated panel would run at the same
+  iteration budget and start params — x64 states/params/nowcasts pin to
+  ~1e-12 (the zero-masked pad tail is exactly inert in the dense filter;
+  only reduction ORDER differs), logliks to fp-reduction tolerance, incl.
+  a ragged-edge mixed-frequency-style masked panel; an f32 variant holds
+  to f32 tolerance.
+- ONE-EXECUTABLE BUDGET: across 5 consecutive ragged updates a traced
+  session pays 1 first-call + 0 recompiles and exactly one blocking d2h
+  per query (the ISSUE 9 acceptance bound, also tools/serve_smoke.sh).
+- HOST-SIDE GUARDS: capacity overflow / row-budget / shape violations
+  raise BEFORE any dispatch; a diverged update keeps the on-device
+  last-good params and warns.
+- WARM-REFIT CACHE (satellite): ``fit(warm_start=prev)`` panel reuse is
+  content-fingerprint based — a ``Y.copy()`` reuses the device panel,
+  changed values re-upload with a ``panel_reupload`` trace event naming
+  the differing field (``utils.checkpoint.panel_mismatch``).
+"""
+
+import dataclasses
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dfm_tpu import (DynamicFactorModel, NowcastSession, fit, open_session)
+from dfm_tpu.api import TPUBackend
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.report import summarize, _print_text
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.utils import dgp
+from dfm_tpu.utils.checkpoint import panel_fingerprint, panel_mismatch
+
+MODEL = DynamicFactorModel(n_factors=2, standardize=False)
+
+
+@pytest.fixture(scope="module")
+def panel():
+    """(T_all, N) panel with one missing cell so cold fits take the
+    masked path the session always uses; first 40 rows are the open
+    panel, the rest stream in via updates."""
+    rng = np.random.default_rng(11)
+    p = dgp.dfm_params(N=12, k=2, rng=rng)
+    Y, _ = dgp.simulate(p, T=52, rng=rng)
+    Y[3, 5] = np.nan
+    return Y
+
+
+def _same_params(a, b, tol=1e-9):
+    for f in ("Lam", "A", "Q", "R", "mu0", "P0"):
+        np.testing.assert_allclose(np.asarray(getattr(a, f)),
+                                   np.asarray(getattr(b, f)),
+                                   rtol=tol, atol=tol, err_msg=f)
+
+
+def _cold_ref(Ycat, init, m, model=MODEL, backend=None):
+    """The parity oracle: a cold fused fit of the extended panel from the
+    same start params at the same (pinned, tol=0) iteration budget."""
+    return fit(model, Ycat, backend=backend, fused=True, max_iters=m,
+               tol=0.0, init=init)
+
+
+def _assert_update_matches(u, ref, states_tol=1e-11, ll_rtol=1e-7):
+    np.testing.assert_allclose(u.nowcast, ref.nowcast,
+                               rtol=states_tol, atol=states_tol)
+    np.testing.assert_allclose(u.factors, ref.factors,
+                               rtol=states_tol, atol=states_tol)
+    np.testing.assert_allclose(u.forecasts["y"], ref.forecasts["y"],
+                               rtol=states_tol, atol=states_tol)
+    np.testing.assert_allclose(u.forecasts["di"], ref.forecasts["di"],
+                               rtol=states_tol, atol=states_tol)
+    # Logliks differ by summation ORDER only (T_cap vs T_true terms):
+    # fp-reduction tolerance, not exactness.
+    assert u.n_iters == ref.n_iters
+    np.testing.assert_allclose(u.logliks, ref.logliks,
+                               rtol=ll_rtol, atol=1e-6)
+
+
+# ------------------------------------------------------------- parity --
+
+def test_update_matches_cold_fused_fit(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=20, tol=1e-6)
+    sess = open_session(res0, Y0, capacity=80, max_update_rows=4,
+                        max_iters=5, tol=0.0)
+    assert sess.t == 40 and sess.remaining == 40
+
+    rows1 = panel[40:43]
+    u1 = sess.update(rows1)
+    assert u1.t == 43 and sess.t == 43
+    ref1 = _cold_ref(panel[:43], res0.params, 5)
+    _assert_update_matches(u1, ref1)
+    _same_params(sess._p.to_numpy(), ref1.params)
+
+    # Chained: the second update starts from update 1's params, exactly
+    # like a cold refit warm-started on the first reference fit.
+    rows2 = panel[43:45]
+    u2 = sess.update(rows2)
+    ref2 = _cold_ref(panel[:45], ref1.params, 5)
+    _assert_update_matches(u2, ref2)
+    np.testing.assert_allclose(u2.factor_cov, ref2.factor_cov,
+                               rtol=1e-11, atol=1e-11)
+
+
+def test_update_matches_cold_fit_ragged_mixed_freq(panel):
+    """Mixed-frequency-style panel: one quarterly column (observed every
+    3rd row) plus a ragged edge in the update itself."""
+    Y = panel[:46].copy()
+    q = np.arange(len(Y)) % 3 != 2
+    Y[q, 0] = np.nan               # column 0 is quarterly
+    Y0, rows = Y[:42], Y[42:46]    # the 4-row update spans a quarter
+    res0 = fit(MODEL, Y0, fused=True, max_iters=20, tol=1e-6)
+    sess = open_session(res0, Y0, capacity=64, max_update_rows=4,
+                        max_iters=4, tol=0.0)
+    u = sess.update(rows)
+    ref = _cold_ref(Y[:46], res0.params, 4)
+    _assert_update_matches(u, ref)
+
+
+def test_update_matches_cold_fit_f32(panel):
+    b = TPUBackend(dtype=jnp.float32)
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, backend=b, fused=True, max_iters=16, tol=1e-5)
+    sess = open_session(res0, Y0, backend=b, capacity=60,
+                        max_update_rows=2, max_iters=4, tol=0.0)
+    u = sess.update(panel[40:42])
+    ref = _cold_ref(panel[:42], res0.params, 4,
+                    backend=TPUBackend(dtype=jnp.float32))
+    np.testing.assert_allclose(u.nowcast, ref.nowcast, rtol=5e-3,
+                               atol=5e-3)
+    np.testing.assert_allclose(u.factors, ref.factors, rtol=5e-3,
+                               atol=5e-3)
+    assert u.n_iters == ref.n_iters
+
+
+# ----------------------------------------------- one-executable budget --
+
+def test_five_updates_one_executable_one_barrier(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=12, tol=1e-6)
+    tr = Tracer(detector=RecompileDetector())
+    with activate(tr):
+        sess = open_session(res0, Y0, capacity=80, max_update_rows=3,
+                            max_iters=3, tol=0.0)
+        t = 40
+        for n in (1, 3, 2, 1, 2):  # ragged row counts, one padded shape
+            u = sess.update(panel[t:t + n])
+            t += n
+            assert u.t == t
+    disp = [e for e in tr.events if e.get("kind") == "dispatch"
+            and e.get("program") == "serve_update"]
+    assert len(disp) == 5
+    assert sum(1 for e in disp if e.get("first_call")) == 1
+    assert sum(1 for e in disp if e.get("recompile")) == 0
+    assert all(e.get("barrier") for e in disp)
+
+    s = summarize(tr.events)
+    # Exactly one blocking d2h per query, none anywhere else.
+    assert s["blocking_transfers"] == 5
+    q = s["queries"]
+    assert q["n_queries"] == 5 and q["n_sessions"] == 1
+    assert q["recompiles_after_warmup"] == 0
+    assert q["per_session"][sess.session_id]["queries"] == 5
+    assert q["per_session"][sess.session_id]["t_rows"] == 49
+    _print_text(s)   # the text report renders the queries section
+
+
+def test_query_events_carry_shape_and_convergence(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=12, tol=1e-6)
+    tr = Tracer()
+    with activate(tr):
+        sess = open_session(res0, Y0, capacity=60, max_iters=8, tol=1e-4)
+        sess.update(panel[40:42])
+    ev = [e for e in tr.events if e.get("kind") == "query"]
+    assert len(ev) == 1
+    assert ev[0]["session"] == sess.session_id
+    assert ev[0]["t_rows"] == 42 and ev[0]["n_new"] == 2
+    assert ev[0]["n_iters"] >= 1 and ev[0]["wall"] > 0
+
+
+# -------------------------------------------------- host-side guards --
+
+def test_capacity_overflow_raises_before_dispatch(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=8, tol=1e-6)
+    tr = Tracer()
+    with activate(tr):
+        sess = open_session(res0, Y0, capacity=41, max_update_rows=4)
+        with pytest.raises(ValueError, match="capacity overflow"):
+            sess.update(panel[40:43])
+        assert sess.t == 40    # untouched
+        u = sess.update(panel[40:41])   # the fitting update still lands
+        assert u.t == 41 and sess.remaining == 0
+    disp = [e for e in tr.events if e.get("kind") == "dispatch"]
+    assert len(disp) == 1      # only the valid update dispatched
+
+
+def test_update_validation(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=8, tol=1e-6)
+    sess = open_session(res0, Y0, capacity=60, max_update_rows=2)
+    with pytest.raises(ValueError, match="max_update_rows"):
+        sess.update(panel[40:43])
+    with pytest.raises(ValueError, match="must be"):
+        sess.update(np.zeros((1, 5)))
+    with pytest.raises(ValueError, match="empty"):
+        sess.update(np.zeros((0, 12)))
+    u = sess.update(panel[40])        # 1-D row promotes to (1, N)
+    assert u.t == 41
+    sess.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        sess.update(panel[41])
+    assert "closed" in repr(sess)
+
+
+def test_open_validation(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=8, tol=1e-6)
+    with pytest.raises(TypeError, match="FitResult"):
+        open_session("nope", Y0)
+    with pytest.raises(ValueError, match="fused device programs"):
+        open_session(res0, Y0, backend="cpu")
+    with pytest.raises(ValueError, match="capacity"):
+        open_session(res0, Y0, capacity=10)
+    with pytest.raises(ValueError, match="N=12"):
+        open_session(res0, Y0[:, :5])
+    with pytest.raises(ValueError, match="horizon"):
+        open_session(res0, Y0[:3])
+    sess = open_session(res0, Y0)
+    assert sess.capacity == 80        # default 2*T
+    assert "NowcastSession" in repr(sess)
+    np.testing.assert_allclose(sess.params().Lam,
+                               np.asarray(res0.params.Lam), rtol=1e-12)
+
+
+def test_diverged_update_keeps_last_good_params(panel):
+    b = TPUBackend(fused_chunk=4)
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, backend=b, fused=True, max_iters=8, tol=1e-6)
+    sess = open_session(res0, Y0, backend=b, capacity=60,
+                        max_update_rows=2, max_iters=8, tol=0.0)
+    # Fault seam: crater chunk 1's logliks on device, as the fused-fit
+    # robustness tests do — the update must flag divergence, warn, and
+    # keep the pre-divergence checkpoint as the resident params.
+    sess._opts = dataclasses.replace(sess._opts, fault_chunk=1)
+    with pytest.warns(RuntimeWarning, match="diverged"):
+        u = sess.update(panel[40:41])
+    assert u.diverged and not u.converged
+    assert np.isfinite(u.nowcast).all()
+    # The session survives: clear the fault and keep streaming.
+    sess._opts = dataclasses.replace(sess._opts, fault_chunk=None)
+    u2 = sess.update(panel[41:42])
+    assert u2.t == 42 and np.isfinite(u2.nowcast).all()
+    assert not u2.diverged
+
+
+# ------------------------------------------------- fit(keep_session=) --
+
+def test_fit_keep_session(panel):
+    model = DynamicFactorModel(n_factors=2)    # standardize on: the
+    Y0 = panel[:40]                            # session must freeze stats
+    res = fit(model, Y0, fused=True, max_iters=12, tol=1e-6,
+              keep_session=True)
+    assert isinstance(res.session, NowcastSession)
+    assert res.session.t == 40
+    u = res.session.update(panel[40:41])
+    assert u.t == 41
+    assert np.isfinite(u.nowcast).all()
+    # Original units: the nowcast lives on the data scale, not z-scores.
+    assert u.nowcast.shape == (12,)
+    res_plain = fit(model, Y0, fused=True, max_iters=12, tol=1e-6)
+    assert res_plain.session is None
+
+
+def test_fit_keep_session_kwargs(panel):
+    res = fit(MODEL, panel[:40], fused=True, max_iters=8, tol=1e-6,
+              keep_session=dict(capacity=90, max_update_rows=6,
+                                max_iters=2))
+    assert res.session.capacity == 90
+    assert res.session._r_max == 6 and res.session._max_iters == 2
+
+
+# ------------------------------- warm-start content fingerprint cache --
+
+def test_warm_refit_panel_cache_survives_copy(panel):
+    b = TPUBackend(filter="info")
+    Y0 = np.ascontiguousarray(panel[:40])
+    cold = fit(MODEL, Y0, backend=b, fused=True, max_iters=6, tol=0.0)
+    tr = Tracer()
+    with activate(tr):
+        warm = fit(MODEL, Y0.copy(), backend=b, fused=True, max_iters=6,
+                   tol=0.0, warm_start=cold)
+    # Content-equal host copy: the device panel is reused, no re-upload.
+    assert not [e for e in tr.events if e.get("kind") == "panel_reupload"]
+    assert warm.n_iters == 6
+
+
+def test_warm_refit_reuploads_on_changed_values(panel):
+    b = TPUBackend(filter="info")
+    Y0 = np.ascontiguousarray(panel[:40])
+    cold = fit(MODEL, Y0, backend=b, fused=True, max_iters=6, tol=0.0)
+    Y1 = Y0.copy()
+    Y1[0, 1] += 0.5
+    tr = Tracer()
+    with activate(tr):
+        warm = fit(MODEL, Y1, backend=b, fused=True, max_iters=6,
+                   tol=0.0, warm_start=cold)
+    ev = [e for e in tr.events if e.get("kind") == "panel_reupload"]
+    assert len(ev) == 1
+    assert "panel values" in ev[0]["reason"]
+    assert warm.n_iters == 6
+
+
+def test_panel_fingerprint_and_mismatch():
+    rng = np.random.default_rng(0)
+    Y = rng.normal(size=(10, 4))
+    Y[2, 3] = np.nan
+    m = np.isfinite(Y)
+    assert panel_fingerprint(Y) == panel_fingerprint(Y.copy())
+    assert panel_fingerprint(Y, m) == panel_fingerprint(Y.copy(), m.copy())
+    assert panel_fingerprint(Y) != panel_fingerprint(Y, m)
+    Y2 = Y.copy()
+    Y2[0, 0] += 1e-9
+    assert panel_fingerprint(Y) != panel_fingerprint(Y2)
+
+    assert panel_mismatch(Y, None, Y.copy(), None) is None      # NaN == NaN
+    assert panel_mismatch(Y, m, Y.copy(), m.copy()) is None
+    assert "panel shape" in panel_mismatch(Y, None, Y[:5], None)
+    assert "panel dtype" in panel_mismatch(Y, None,
+                                           Y.astype(np.float32), None)
+    assert "mask presence" in panel_mismatch(Y, m, Y, None)
+    m2 = m.copy()
+    m2[0, 0] = ~m2[0, 0]
+    assert "mask pattern" in panel_mismatch(Y, m, Y, m2)
+    assert "panel values" in panel_mismatch(Y, None, Y2, None)
+
+
+# ------------------------------------------------------- obs plumbing --
+
+def test_summarize_without_queries_has_no_section():
+    s = summarize([{"kind": "dispatch", "program": "x", "key": "k",
+                    "t": 0.0, "dur": 0.01, "barrier": True}])
+    assert "queries" not in s
+
+
+def test_serve_metrics_registered_in_store():
+    from dfm_tpu.obs import store
+    for k in ("serve_p50_ms", "serve_p99_ms",
+              "serve_blocking_transfers_per_query"):
+        assert k in store._BENCH_NUMERIC_KEYS
+        assert store.lower_is_better(k)
+    assert store.noise_floor("serve_p50_ms") == store.noise_floor(
+        "serve_p99_ms") > 0

@@ -66,5 +66,20 @@ for k in ("pad_waste_frac", "scheduler_overhead_ms"):
         f"perf_gate: {k} lost its lower-is-better marker"
 assert not store.lower_is_better("aggregate_mixed_iters_per_sec")'
 
+# The streaming-session metrics (bench.serve / tools/serve_smoke.sh) must
+# stay registered too: query walls are lower-is-better with the ms noise
+# floor; blocking transfers per query is an exact count (floor 0).
+python -c '
+from dfm_tpu.obs import store
+need = ("serve_p50_ms", "serve_p99_ms",
+        "serve_blocking_transfers_per_query")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+for k in need:
+    assert store.lower_is_better(k), \
+        f"perf_gate: {k} lost its lower-is-better marker"
+assert store.noise_floor("serve_p50_ms") > 0, \
+    "perf_gate: serve walls lost their ms noise floor"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"

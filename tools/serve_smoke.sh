@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# One-command streaming-session check: open a NowcastSession from a cold
+# fused fit, push 3 ragged updates through it under a recompile-detecting
+# tracer, and assert the ISSUE 9 warm-query budget from the trace via the
+# report CLI: exactly ONE serve_update executable (zero recompiles after
+# warmup) and <= 1 blocking d2h transfer per query.  The quick way to
+# answer "is a warm update still one program" without the real chip.
+#
+# Usage (from the repo root):
+#   tools/serve_smoke.sh [trace_path]        # default /tmp/dfm_serve.jsonl
+#
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time;
+# export JAX_PLATFORMS= (empty) to smoke the default backend instead.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRACE="${1:-/tmp/dfm_serve.jsonl}"
+rm -f "$TRACE"
+
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" python - "$TRACE" <<'PY'
+import sys
+
+import numpy as np
+
+from dfm_tpu import DynamicFactorModel, fit, open_session
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(0)
+p_true = dgp.dfm_params(30, 2, rng)
+Y, _ = dgp.simulate(p_true, 86, rng)
+Y0, stream = Y[:80], Y[80:]
+
+model = DynamicFactorModel(n_factors=2)
+res = fit(model, Y0, max_iters=24, tol=1e-6, fused=True)
+print(f"cold fused fit: {res.n_iters} iters, "
+      f"converged={bool(res.converged)}")
+
+# Trace the session lifecycle: update 1 compiles the one serve_update
+# executable; updates 2-3 (different row counts -> ragged padding, same
+# padded shape) must reuse it with one d2h barrier each.
+tr = Tracer(path=sys.argv[1], detector=RecompileDetector())
+with activate(tr):
+    sess = open_session(res, Y0, capacity=120, max_update_rows=3,
+                        max_iters=5, tol=0.0)
+    for rows in (stream[:2], stream[2:5], stream[5:6]):
+        u = sess.update(rows)
+        print(f"update -> t={u.t}, nowcast[:3]="
+              f"{np.round(u.nowcast[:3], 3).tolist()}")
+tr.close()
+PY
+
+echo "--- serve smoke gate ($TRACE) ---"
+python -m dfm_tpu.obs.report "$TRACE"
+python -m dfm_tpu.obs.report "$TRACE" --json | python -c '
+import json, sys
+s = json.load(sys.stdin)
+p = s.get("programs", {}).get("serve_update", {})
+q = s.get("queries") or {}
+n = q.get("n_queries", 0)
+bt = s.get("blocking_transfers", 99)
+rc = q.get("recompiles_after_warmup", 99)
+d = p.get("dispatches")
+assert n == 3, f"serve smoke FAILED: expected 3 query events, got {n}"
+assert d == 3, f"serve smoke FAILED: serve_update dispatches {d}"
+assert rc == 0, f"serve smoke FAILED: {rc} recompiles after warmup"
+assert bt <= n, f"serve smoke FAILED: {bt} blocking transfers for {n} queries"
+print(f"serve smoke OK: {n} queries, {bt} blocking transfer(s) "
+      f"(<= 1/query), 0 recompiles after warmup")'
