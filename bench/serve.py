@@ -133,6 +133,11 @@ def main():
     recomp = (warm["programs"].get("serve_update", {}).get("recompiles", 0)
               - base["programs"].get("serve_update", {}).get("recompiles",
                                                              0))
+    # Queries answered in degraded mode (divergence retry / repair): 0 on
+    # a healthy bench; recorded + gated exactly (no noise floor) so a
+    # serving regression that silently leans on the repair ladder trips.
+    degraded = (warm.get("robustness", {}).get("degraded_queries", 0)
+                - base.get("robustness", {}).get("degraded_queries", 0))
     log(f"warm queries: p50 {p50_ms:.1f} ms, p99 {p99_ms:.1f} ms, "
         f"{per_query:.2f} blocking transfers/query, "
         f"{recomp} recompiles after warmup; {ext_ms / p50_ms:.1f}x vs the "
@@ -155,6 +160,7 @@ def main():
         "serve_p50_ms": round(p50_ms, 2),
         "serve_p99_ms": round(p99_ms, 2),
         "serve_blocking_transfers_per_query": round(per_query, 3),
+        "serve_degraded_queries": int(degraded),
         "cold_extend_refit_ms": round(ext_ms, 2),
         "cold_rolling_refit_ms": round(cold_ms, 2),
         "speedup_vs_cold_refit": round(ext_ms / p50_ms, 2),

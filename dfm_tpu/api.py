@@ -496,9 +496,18 @@ class TPUBackend(Backend):
             from .ssm.steady import auto_tau
             cfg = dataclasses.replace(cfg, tau=auto_tau(p0))
         floor = noise_floor_for(dt, Yj.size, mult=cfg.noise_floor_mult)
+        # The one fused dispatch goes through the unified guard
+        # (robust.dispatch): retry/backoff + watchdog + fault seams, with
+        # the host init params as the donated-twin recovery checkpoint.
+        policy = _resolve_policy(self.robust)
+        health = None
+        if policy is not None:
+            from .robust.health import FitHealth
+            health = FitHealth(engine="fused")
         with self._precision_ctx():
             run = run_fused(Yj, mj, pj, cfg, max_iters, tol, floor, opts,
-                            fused_chunk=self.fused_chunk)
+                            fused_chunk=self.fused_chunk, policy=policy,
+                            health=health, p0_host=p0)
             if callback is not None:
                 # Post-hoc replay: per-iter params never leave the device;
                 # callbacks get the fit-entry params (the chunk-entry
@@ -514,7 +523,6 @@ class TPUBackend(Backend):
                 if tr is not None:
                     tr.emit("fused_fallback", good_it=int(run.good_it),
                             n_iters=int(run.n_iters))
-                policy = _resolve_policy(self.robust)
                 if policy is None:
                     # Unguarded: mirror the chunked driver's divergence
                     # return — last-good params, full loglik path, not
@@ -523,6 +531,13 @@ class TPUBackend(Backend):
                 # Guarded fallback: resume the health-monitored chunked
                 # driver from the fused program's last-good checkpoint
                 # with the remaining budget.
+                from .robust.health import HealthEvent
+                health.record(HealthEvent(
+                    chunk=-1, iteration=int(run.good_it),
+                    kind="divergence", action="restored",
+                    detail=(f"fused fit diverged after {int(run.good_it)} "
+                            f"good iterations; resuming chunked driver "
+                            f"from last-good")))
                 warm = JaxParams.from_numpy(run.p_good, dtype=dt)
                 remaining = max(max_iters - run.good_it, 1)
                 p, lls2, converged, p_it2 = self._run_em_chunked(
@@ -532,10 +547,21 @@ class TPUBackend(Backend):
                 self._async_smooth_stash(Y, mask, Yj, mj, p, pn, cfg)
                 lls = np.concatenate(
                     [run.lls[:run.good_it], np.asarray(lls2)])
+                # Fold the fused guard's record into the chunked
+                # monitor's health (set by _run_em_chunked) so one
+                # FitResult.health tells the whole story.
+                mh = self._last_health
+                if mh is not None and mh is not health:
+                    mh.events[:0] = health.events
+                    mh.n_dispatch_retries += health.n_dispatch_retries
+                    mh.n_recoveries += health.n_recoveries
+                else:
+                    self._last_health = health
                 return pn, lls, converged, run.good_it + p_it2
         # Success: the program already smoothed at the final params —
         # smooth() consumes this identity-keyed cache as a pure host read
         # (non-blocking transfer event; values are already numpy).
+        self._last_health = health
         self._smooth_cache = (Y, mask, run.params, run.x_sm, run.P_sm)
         # One-shot fused outputs for _fit_impl (nowcast/forecasts in
         # standardized units; fit() de-standardizes).
@@ -1041,6 +1067,12 @@ def fit(model,                     # DynamicFactorModel | family spec
         exhausted (e.g. persistent device dispatch failures) re-runs from
         the last good params on the NumPy f64 oracle instead of raising;
         ``FitResult.health`` records everything the guard saw/did.
+        Composes with every execution mode: ``fused=True`` routes the
+        one-shot program through the same ``robust.dispatch`` guard
+        (retry/backoff, watchdog deadline, fault seams), ``auto=True``
+        applies the policy to whichever plan the advisor picks, and
+        ``keep_session=True`` carries it into the session so every
+        ``update()`` dispatch is guarded too.
     telemetry : observability for THIS fit (see ``dfm_tpu.obs``): ``None``
         inherits the ambient tracer (the ``DFM_TRACE=<path>`` env var),
         ``False`` forces telemetry hard-off, ``True`` records in memory
@@ -1139,6 +1171,11 @@ def fit(model,                     # DynamicFactorModel | family spec
                 from .serve import open_session
                 skw = (dict(keep_session) if isinstance(keep_session, dict)
                        else {})
+                # The per-fit robust override outlives the fit for its
+                # session: updates run under the same policy the fit ran
+                # under (the backend's own setting was already restored).
+                if robust is not None and "robust" not in skw:
+                    skw["robust"] = robust
                 res.session = open_session(res, Y, mask=mask,
                                            backend=backend, **skw)
             if isinstance(res, FitResult) and res.advice is not None:

@@ -55,6 +55,7 @@ from ..ops.linalg import (UNROLL_K_MAX, chol_logdet, chol_solve,
                           chol_solve_unrolled, chol_unrolled, default_jitter,
                           matmul_vpu, matvec_vpu, psd_cholesky, sym)
 from ..ops.precision import accum_dtype, default_compute_dtype
+from ..robust.dispatch import _call_with_deadline
 from ..robust.health import FitHealth, HealthEvent, health_from_trace
 from ..ssm.params import SSMParams
 from ..utils.data import Standardizer, standardize, validate_panel
@@ -774,15 +775,26 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                   if mets is not None else None)
         return state_h, lls_h, mets_h
 
+    # Unified-guard seams (robust.dispatch): the policy's wrap_dispatch
+    # test hook and watchdog deadline apply to the bucket program's
+    # dispatch + blocking pull exactly as they do to the fused fit and
+    # session update.  Both are None on the default policy — the wrapped
+    # call is then the original callable and no watchdog thread exists.
+    wrap = policy.wrap_dispatch if policy is not None else None
+    deadline = policy.dispatch_deadline_s if policy is not None else None
+
     def _dispatch_block(carry_in, n, a):
-        if tr is None:
-            new_carry, out = _call(carry_in, n)
-            return (new_carry,) + _pull(new_carry, out, n)
-        with tr.dispatch(prog, _key(n), barrier=True, attempt=a,
-                         **_payload(n)):
-            new_carry, out = _call(carry_in, n)
-            res = _pull(new_carry, out, n)
-        return (new_carry,) + res
+        def _go():
+            if tr is None:
+                new_carry, out = _call(carry_in, n)
+                return (new_carry,) + _pull(new_carry, out, n)
+            with tr.dispatch(prog, _key(n), barrier=True, attempt=a,
+                             **_payload(n)):
+                new_carry, out = _call(carry_in, n)
+                res = _pull(new_carry, out, n)
+            return (new_carry,) + res
+        run = _go if wrap is None else wrap(_go)
+        return _call_with_deadline(run, deadline)
 
     def _attempt_chunk(carry_in, n, pre=None, first_exc=None):
         """The guard's dispatch retry/backoff seam.  ``pre`` short-circuits
@@ -807,7 +819,8 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                     chunk=n_chunks, iteration=it, kind="dispatch_error",
                     detail=f"{type(e).__name__}: {e}"[:200],
                     action="abort" if last else "retried",
-                    t=time.perf_counter(), engine=engine)
+                    t=time.perf_counter(), engine=engine,
+                    backoff_s=0.0 if last else float(delay))
                 dispatch_events.append(ev)
                 if tr is not None:
                     # Emitted once here; the per-problem health fan-out

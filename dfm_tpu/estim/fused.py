@@ -354,33 +354,64 @@ def _read_run(out, max_iters):
     )
 
 
-def run_fused(Yj, mj, pj, cfg, max_iters, tol, noise_floor, opts, fused_chunk=8):
+def run_fused(Yj, mj, pj, cfg, max_iters, tol, noise_floor, opts, fused_chunk=8,
+              policy=None, health=None, p0_host=None):
     """Run the fused fit program; returns a host-materialized FusedRun.
 
     All device→host reads happen inside one barrier'd dispatch span, so a
     traced fused fit counts exactly one blocking transfer.
+
+    With a ``RobustPolicy`` the single dispatch + read goes through
+    ``robust.dispatch.guarded_dispatch`` (retry/backoff, watchdog
+    deadline, ``wrap_dispatch`` fault seam); a retry after a failed
+    donated dispatch rebuilds the entry params from ``p0_host`` (the
+    donated twin consumed them in flight).  ``policy=None`` is the exact
+    pre-guard code path: one dispatch, no wrapper.
     """
     max_iters = max(1, int(max_iters))
     C = max(1, int(fused_chunk))
     # CPU backend: donation is unimplemented and warns; use the plain twin.
     impl = _fused_fit_impl if jax.default_backend() == "cpu" else _fused_fit_impl_donated
     acc = accum_dtype(Yj.dtype)
-    args = (Yj, mj, pj, jnp.asarray(tol, acc), jnp.asarray(noise_floor, acc))
+    tol_j, floor_j = jnp.asarray(tol, acc), jnp.asarray(noise_floor, acc)
     kw = dict(cfg=cfg, has_mask=mj is not None, max_iters=max_iters, chunk=C, opts=opts)
     tr = current_tracer()
     key = shape_key(Yj, cfg.filter, f"chunk{C}", f"max{max_iters}")
+
+    def _once(attempt):
+        p_in = pj
+        if attempt > 0 and p0_host is not None:
+            # The failed attempt may have consumed the donated params
+            # pytree; re-enter from the host copy (tiny h2d upload).
+            from ..ssm.params import SSMParams as JaxParams
+            p_in = JaxParams.from_numpy(p0_host, dtype=Yj.dtype)
+        args = (Yj, mj, p_in, tol_j, floor_j)
+        if tr is None:
+            return _read_run(impl(*args, **kw), max_iters)
+        if attempt == 0:
+            # Static cost capture (DFM_TRACE_COST=1): lower+compile only —
+            # nothing executes, so the donated twin's buffers are
+            # untouched.  Both twins share the program name AND shape key,
+            # so the RecompileDetector sees the donated warm refit as the
+            # SAME logical program, not a recompile.
+            tr.maybe_cost("fused_fit", key, impl, *args, **kw)
+        extra = {"attempt": attempt} if policy is not None else {}
+        with tr.dispatch("fused_fit", key, barrier=True, fused=True,
+                         n_iters=max_iters, **extra) as rec:
+            out = impl(*args, **kw)
+            run = _read_run(out, max_iters)
+            if rec is not None:
+                rec["n_iters"] = int(run.n_iters)
+        return run
+
+    if policy is None:
+        run = _once(0)
+    else:
+        from ..robust.dispatch import guarded_dispatch
+        run = guarded_dispatch(_once, policy, health, label="fused fit",
+                               last_good=p0_host)
     if tr is None:
-        return _read_run(impl(*args, **kw), max_iters)
-    # Static cost capture (DFM_TRACE_COST=1): lower+compile only — nothing
-    # executes, so the donated twin's buffers are untouched.  Both twins
-    # share the program name AND shape key, so the RecompileDetector sees
-    # the donated warm refit as the SAME logical program, not a recompile.
-    tr.maybe_cost("fused_fit", key, impl, *args, **kw)
-    with tr.dispatch("fused_fit", key, barrier=True, fused=True, n_iters=max_iters) as rec:
-        out = impl(*args, **kw)
-        run = _read_run(out, max_iters)
-        if rec is not None:
-            rec["n_iters"] = int(run.n_iters)
+        return run
     drops = np.diff(run.lls)
     tr.emit(
         "chunk",

@@ -314,6 +314,63 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
                 and e.get("recompile")),
             "per_session": per_session,
         }
+    # Serving-grade fault tolerance (robust.dispatch / sched quarantine /
+    # self-healing sessions): the guard's forensic trail aggregated next
+    # to the fairness/queries tables — retries + backoff paid, tenants
+    # quarantined out of their buckets, divergences the repair ladder
+    # recovered, and queries answered in degraded mode.  Absent entirely
+    # on a clean trace.
+    degraded = [q for q in queries if q.get("degraded")]
+    if health or degraded:
+        retried = [e for e in health if e.get("event") == "dispatch_error"
+                   and e.get("action") == "retried"]
+        rb = {
+            "dispatch_retries": len(retried),
+            "backoff_s_total": sum(float(e.get("backoff_s") or 0.0)
+                                   for e in health),
+            "quarantines": sum(1 for e in health
+                               if e.get("event") == "quarantine"),
+            "recovered_divergences": sum(
+                1 for e in health if e.get("event") == "divergence"
+                and e.get("action") in ("restored", "repaired")),
+            "degraded_queries": len(degraded),
+        }
+        per_tenant: dict = {}
+        for e in health:
+            t = e.get("tenant")
+            if not t:
+                continue
+            pt = per_tenant.setdefault(str(t), {
+                "events": 0, "retries": 0, "quarantined": False})
+            pt["events"] += 1
+            pt["retries"] += int(e.get("event") == "dispatch_error"
+                                 and e.get("action") == "retried")
+            pt["quarantined"] |= e.get("event") == "quarantine"
+        per_sess: dict = {}
+
+        def _sess(sid):
+            return per_sess.setdefault(str(sid), {
+                "events": 0, "retries": 0, "recovered_divergences": 0,
+                "degraded_queries": 0})
+
+        for e in health:
+            sid = e.get("session")
+            if not sid:
+                continue
+            ps = _sess(sid)
+            ps["events"] += 1
+            ps["retries"] += int(e.get("event") == "dispatch_error"
+                                 and e.get("action") == "retried")
+            ps["recovered_divergences"] += int(
+                e.get("event") == "divergence"
+                and e.get("action") in ("restored", "repaired"))
+        for q in degraded:
+            _sess(q.get("session", "?"))["degraded_queries"] += 1
+        if per_tenant:
+            rb["per_tenant"] = per_tenant
+        if per_sess:
+            rb["per_session"] = per_sess
+        out["robustness"] = rb
     return out
 
 
@@ -401,6 +458,36 @@ def _print_text(s: dict) -> None:
     if "health_events" in s:
         print(f"health: {s['health_events']} events "
               f"({', '.join(s['health_kinds'])})")
+    rb = s.get("robustness")
+    if rb:
+        n = rb["dispatch_retries"]
+        line = (f"robustness: {n} dispatch retr{'y' if n == 1 else 'ies'} "
+                f"({_fmt_s(rb['backoff_s_total'])} backoff), "
+                f"{rb['quarantines']} quarantine"
+                f"{'' if rb['quarantines'] == 1 else 's'}, "
+                f"{rb['recovered_divergences']} recovered divergence"
+                f"{'' if rb['recovered_divergences'] == 1 else 's'}, "
+                f"{rb['degraded_queries']} degraded quer"
+                f"{'y' if rb['degraded_queries'] == 1 else 'ies'}")
+        print(line)
+        for t, pt in rb.get("per_tenant", {}).items():
+            bits = [f"  tenant {t}: {pt['events']} event"
+                    f"{'' if pt['events'] == 1 else 's'}"]
+            if pt.get("retries"):
+                bits.append(f"{pt['retries']} retries")
+            if pt.get("quarantined"):
+                bits.append("QUARANTINED -> requeued")
+            print(", ".join(bits))
+        for sid, ps in rb.get("per_session", {}).items():
+            bits = [f"  session {sid}: {ps['events']} event"
+                    f"{'' if ps['events'] == 1 else 's'}"]
+            if ps.get("retries"):
+                bits.append(f"{ps['retries']} retries")
+            if ps.get("recovered_divergences"):
+                bits.append(f"{ps['recovered_divergences']} recovered")
+            if ps.get("degraded_queries"):
+                bits.append(f"{ps['degraded_queries']} degraded")
+            print(", ".join(bits))
     for name, c in s.get("costs", {}).items():
         bits = [f"{k}={v:.3g}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in c.items() if k != "key"]

@@ -44,7 +44,7 @@ RUNS_FILE = "runs.jsonl"
 _LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
                          "compile_s", "dispatch_s", "transfer_s", "host_s",
                          "rel_err", "blocking_transfers",
-                         "dispatches_per_fit", "pad_waste")
+                         "dispatches_per_fit", "pad_waste", "degraded")
 
 
 def lower_is_better(metric: str) -> bool:
@@ -264,6 +264,7 @@ _BENCH_NUMERIC_KEYS = (
     "aggregate_mixed_iters_per_sec", "pad_waste_frac",
     "scheduler_overhead_ms",
     "serve_p50_ms", "serve_p99_ms", "serve_blocking_transfers_per_query",
+    "serve_degraded_queries",
 )
 
 

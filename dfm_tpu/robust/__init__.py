@@ -3,26 +3,33 @@
 The chunked EM drivers dispatch one fused XLA program per chunk and only
 see the loglik trace on the host between dispatches — exactly the place a
 health monitor can live without touching the hot path.  This package
-supplies that monitor:
+supplies that monitor, and (since the serving stack landed) the unified
+dispatch guard every one-shot program goes through:
 
 - ``health``  — ``FitHealth`` / ``HealthEvent`` records attached to results.
 - ``guard``   — ``RobustPolicy`` (knobs), ``GuardControls`` (backend hooks),
   ``guarded_run_em_chunked`` (the monitored loop ``estim.em.run_em_chunked``
   delegates to when a monitor is passed), ``GuardFailure`` (carries the last
   good params out for graceful degradation).
+- ``dispatch`` — ``guarded_dispatch``, the shared retry/backoff/watchdog
+  seam around every dispatch site: the chunked ``_dispatch``, the fused
+  fit, the scheduler bucket programs, and ``session.update`` all route
+  their dispatch + blocking d2h read through it.
 - ``faults``  — deterministic fault injection for testing every recovery
   path on the fake CPU mesh (NaN-poisoned chunks, dispatch exceptions,
-  non-PSD parameter corruption, forced freeze drift).
+  hung transfers, non-PSD parameter corruption, forced freeze drift).
 """
 
 from .health import FitHealth, HealthEvent, health_from_trace
 from .guard import (ChunkMonitor, GuardControls, GuardFailure, RobustPolicy,
                     check_param_health, guarded_run_em_chunked, repair_params)
+from .dispatch import guarded_dispatch
 from .faults import FaultInjector, InjectedDispatchError
 
 __all__ = [
     "FitHealth", "HealthEvent", "health_from_trace",
     "ChunkMonitor", "GuardControls", "GuardFailure", "RobustPolicy",
     "check_param_health", "guarded_run_em_chunked", "repair_params",
+    "guarded_dispatch",
     "FaultInjector", "InjectedDispatchError",
 ]

@@ -1,0 +1,131 @@
+"""Unified guarded dispatch for one-shot device programs.
+
+The chunked EM guard (``robust.guard``) has always owned retry/backoff
+around its per-chunk dispatches; the serving stack (fused fit, scheduler
+bucket programs, session updates) dispatches ONE program per request and
+had no guard at all.  ``guarded_dispatch`` is the shared seam: every
+dispatch site builds a ``call(attempt)`` thunk that performs the dispatch
+AND the blocking d2h read, and this wrapper supplies
+
+- retry with exponential backoff on ``policy.retry_exceptions``
+  (``GuardFailure`` always passes through untouched — it IS the guard's
+  own terminal signal);
+- a watchdog deadline (``policy.dispatch_deadline_s``) around the whole
+  call.  On axon the blocking d2h transfer is the only execution barrier
+  and a hung tunnel blocks it forever; the watchdog runs the call on a
+  daemon thread and raises ``TimeoutError`` (a retryable exception) when
+  the deadline passes, so a hung transfer feeds the same retry loop as a
+  raised one.  The abandoned thread is left to die with the process —
+  there is no portable way to cancel a blocked transfer, so a deadline
+  only makes sense where the hung call will never land (tunnel death).
+- the deterministic fault-injection seam (``policy.wrap_dispatch``) that
+  gives one-shot programs the same chaos-testing surface
+  ``policy.wrap_scan`` gives the chunked loop;
+- ``HealthEvent`` records carrying tenant/session attribution and the
+  backoff charged before each retry.
+
+``policy=None`` short-circuits to ``call(0)`` — the off path adds no
+wrapper, no thread, no payload keys, keeping default trajectories and
+dispatch counts byte-identical.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from .health import FitHealth, HealthEvent
+
+__all__ = ["guarded_dispatch"]
+
+
+def _call_with_deadline(fn: Callable[[], object],
+                        deadline_s: Optional[float]):
+    """Run ``fn()`` under a watchdog deadline.
+
+    ``deadline_s`` falsy -> direct call (zero overhead).  Otherwise the
+    call runs on a daemon thread (with the caller's contextvars, so the
+    active tracer is visible) and ``TimeoutError`` is raised if it has
+    not returned within the deadline.  The timed-out call keeps running
+    in the background; callers must only retry when the abandoned
+    dispatch cannot land (see module docstring).
+    """
+    if not deadline_s or deadline_s <= 0:
+        return fn()
+    box: dict = {}
+    ctx = contextvars.copy_context()
+
+    def _run():
+        try:
+            box["value"] = ctx.run(fn)
+        except BaseException as e:  # re-raised on the caller thread
+            box["error"] = e
+
+    th = threading.Thread(target=_run, daemon=True,
+                          name="dfm-dispatch-watchdog")
+    th.start()
+    th.join(float(deadline_s))
+    if th.is_alive():
+        raise TimeoutError(
+            f"dispatch exceeded the {float(deadline_s):g}s watchdog "
+            f"deadline (hung d2h transfer?)")
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+def guarded_dispatch(call: Callable[[int], object], policy,
+                     health: Optional[FitHealth] = None, *,
+                     label: str = "dispatch", tenant: str = "",
+                     session: str = "", chunk: int = -1,
+                     iteration: int = 0, last_good=None,
+                     lls: Sequence[float] = (), p_iters: int = 0):
+    """Run ``call(attempt)`` under ``policy``'s retry/backoff/watchdog.
+
+    ``call`` receives the 0-based attempt number (so dispatch spans can
+    stamp ``attempt=`` into their trace payload) and must perform both
+    the dispatch and the blocking read — a failure anywhere in that span
+    is what the guard retries.  On exhaustion raises ``GuardFailure``
+    whose message carries ``label`` plus tenant/session attribution and
+    whose payload carries ``last_good`` (called first if callable — the
+    site's cheapest route to host params), ``lls`` and ``p_iters`` so
+    ``on_failure="cpu"`` degradation can resume from the last good state.
+    """
+    if policy is None:
+        return call(0)
+    from .guard import GuardFailure
+    run = call if policy.wrap_dispatch is None else policy.wrap_dispatch(call)
+    h = health if health is not None else FitHealth()
+    attempt = 0
+    delay = policy.backoff_base
+    while True:
+        try:
+            return _call_with_deadline(lambda: run(attempt),
+                                       policy.dispatch_deadline_s)
+        except policy.retry_exceptions as e:
+            if isinstance(e, GuardFailure):
+                raise
+            h.n_dispatch_retries += 1
+            last = attempt >= policy.dispatch_retries
+            h.record(HealthEvent(
+                chunk=chunk, iteration=iteration, kind="dispatch_error",
+                detail=f"{type(e).__name__}: {e}"[:200],
+                action="abort" if last else "retried",
+                tenant=tenant, session=session,
+                backoff_s=0.0 if last else float(delay)))
+            if last:
+                scope = ""
+                if tenant:
+                    scope += f" (tenant {tenant})"
+                if session:
+                    scope += f" (session {session})"
+                lg = last_good() if callable(last_good) else last_good
+                raise GuardFailure(
+                    f"{label} failed after {policy.dispatch_retries} "
+                    f"retries{scope}: {e}", h, lg, list(lls),
+                    int(p_iters)) from e
+            time.sleep(delay)
+            delay *= policy.backoff_factor
+            attempt += 1

@@ -10,6 +10,18 @@ reproducible on the fake CPU mesh without real hardware faults:
 - ``nonpsd_params(at)``       — corrupt the returned Q to non-PSD
 - ``freeze_drift(at, count, delta)`` — force the reported ss freeze deltas
   above threshold for ``count`` dispatches
+- ``hung_transfer(at, seconds)`` — simulate a hung d2h transfer: block for
+  ``seconds`` and then die without ever returning a result (with a
+  ``RobustPolicy.dispatch_deadline_s`` shorter than ``seconds`` the
+  watchdog fires first and the retry proceeds deterministically)
+
+The same injector also serves the one-shot serving programs (fused fit,
+scheduler bucket, ``session.update``) through ``wrap_call``, the
+``RobustPolicy.wrap_dispatch`` seam: it consumes one call index per
+dispatch thunk invocation and applies the ``raise``/``hang`` faults
+host-side, before the device program runs — NaN faults for one-shot
+programs use the on-device ``FusedOptions.fault_chunk`` seam instead
+(their reads happen inside the program, out of host reach).
 
 Call indices count EVERY dispatch the guard makes (including retries and
 replays), which is what makes one-shot faults recoverable: the retry is a
@@ -18,6 +30,7 @@ new call index and passes clean.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -60,21 +73,56 @@ class FaultInjector:
             self._plan(at + j, ("drift", delta))
         return self
 
+    def hung_transfer(self, at: int,
+                      seconds: float = 0.5) -> "FaultInjector":
+        return self._plan(at, ("hang", float(seconds)))
+
+    def _pre_faults(self, idx: int):
+        """Faults applied BEFORE the dispatch runs (raise / hang);
+        returns the remaining (post-dispatch) faults."""
+        faults = list(self._faults.get(idx, ()))
+        if (self._persistent_fail_from is not None
+                and idx >= self._persistent_fail_from):
+            faults.append(("raise",))
+        post = []
+        for f in faults:
+            if f[0] == "raise":
+                self.log.append((idx, "raise"))
+                raise InjectedDispatchError(
+                    f"injected dispatch failure at call {idx}")
+            if f[0] == "hang":
+                # A hung transfer never returns: log, block, then die.
+                # Under a watchdog deadline the caller's TimeoutError
+                # fires first; without one this degenerates to a slow
+                # dispatch failure — either way the retry is clean.
+                self.log.append((idx, "hang"))
+                time.sleep(f[1])
+                raise InjectedDispatchError(
+                    f"injected hung transfer at call {idx} "
+                    f"(released after {f[1]:g}s)")
+            post.append(f)
+        return post
+
+    def wrap_call(self, call):
+        """The ``RobustPolicy.wrap_dispatch`` callable: the same
+        call-index fault plan applied to a one-shot dispatch thunk
+        (fused fit / bucket program / session update)."""
+
+        def wrapped(*a, **kw):
+            idx = self.calls
+            self.calls += 1
+            self._pre_faults(idx)
+            return call(*a, **kw)
+
+        return wrapped
+
     def wrap(self, scan_fn):
         """The ``RobustPolicy.wrap_scan`` callable."""
 
         def wrapped(p, n):
             idx = self.calls
             self.calls += 1
-            faults = list(self._faults.get(idx, ()))
-            if (self._persistent_fail_from is not None
-                    and idx >= self._persistent_fail_from):
-                faults.append(("raise",))
-            for f in faults:
-                if f[0] == "raise":
-                    self.log.append((idx, "raise"))
-                    raise InjectedDispatchError(
-                        f"injected dispatch failure at call {idx}")
+            faults = self._pre_faults(idx)
             p_new, lls, deltas = scan_fn(p, n)
             for f in faults:
                 if f[0] == "nan":

@@ -30,6 +30,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from ..backends.cpu_ref import SSMParams
+from .dispatch import guarded_dispatch
 from .health import FitHealth, HealthEvent
 
 __all__ = ["RobustPolicy", "GuardControls", "ChunkMonitor", "GuardFailure",
@@ -81,6 +82,15 @@ class RobustPolicy:
     iter_offset: int = 0                # checkpoint resume: iters already run
     # Test seam: wraps the chunk scan_fn (fault injection lives here).
     wrap_scan: Optional[Callable] = None
+    # Watchdog deadline (seconds) around each dispatch + blocking read.
+    # On axon the d2h transfer is the only barrier and a hung tunnel
+    # blocks forever; a deadline turns the hang into a retryable
+    # TimeoutError (see robust.dispatch).  None (default) = no watchdog.
+    dispatch_deadline_s: Optional[float] = None
+    # Test seam for one-shot programs: wraps the ``call(attempt)`` thunk
+    # handed to ``robust.dispatch.guarded_dispatch`` (fused fit, bucket
+    # program, session update — FaultInjector.wrap_call lives here).
+    wrap_dispatch: Optional[Callable] = None
 
 
 class GuardControls:
@@ -259,54 +269,44 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         return p_out, chunk, deltas, metrics
 
     def _dispatch(fn, p_in, n, first_exc=None):
-        """One chunk dispatch with bounded retry + exponential backoff.
+        """One chunk dispatch with bounded retry + exponential backoff,
+        routed through the shared ``robust.dispatch.guarded_dispatch``
+        seam (which also supplies the watchdog deadline and the
+        ``wrap_dispatch`` fault-injection surface).
 
-        The device->host transfers happen INSIDE the try: on the tunneled
-        device errors surface at the transfer, not the (async) dispatch.
+        The device->host transfers happen INSIDE the guarded call: on the
+        tunneled device errors surface at the transfer, not the (async)
+        dispatch.
 
         ``first_exc``: a pre-observed attempt-0 failure (a pipelined
         issue/drain already consumed the dispatch and raised) — recorded
         and retried exactly as if attempt 0 had failed here.
         """
-        delay = policy.backoff_base
-        attempt = 0
+        pending = [first_exc]
 
-        while True:
-            try:
-                if first_exc is not None:
-                    e, first_exc = first_exc, None
-                    raise e
-                if tr is None:
-                    p_out, chunk, deltas, metrics = _pull(
-                        cc.run(fn, p_in, n), n)
-                else:
-                    # Failed attempts each leave a dispatch event with an
-                    # ``error`` field; the transfers inside the span make
-                    # its wall time the true execution barrier.
-                    with tr.dispatch(
-                            getattr(fn, "trace_name", prog),
-                            cc.key(fn, getattr(fn, "trace_key", prog_key),
-                                   n),
-                            barrier=True, n_iters=n, attempt=attempt,
-                            **cc.payload(fn)):
-                        p_out, chunk, deltas, metrics = _pull(
-                            cc.run(fn, p_in, n), n)
-                return p_out, chunk, deltas, metrics
-            except policy.retry_exceptions as e:
-                if isinstance(e, GuardFailure):
-                    raise
-                health.n_dispatch_retries += 1
-                last = attempt >= policy.dispatch_retries
-                health.record(HealthEvent(
-                    chunk=chunk_idx, iteration=it, kind="dispatch_error",
-                    detail=f"{type(e).__name__}: {e}"[:200],
-                    action="abort" if last else "retried"))
-                if last:
-                    _fail(f"dispatch failed after "
-                          f"{policy.dispatch_retries} retries: {e}", e)
-                time.sleep(delay)
-                delay *= policy.backoff_factor
-                attempt += 1
+        def call(attempt):
+            if pending[0] is not None:
+                e, pending[0] = pending[0], None
+                raise e
+            if tr is None:
+                return _pull(cc.run(fn, p_in, n), n)
+            # Failed attempts each leave a dispatch event with an
+            # ``error`` field; the transfers inside the span make its
+            # wall time the true execution barrier.
+            with tr.dispatch(
+                    getattr(fn, "trace_name", prog),
+                    cc.key(fn, getattr(fn, "trace_key", prog_key), n),
+                    barrier=True, n_iters=n, attempt=attempt,
+                    **cc.payload(fn)):
+                return _pull(cc.run(fn, p_in, n), n)
+
+        try:
+            return guarded_dispatch(call, policy, health,
+                                    chunk=chunk_idx, iteration=it)
+        except GuardFailure as e:
+            # Re-raise through _fail: same message, plus the last-good
+            # checkpoint save and the chunked loop's loglik trace.
+            _fail(str(e), e.__cause__)
 
     def _apply_rebuild(action: str, reason_event: HealthEvent):
         """Swap in an escalated chunk program; returns True on success."""

@@ -39,12 +39,20 @@ class HealthEvent:
     t: float = 0.0      # time.perf_counter() at record time (0 = unstamped);
     #                   # monotonic, comparable to obs.trace event times
     engine: str = ""    # emitting engine ("tpu_em", "batched_em", ...)
+    tenant: str = ""    # fit_jobs tenant id (multi-tenant attribution)
+    session: str = ""   # NowcastSession id (serving attribution)
+    backoff_s: float = 0.0  # sleep charged to this event before the retry
 
     def __str__(self) -> str:
         eng = f" {self.engine}" if self.engine else ""
-        return (f"[chunk {self.chunk} it {self.iteration}]{eng} {self.kind}"
-                f" -> {self.action}" + (f" ({self.detail})" if self.detail
-                                        else ""))
+        who = ""
+        if self.tenant:
+            who += f" tenant={self.tenant}"
+        if self.session:
+            who += f" session={self.session}"
+        return (f"[chunk {self.chunk} it {self.iteration}]{eng}{who} "
+                f"{self.kind} -> {self.action}"
+                + (f" ({self.detail})" if self.detail else ""))
 
 
 @dataclasses.dataclass
@@ -89,10 +97,19 @@ class FitHealth:
             from ..obs.trace import current_tracer
             tr = current_tracer()
             if tr is not None:
+                extra = {}
+                # Attribution/backoff keys ride along only when set, so
+                # pre-existing trace payloads stay byte-identical.
+                if event.tenant:
+                    extra["tenant"] = event.tenant
+                if event.session:
+                    extra["session"] = event.session
+                if event.backoff_s:
+                    extra["backoff_s"] = event.backoff_s
                 tr.emit("health", t=event.t, event=event.kind,
                         chunk=event.chunk, iteration=event.iteration,
                         action=event.action, detail=event.detail,
-                        engine=event.engine)
+                        engine=event.engine, **extra)
         return event
 
     def escalate(self, action: str) -> None:

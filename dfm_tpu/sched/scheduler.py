@@ -16,6 +16,7 @@ executable, so they are grouped first and bucketed within each group.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import List, Optional, Sequence
 
@@ -25,6 +26,8 @@ import jax
 import jax.numpy as jnp
 
 from ..backends import cpu_ref
+from ..robust.guard import GuardFailure
+from ..robust.health import FitHealth, HealthEvent
 from ..estim.batched import (_smooth_impl, make_hetero, pad_panel_to_n,
                              pad_panel_to_t, pad_params_to_k,
                              pad_params_to_n, run_batched_em,
@@ -98,6 +101,60 @@ def _prep_job(i: int, job: Job):
     return Yz, std, init
 
 
+def _requeue_quarantined(job: Job, tenant: str, bucket: int, reason: str,
+                         policy, *, dtype, filter: str, fused_chunk: int,
+                         queue_wait: float, shape, tr) -> JobResult:
+    """Blast-radius isolation: refit one tenant alone after its bucket was
+    quarantined (dispatch retries exhausted, or — under
+    ``recover_divergence=True`` — a NaN-poisoned lane).
+
+    The lone fit runs under the SAME policy, so the chunk guard's full
+    repair ladder (and ``on_failure="cpu"`` degradation to the NumPy
+    oracle) applies per tenant; bucket-mates are never re-run.  The
+    quarantine itself is recorded as a ``HealthEvent(kind="quarantine")``
+    at the head of the refit's health trail.
+    """
+    from ..api import TPUBackend, fit
+    t0 = time.perf_counter()
+    ev = HealthEvent(chunk=-1, iteration=0, kind="quarantine",
+                     action="requeued", tenant=tenant, engine="sched",
+                     detail=f"bucket {bucket}: {reason}",
+                     t=time.perf_counter())
+    if tr is not None:
+        tr.emit("health", t=ev.t, event=ev.kind, chunk=ev.chunk,
+                iteration=ev.iteration, action=ev.action, detail=ev.detail,
+                engine=ev.engine, tenant=ev.tenant)
+    try:
+        f = fit(job.model, job.Y,
+                backend=TPUBackend(dtype=dtype, filter=filter,
+                                   fused_chunk=fused_chunk, robust=policy),
+                max_iters=job.max_iters, tol=job.tol, init=job.init)
+    except GuardFailure as e:
+        raise GuardFailure(
+            f"tenant {tenant!r} was quarantined from bucket {bucket} "
+            f"({reason}) and its lone refit failed too: {e}",
+            e.health, e.last_good, e.lls, e.p_iters) from e
+    h = f.health
+    if h is None:                       # defensive: policy is non-None here
+        h = FitHealth(engine="sched")
+        f = dataclasses.replace(f, health=h)
+    for hev in h.events:
+        if not hev.tenant:
+            hev.tenant = tenant
+    h.events.insert(0, ev)
+    wall = time.perf_counter() - t0
+    T_j, N_j, k_j = shape
+    if tr is not None:
+        tr.emit("tenant", tenant=tenant, bucket=bucket, T=T_j, N=N_j, k=k_j,
+                bucket_T=T_j, bucket_N=N_j, bucket_k=k_j,
+                queue_wait_s=float(queue_wait), compute_s=float(wall),
+                pad_waste_frac=0.0, n_iters=int(len(f.logliks)),
+                converged=bool(f.converged), quarantined=True)
+    return JobResult(tenant=tenant, fit=f, bucket=bucket,
+                     shape=(T_j, N_j, k_j), queue_wait_s=float(queue_wait),
+                     compute_s=float(wall), pad_waste_frac=0.0)
+
+
 def submit(jobs: Sequence[Job], *, backend: str = "tpu",
            max_buckets: int = 3, dtype=None, fused_chunk: int = 8,
            n_devices: Optional[int] = None, robust=True, pipeline=None,
@@ -154,6 +211,7 @@ def submit(jobs: Sequence[Job], *, backend: str = "tpu",
     bucket_dims: List[tuple] = []
     compute_total = 0.0
     n_bucket_global = 0
+    n_quarantined = 0
 
     for idx, plan in plans:
         for b_local, bucket in enumerate(plan.buckets):
@@ -186,47 +244,84 @@ def submit(jobs: Sequence[Job], *, backend: str = "tpu",
             t_launch = time.perf_counter()
             queue_wait = t_launch - t_submit
 
-            with jax.default_matmul_precision("highest"):
-                if backend == "sharded":
-                    from ..parallel.batched import (batched_smooth_sharded,
-                                                    run_batched_em_sharded)
-                    p, lls_list, conv, p_iters, healths = \
-                        run_batched_em_sharded(
+            quarantined: dict = {}              # job index -> reason
+            try:
+                with jax.default_matmul_precision("highest"):
+                    if backend == "sharded":
+                        from ..parallel.batched import (
+                            batched_smooth_sharded, run_batched_em_sharded)
+                        p, lls_list, conv, p_iters, healths = \
+                            run_batched_em_sharded(
+                                Yj, p0, cfg, cap, 0.0,
+                                fused_chunk=fused_chunk,
+                                n_devices=n_devices, policy=policy,
+                                pipeline=pipeline, hetero=het)
+
+                        def _smooth(Yj=Yj, p=p, het=het):
+                            return batched_smooth_sharded(
+                                Yj, p, n_devices=n_devices, hetero=het)
+                    elif backend == "tpu":
+                        p, lls_list, conv, p_iters, healths = run_batched_em(
                             Yj, p0, cfg, cap, 0.0, fused_chunk=fused_chunk,
-                            n_devices=n_devices, policy=policy,
-                            pipeline=pipeline, hetero=het)
+                            policy=policy, pipeline=pipeline, hetero=het)
 
-                    def _smooth(Yj=Yj, p=p, het=het):
-                        return batched_smooth_sharded(
-                            Yj, p, n_devices=n_devices, hetero=het)
-                elif backend == "tpu":
-                    p, lls_list, conv, p_iters, healths = run_batched_em(
-                        Yj, p0, cfg, cap, 0.0, fused_chunk=fused_chunk,
-                        policy=policy, pipeline=pipeline, hetero=het)
-
-                    def _smooth(Yj=Yj, p=p, het=het):
-                        return _smooth_impl(Yj, p, het)
-                else:
-                    raise ValueError(f"unknown scheduler backend "
-                                     f"{backend!r} (use 'tpu' or 'sharded')")
-                if tr is None:
-                    x_sm, P_sm = _smooth()
-                    x_h = np.asarray(x_sm, np.float64)
-                    P_h = np.asarray(P_sm, np.float64)
-                else:
-                    with tr.dispatch("batched_smooth",
-                                     shape_key(Yj, backend, "het"),
-                                     barrier=True):
+                        def _smooth(Yj=Yj, p=p, het=het):
+                            return _smooth_impl(Yj, p, het)
+                    else:
+                        raise ValueError(
+                            f"unknown scheduler backend "
+                            f"{backend!r} (use 'tpu' or 'sharded')")
+                    if tr is None:
                         x_sm, P_sm = _smooth()
                         x_h = np.asarray(x_sm, np.float64)
                         P_h = np.asarray(P_sm, np.float64)
+                    else:
+                        with tr.dispatch("batched_smooth",
+                                         shape_key(Yj, backend, "het"),
+                                         barrier=True):
+                            x_sm, P_sm = _smooth()
+                            x_h = np.asarray(x_sm, np.float64)
+                            P_h = np.asarray(P_sm, np.float64)
+            except Exception as e:
+                # Blast-radius isolation: a bucket program whose dispatch
+                # exhausted its retries (GuardFailure is a RuntimeError)
+                # quarantines the BUCKET — every member is requeued below
+                # as a lone guarded fit.  Non-retryable exceptions (bad
+                # backend name, shape errors) propagate unchanged, as does
+                # everything when unguarded.
+                if policy is None or not isinstance(
+                        e, tuple(policy.retry_exceptions)):
+                    raise
+                reason = f"{type(e).__name__}: {e}"[:200]
+                quarantined = {i: reason for i in members}
             compute_s = time.perf_counter() - t_launch
             compute_total += compute_s
 
-            p_list = unstack_params(p)
+            if not quarantined:
+                p_list = unstack_params(p)
+                if policy is not None and policy.recover_divergence:
+                    # NaN blast radius: under recover_divergence a lane
+                    # with a non-finite trace is evicted and refit alone
+                    # (where the chunk guard's divergence repair applies);
+                    # clean lanes keep their bucket results.  The default
+                    # policy keeps the legacy sail-through semantics
+                    # (pinned by test_sched).
+                    for slot, i in enumerate(members):
+                        lls_s = np.asarray(lls_list[slot])
+                        if lls_s.size and not np.isfinite(lls_s).all():
+                            quarantined[i] = ("non-finite loglik trace in "
+                                              f"bucket lane {slot}")
             for slot, i in enumerate(members):
                 T_j, N_j, k_j = shapes[i]
                 job = jobs[i]
+                tenant = job.tenant if job.tenant is not None else f"job{i}"
+                if i in quarantined:
+                    results[i] = _requeue_quarantined(
+                        job, tenant, bi, quarantined[i], policy,
+                        dtype=dt, filter="info", fused_chunk=fused_chunk,
+                        queue_wait=queue_wait, shape=(T_j, N_j, k_j), tr=tr)
+                    n_quarantined += 1
+                    continue
                 waste = plan.job_pad_waste[idx.index(i)]
                 pj = slice_params_to_n(
                     slice_params_to_k(p_list[slot], k_j), N_j)
@@ -241,7 +336,11 @@ def submit(jobs: Sequence[Job], *, backend: str = "tpu",
                     health=healths[slot],
                     fingerprint=warm_fingerprint((T_j, N_j), job.model,
                                                  False))
-                tenant = job.tenant if job.tenant is not None else f"job{i}"
+                if fit.health is not None:
+                    # Multi-tenant attribution on the shared bucket events.
+                    for hev in fit.health.events:
+                        if not hev.tenant:
+                            hev.tenant = tenant
                 if tr is not None:
                     tr.emit("tenant", tenant=tenant, bucket=bi,
                             T=T_j, N=N_j, k=k_j,
@@ -276,6 +375,7 @@ def submit(jobs: Sequence[Job], *, backend: str = "tpu",
                                if agg_waste_den > 0 else 0.0),
             "predicted_wall_s": sum(pl.predicted_wall_s
                                     for _, pl in plans),
+            "n_quarantined": n_quarantined,
             "calibrated": m.calibrated,
         })
     return results  # type: ignore[return-value]

@@ -29,6 +29,20 @@ the pad algebra is bitwise inert; fp-tolerance for info-form/f32).
 Capacity overflow and row-budget violations raise on host BEFORE any
 dispatch.  A diverged update keeps the on-device last-good params (the
 fused driver's replay rule) and warns.
+
+Self-healing (robust layer): sessions resolve a ``RobustPolicy`` from
+the backend (or the ``robust=`` argument) and route every query through
+``robust.dispatch.guarded_dispatch`` — a failed dispatch retries from
+the last-good state (host shadows rebuild the donated device buffers on
+real devices; one recovery h2d), a hung d2h trips the watchdog deadline
+into the same retry loop, and ``policy.chunk_retries``+1 CONSECUTIVE
+diverged updates escalate through the PR 1 repair ladder
+(``repair_params`` + re-upload).  ``session.snapshot(path)`` /
+``open_session(snapshot=path)`` persist and rebuild a warm session
+(params + live standardized panel + config, content-fingerprinted via
+``utils.checkpoint``): a restarted process is one h2d upload + one
+dispatch away from serving again.  With ``robust=False`` the query path
+is byte-identical to the unguarded original.
 """
 
 from __future__ import annotations
@@ -51,6 +65,8 @@ from ..estim.fused import (FusedOptions, _CONVERGED, _DIVERGED,
                            _di_forecast_core_masked, _em_while_core)
 from ..obs.trace import current_tracer, shape_key
 from ..ops.precision import accum_dtype
+from ..robust.dispatch import guarded_dispatch
+from ..robust.health import FitHealth, HealthEvent
 from ..ssm.info_filter import info_filter
 from ..ssm.kalman import kalman_filter, rts_smoother
 from ..ssm.params import SSMParams as JaxParams
@@ -166,9 +182,9 @@ class NowcastSession:
     def __init__(self, res, Y, mask=None, *, capacity: Optional[int] = None,
                  max_update_rows: int = 8, max_iters: int = 5,
                  tol: float = 1e-6, horizon: Optional[int] = None,
-                 di: Optional[bool] = None, backend=None):
+                 di: Optional[bool] = None, backend=None, robust=None):
         from ..api import (CPUBackend, DynamicFactorModel, FitResult,
-                           get_backend)
+                           _resolve_policy, get_backend)
         if not isinstance(res, FitResult):
             raise TypeError(
                 f"open_session needs a FitResult; got {type(res).__name__}")
@@ -209,9 +225,15 @@ class NowcastSession:
         W = build_mask(Y, mask)
         Yz = np.where(W > 0, np.nan_to_num(Yz), 0.0)
         dt = b._dtype()
+        # Host shadows (standardized units, capacity-padded, f64): the
+        # snapshot source and the donated-retry rebuild state.  Pure
+        # numpy — maintaining them costs no device traffic.
+        self._Yhost = np.asarray(pad_panel_to_t(Yz, capacity), np.float64)
+        self._Whost = np.asarray(pad_panel_to_t(W, capacity), np.float64)
+        self._p_host = res.params.copy()   # f64 host copy (SSMParams)
         with b._precision_ctx():
-            self._Ybuf = jnp.asarray(pad_panel_to_t(Yz, capacity), dt)
-            self._Wbuf = jnp.asarray(pad_panel_to_t(W, capacity), dt)
+            self._Ybuf = jnp.asarray(self._Yhost, dt)
+            self._Wbuf = jnp.asarray(self._Whost, dt)
             self._p = JaxParams.from_numpy(res.params, dtype=dt)
         flt = b._filter_for(N, True)   # masked: dense or info, never ss
         self._cfg = EMConfig(estimate_A=res.model.estimate_A,
@@ -235,6 +257,12 @@ class NowcastSession:
         self._key = shape_key(self._Ybuf, flt, f"rows{self._r_max}",
                               f"chunk{self._chunk}",
                               f"max{self._max_iters}")
+        # Self-healing: inherit the backend's robust policy unless the
+        # caller overrides (robust=False -> unguarded original path).
+        self._policy = _resolve_policy(
+            getattr(b, "robust", True) if robust is None else robust)
+        self.health = FitHealth(engine="serve")
+        self._div_run = 0      # consecutive diverged updates (escalation)
 
     # -- introspection -------------------------------------------------
     @property
@@ -308,57 +336,99 @@ class NowcastSession:
         # the same floor a cold fit of the extended panel would use.
         floor = noise_floor_for(self._dt, t_new * self._N,
                                 mult=self._cfg.noise_floor_mult)
-        args = (self._Ybuf, self._Wbuf,
-                jnp.asarray(rz, self._dt), jnp.asarray(W_rows, self._dt),
-                jnp.asarray(n_new, jnp.int32),
-                jnp.asarray(self._t, jnp.int32),
-                self._p,
-                jnp.asarray(self._tol, self._acc),
-                jnp.asarray(floor, self._acc))
+        rows_j = jnp.asarray(rz, self._dt)
+        rmask_j = jnp.asarray(W_rows, self._dt)
+        consts = (jnp.asarray(n_new, jnp.int32),
+                  jnp.asarray(self._t, jnp.int32),
+                  jnp.asarray(self._tol, self._acc),
+                  jnp.asarray(floor, self._acc))
         kw = dict(cfg=self._cfg, max_iters=self._max_iters,
                   chunk=self._chunk, opts=self._opts)
         impl = (_session_impl if jax.default_backend() == "cpu"
                 else _session_impl_donated)
+        donated = impl is _session_impl_donated
+        pol = self._policy
         tr = current_tracer()
         t0 = time.perf_counter()
-        with self._backend._precision_ctx():
+
+        def _once(attempt):
+            if attempt > 0 and donated:
+                # The failed dispatch consumed the donated buffers;
+                # rebuild device state from the host shadows (one
+                # recovery h2d upload of the exact original values).
+                self._redeploy()
+            args = (self._Ybuf, self._Wbuf, rows_j, rmask_j, consts[0],
+                    consts[1], self._p, consts[2], consts[3])
             if tr is None:
                 out = impl(*args, **kw)
-                host = self._read(out)
-            else:
+                return out, self._read(out, donated and pol is not None)
+            if attempt == 0:
                 tr.maybe_cost("serve_update", self._key, impl, *args, **kw)
-                with tr.dispatch("serve_update", self._key, barrier=True,
-                                 fused=True,
-                                 n_iters=self._max_iters) as rec:
-                    out = impl(*args, **kw)
-                    host = self._read(out)
-                    if rec is not None:
-                        rec["n_iters"] = host["n_iters"]
+            extra = {"attempt": attempt} if pol is not None else {}
+            with tr.dispatch("serve_update", self._key, barrier=True,
+                             fused=True, n_iters=self._max_iters,
+                             **extra) as rec:
+                out = impl(*args, **kw)
+                host = self._read(out, donated and pol is not None)
+                if rec is not None:
+                    rec["n_iters"] = host["n_iters"]
+            return out, host
+
+        with self._backend._precision_ctx():
+            if pol is None:
+                out, host = _once(0)
+            else:
+                out, host = guarded_dispatch(
+                    _once, pol, self.health, label="session update",
+                    session=self._sid, iteration=self._t,
+                    last_good=lambda: self._p_host)
         wall = time.perf_counter() - t0
         # Rebind device state from the program's outputs (the donated
-        # inputs are gone on real devices).
+        # inputs are gone on real devices); the host shadows track the
+        # same append in numpy.
         self._Ybuf, self._Wbuf = out["Ybuf"], out["Wbuf"]
+        self._Yhost[self._t:t_new] = rz[:n_new]
+        self._Whost[self._t:t_new] = W_rows[:n_new]
         self._t = t_new
         self._n_queries += 1
+        if "p_np" in host:     # guarded donated path: last-good shadow
+            self._p_host = host["p_np"]
         diverged = host["status"] == _DIVERGED
+        repaired = False
         if diverged:
             # Fused replay rule: keep the on-device last-good checkpoint
             # as the resident params — no host round-trip, no re-upload.
             self._p = out["p_good"]
+            self._div_run += 1
             warnings.warn(
                 f"session update diverged after {host['good_it']} good "
                 "iterations; keeping the last-good params (this update's "
                 "nowcast/forecasts reflect the pre-divergence state only "
                 "loosely — consider a cold refit)", RuntimeWarning,
                 stacklevel=2)
+            if pol is not None:
+                self.health.record(HealthEvent(
+                    chunk=-1, iteration=self._t, kind="divergence",
+                    action="restored", session=self._sid,
+                    detail=(f"update diverged after {host['good_it']} "
+                            f"good iterations; kept last-good params")))
+                if self._div_run > pol.chunk_retries:
+                    # Escalate repeated divergence through the repair
+                    # ladder: project the resident params back into the
+                    # feasible set and re-upload.
+                    self._repair_resident()
+                    repaired = True
         else:
             self._p = out["p"]
+            self._div_run = 0
         if tr is not None:
+            degraded = bool(diverged or repaired)
             tr.emit("query", session=self._sid, t_rows=int(t_new),
                     n_new=int(n_new), wall=wall,
                     n_iters=int(host["n_iters"]),
                     converged=bool(host["status"] == _CONVERGED),
-                    diverged=bool(diverged))
+                    diverged=bool(diverged),
+                    **({"degraded": True} if degraded else {}))
         inv = (self._std.inverse if self._std is not None
                else (lambda a: a))
         di = host["di"]
@@ -377,10 +447,14 @@ class NowcastSession:
             t=t_new,
             wall_s=wall)
 
-    def _read(self, out):
+    def _read(self, out, want_params: bool = False):
         """Materialize the small host-bound outputs (inside the dispatch
-        span, so a traced query counts exactly one blocking transfer)."""
-        return {
+        span, so a traced query counts exactly one blocking transfer).
+
+        ``want_params`` (guarded donated path only) also reads the
+        resulting params — a few KB riding the same barrier — so the
+        host last-good shadow stays current for donated-retry rebuilds."""
+        host = {
             "status": int(out["status"]),
             "n_iters": int(out["n_iters"]),
             "good_it": int(out["good_it"]),
@@ -393,10 +467,184 @@ class NowcastSession:
             "x_sm": np.asarray(out["x_sm"], np.float64),
             "P_sm": np.asarray(out["P_sm"], np.float64),
         }
+        if want_params:
+            src = (out["p_good"] if host["status"] == _DIVERGED
+                   else out["p"])
+            host["p_np"] = src.to_numpy()
+        return host
+
+    # -- self-healing --------------------------------------------------
+    def _redeploy(self):
+        """Rebuild device state from the host shadows after a failed
+        donated dispatch (the consumed buffers are undefined).  The
+        shadows hold the exact f64 values originally uploaded, so the
+        cast reproduces the device state bit-for-bit."""
+        with self._backend._precision_ctx():
+            self._Ybuf = jnp.asarray(self._Yhost, self._dt)
+            self._Wbuf = jnp.asarray(self._Whost, self._dt)
+            self._p = JaxParams.from_numpy(self._p_host, dtype=self._dt)
+
+    def _repair_resident(self):
+        """Repair ladder for repeated divergence: project the resident
+        params back into the feasible set (PSD clip + R floor lift) on
+        host and re-upload.  One small d2h + h2d, off the healthy path."""
+        from ..robust.guard import repair_params
+        p_np = self._p.to_numpy()
+        p_rep = repair_params(p_np, self._policy.r_floor,
+                              jitter=self._policy.psd_tol)
+        with self._backend._precision_ctx():
+            self._p = JaxParams.from_numpy(p_rep, dtype=self._dt)
+        self._p_host = p_rep
+        self.health.escalate("repair_params")
+        self.health.record(HealthEvent(
+            chunk=-1, iteration=self._t, kind="divergence",
+            action="repaired", session=self._sid,
+            detail=(f"{self._div_run} consecutive diverged updates; "
+                    "repaired resident params and re-uploaded")))
+        self._div_run = 0
+
+    # -- durability ----------------------------------------------------
+    def snapshot(self, path: str) -> str:
+        """Durable session snapshot: params + live standardized panel +
+        session config in ONE atomic npz (``utils.checkpoint``, content-
+        fingerprinted).  Restore with ``open_session(snapshot=path)`` —
+        a restarted process rebuilds the warm device-resident session in
+        one h2d upload, and its next ``update`` is one dispatch (in the
+        same process it reuses the already-compiled executable).  Costs
+        one explicit params d2h; the panel comes from the host shadows
+        (no device read).  The file is also a valid EM warm-start
+        checkpoint (``load_checkpoint`` ignores the session extras)."""
+        self._check_open()
+        from ..utils.checkpoint import panel_fingerprint, save_checkpoint
+        p_np = self._p.to_numpy()
+        Y_live = self._Yhost[:self._t]
+        W_live = self._Whost[:self._t]
+        m = self._model
+        extra = {
+            "session_format": 1,
+            "Y_live": Y_live,
+            "W_live": W_live,
+            "std_mean": (self._std.mean if self._std is not None
+                         else np.zeros(0)),
+            "std_scale": (self._std.scale if self._std is not None
+                          else np.zeros(0)),
+            "capacity": self._capacity,
+            "max_update_rows": self._r_max,
+            "max_iters": self._max_iters,
+            "tol": self._tol,
+            "horizon": self._opts.horizon,
+            "di": self._opts.di,
+            "n_queries": self._n_queries,
+            "model_n_factors": m.n_factors,
+            "model_dynamics": m.dynamics,
+            "model_standardize": m.standardize,
+            "model_estimate_init": m.estimate_init,
+        }
+        save_checkpoint(path, p_np, it=self._t, logliks=[],
+                        fingerprint=panel_fingerprint(Y_live, W_live),
+                        converged=False, extra=extra)
+        return path
+
+    @classmethod
+    def restore(cls, path: str, *, backend=None,
+                robust=None) -> "NowcastSession":
+        """Rebuild a warm session from ``snapshot(path)``.
+
+        The stored panel is verified against its content fingerprint
+        (a corrupt or hand-edited snapshot fails loudly), then the
+        standardized live panel + params are re-uploaded exactly as the
+        original session held them — the restored session's updates are
+        numerically identical to the uninterrupted session's (pinned by
+        tests/test_chaos.py)."""
+        from ..api import (CPUBackend, DynamicFactorModel, _resolve_policy,
+                           get_backend)
+        from ..backends.cpu_ref import SSMParams
+        from ..utils.checkpoint import _FIELDS, panel_fingerprint
+        meta_keys = ("capacity", "max_update_rows", "max_iters", "tol",
+                     "horizon", "di", "n_queries", "model_n_factors",
+                     "model_dynamics", "model_standardize",
+                     "model_estimate_init")
+        with np.load(path) as z:
+            if "session_format" not in z.files:
+                raise ValueError(
+                    f"{path!r} is not a session snapshot (no "
+                    "session_format field) — a plain EM checkpoint "
+                    "cannot rebuild a session; open one with "
+                    "open_session(res, Y)")
+            params = SSMParams(*(np.asarray(z[f], np.float64)
+                                 for f in _FIELDS))
+            Y_live = np.asarray(z["Y_live"], np.float64)
+            W_live = np.asarray(z["W_live"], np.float64)
+            fp = str(z["fingerprint"]) if "fingerprint" in z.files else ""
+            mean = np.asarray(z["std_mean"], np.float64)
+            scale = np.asarray(z["std_scale"], np.float64)
+            meta = {k: z[k][()] for k in meta_keys}
+        if fp and panel_fingerprint(Y_live, W_live) != fp:
+            raise ValueError(
+                f"session snapshot {path!r} is corrupt: the stored live "
+                "panel does not match its content fingerprint")
+        b = get_backend(backend if backend is not None else "tpu")
+        if isinstance(b, CPUBackend) or not hasattr(b, "_fused_panel"):
+            raise ValueError(
+                f"backend {b.name!r} has no fused device programs; "
+                "sessions need a single-device JAX backend "
+                "(backend=\"tpu\" or a TPUBackend instance)")
+        self = cls.__new__(cls)
+        model = DynamicFactorModel(
+            n_factors=int(meta["model_n_factors"]),
+            dynamics=str(meta["model_dynamics"]),
+            standardize=bool(meta["model_standardize"]),
+            estimate_init=bool(meta["model_estimate_init"]))
+        T_live, N = Y_live.shape
+        capacity = int(meta["capacity"])
+        self._opts = FusedOptions(horizon=int(meta["horizon"]),
+                                  di=bool(meta["di"]))
+        from ..utils.data import Standardizer
+        self._std = (Standardizer(mean=mean, scale=scale) if mean.size
+                     else None)
+        dt = b._dtype()
+        self._Yhost = np.asarray(pad_panel_to_t(Y_live, capacity),
+                                 np.float64)
+        self._Whost = np.asarray(pad_panel_to_t(W_live, capacity),
+                                 np.float64)
+        self._p_host = params
+        with b._precision_ctx():
+            # The one restore upload: panel shadows + params to device.
+            self._Ybuf = jnp.asarray(self._Yhost, dt)
+            self._Wbuf = jnp.asarray(self._Whost, dt)
+            self._p = JaxParams.from_numpy(params, dtype=dt)
+        flt = b._filter_for(N, True)
+        self._cfg = EMConfig(estimate_A=model.estimate_A,
+                             estimate_Q=model.estimate_Q,
+                             estimate_init=model.estimate_init,
+                             filter=flt, debug=False)
+        self._backend = b
+        self._model = model
+        self._dt = dt
+        self._acc = accum_dtype(dt)
+        self._N = N
+        self._t = T_live
+        self._capacity = capacity
+        self._r_max = int(meta["max_update_rows"])
+        self._max_iters = int(meta["max_iters"])
+        self._tol = float(meta["tol"])
+        self._chunk = max(1, int(getattr(b, "fused_chunk", 8)))
+        self._closed = False
+        self._n_queries = int(meta["n_queries"])
+        self._sid = f"s{next(_SESSION_IDS)}"
+        self._key = shape_key(self._Ybuf, flt, f"rows{self._r_max}",
+                              f"chunk{self._chunk}",
+                              f"max{self._max_iters}")
+        self._policy = _resolve_policy(
+            getattr(b, "robust", True) if robust is None else robust)
+        self.health = FitHealth(engine="serve")
+        self._div_run = 0
+        return self
 
     def close(self):
         """Release the device buffers; further updates raise."""
         self._Ybuf = self._Wbuf = self._p = None
+        self._Yhost = self._Whost = self._p_host = None
         self._closed = True
 
     def __repr__(self):
@@ -406,7 +654,8 @@ class NowcastSession:
                 f"filter={self._cfg.filter}, {state})")
 
 
-def open_session(res, Y, mask=None, **kwargs) -> NowcastSession:
+def open_session(res=None, Y=None, mask=None, *, snapshot=None,
+                 **kwargs) -> NowcastSession:
     """Open a streaming ``NowcastSession`` from a fitted model.
 
     res  : the ``FitResult`` of a ``DynamicFactorModel`` fit of ``Y``.
@@ -419,5 +668,19 @@ def open_session(res, Y, mask=None, **kwargs) -> NowcastSession:
     max_iters / tol : warm EM budget per query (default 5 / 1e-6).
     horizon / di    : forecast steps and diffusion-index toggle.
     backend         : "tpu" (default) or a TPUBackend instance.
+    robust          : ``RobustPolicy`` / True / False — the self-healing
+                      query guard; default inherits the backend's policy.
+    snapshot        : path written by ``session.snapshot(path)`` —
+                      restores the saved session instead (pass no
+                      res/Y/mask; ``backend``/``robust`` still apply).
     """
+    if snapshot is not None:
+        if res is not None or Y is not None or mask is not None:
+            raise ValueError(
+                "open_session(snapshot=...) restores a saved session: "
+                "res/Y/mask come from the snapshot and cannot be passed")
+        return NowcastSession.restore(snapshot, **kwargs)
+    if res is None or Y is None:
+        raise TypeError("open_session needs (res, Y) — or snapshot= to "
+                        "restore a saved session")
     return NowcastSession(res, Y, mask=mask, **kwargs)

@@ -100,14 +100,25 @@ def panel_mismatch(Y_a, mask_a, Y_b, mask_b) -> Optional[str]:
 
 def save_checkpoint(path: str, params, it: int, logliks,
                     fingerprint: Optional[str] = None,
-                    converged: bool = False) -> None:
-    """Atomic write (tmp + rename) of EM state."""
+                    converged: bool = False,
+                    extra: Optional[dict] = None) -> None:
+    """Atomic write (tmp + rename) of EM state.
+
+    ``extra``: additional arrays merged into the npz under their own keys
+    (the serve-session snapshot stores its live panel + config here);
+    ``load_checkpoint`` reads only the EM fields and ignores extras, so
+    a session snapshot is ALSO a valid warm-start checkpoint."""
     arrays = {f: np.asarray(getattr(params, f), np.float64) for f in _FIELDS}
     arrays["iter"] = np.asarray(it)
     arrays["logliks"] = np.asarray(logliks, np.float64)
     arrays["converged"] = np.asarray(bool(converged))
     if fingerprint is not None:
         arrays["fingerprint"] = np.asarray(fingerprint)
+    for k, v in (extra or {}).items():
+        if k in arrays:
+            raise ValueError(f"extra key {k!r} collides with an EM "
+                             f"checkpoint field")
+        arrays[k] = np.asarray(v)
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")

@@ -1,0 +1,538 @@
+"""Serving-grade fault tolerance (ISSUE 10): the unified dispatch guard,
+tenant blast-radius isolation, and self-healing sessions, driven
+deterministically on the fake CPU mesh.
+
+- ``robust.dispatch.guarded_dispatch`` units: retry + backoff records,
+  exhaustion -> ``GuardFailure`` with tenant/session attribution,
+  watchdog deadline around a hung d2h, ``policy=None`` passthrough, and
+  the ``wrap_dispatch`` fault seam.
+- Fused fit: an injected dispatch failure retries to a result EXACTLY
+  equal to the clean run; persistent failure degrades to the NumPy
+  oracle under ``on_failure="cpu"`` or raises under ``"raise"``.
+- Scheduler: a transient mid-bucket failure retries with every tenant's
+  result bit-identical to its lone fit; retry exhaustion quarantines the
+  bucket and requeues its tenants as lone guarded fits (results still
+  match the lone oracle); under ``recover_divergence=True`` a
+  NaN-poisoned tenant is evicted ALONE while its bucket-mates keep
+  their in-bucket results.
+- Sessions: a failed update retries from last-good to the exact clean
+  answer; repeated divergence escalates through the repair ladder;
+  ``snapshot -> restore -> update`` equals the uninterrupted session
+  (x64-exact, f32-tolerance) at the same one-dispatch budget.
+- Observability: ``summarize()`` aggregates retries / backoff /
+  quarantines / degraded queries per tenant and session; the
+  ``serve_degraded_queries`` bench metric stays registered.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dfm_tpu import (DynamicFactorModel, Job, fit, fit_jobs, open_session)
+from dfm_tpu.api import TPUBackend
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.report import summarize, _print_text
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.robust import (FaultInjector, FitHealth, GuardFailure,
+                            RobustPolicy)
+from dfm_tpu.robust.dispatch import guarded_dispatch
+from dfm_tpu.robust.faults import InjectedDispatchError
+from dfm_tpu.utils import dgp
+
+MODEL = DynamicFactorModel(n_factors=2, standardize=False)
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(19)
+    p = dgp.dfm_params(N=12, k=2, rng=rng)
+    Y, _ = dgp.simulate(p, T=60, rng=rng)
+    return Y
+
+
+def _panel(T, N, k, seed=0):
+    rng = np.random.default_rng(seed)
+    Y, _ = dgp.simulate(dgp.dfm_params(N, k, rng), T, rng)
+    return Y
+
+
+def _jobs(shapes, seed=0, **kw):
+    return [Job(Y=_panel(T, N, k, seed=seed + i),
+                model=DynamicFactorModel(n_factors=k), tenant=f"t{i}",
+                **kw)
+            for i, (T, N, k) in enumerate(shapes)]
+
+
+def _ref(job, dtype="float64"):
+    """Lone-fit oracle, same engine (info filter) as the scheduler."""
+    return fit(job.model, job.Y,
+               backend=TPUBackend(dtype=dtype, filter="info"),
+               max_iters=job.max_iters, tol=job.tol)
+
+
+def _match(r, ref):
+    np.testing.assert_allclose(r.fit.logliks, ref.logliks,
+                               rtol=1e-9, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(r.fit.params.Lam),
+                               np.asarray(ref.params.Lam),
+                               rtol=1e-7, atol=1e-8)
+    assert r.fit.converged == ref.converged
+
+
+def quick_policy(**kw):
+    kw.setdefault("backoff_base", 1e-6)
+    return RobustPolicy(**kw)
+
+
+# ------------------------------------------- guarded_dispatch units --
+
+
+def test_guarded_dispatch_retries_then_succeeds():
+    calls = []
+
+    def flaky(attempt):
+        calls.append(attempt)
+        if len(calls) < 3:
+            raise RuntimeError(f"tunnel reset #{len(calls)}")
+        return "ok"
+
+    h = FitHealth()
+    out = guarded_dispatch(flaky, quick_policy(dispatch_retries=3), h,
+                           label="unit dispatch", tenant="acme")
+    assert out == "ok" and calls == [0, 1, 2]
+    assert h.n_dispatch_retries == 2
+    assert [e.kind for e in h.events] == ["dispatch_error"] * 2
+    assert all(e.action == "retried" and e.tenant == "acme"
+               for e in h.events)
+    # Exponential backoff is charged to the event that paid it.
+    assert h.events[1].backoff_s > h.events[0].backoff_s > 0.0
+
+
+def test_guarded_dispatch_exhaustion_raises_guardfailure():
+    def dead(attempt):
+        raise ConnectionError("axon tunnel down")
+
+    h = FitHealth()
+    lg = {"called": 0}
+
+    def last_good():
+        lg["called"] += 1
+        return "LG"
+
+    with pytest.raises(GuardFailure, match=r"session update failed after "
+                       r"1 retries \(tenant acme\) \(session s9\)") as ei:
+        guarded_dispatch(dead, quick_policy(dispatch_retries=1), h,
+                         label="session update", tenant="acme",
+                         session="s9", last_good=last_good,
+                         lls=[-5.0, -4.0], p_iters=2)
+    e = ei.value
+    assert lg["called"] == 1 and e.last_good == "LG"
+    np.testing.assert_array_equal(e.lls, [-5.0, -4.0])
+    assert e.p_iters == 2
+    assert e.health is h and h.events[-1].action == "abort"
+
+
+def test_guarded_dispatch_policy_none_passthrough():
+    seen = []
+    assert guarded_dispatch(lambda a: seen.append(a) or 42, None) == 42
+    assert seen == [0]
+    with pytest.raises(ValueError):   # no retry machinery without policy
+        guarded_dispatch(lambda a: (_ for _ in ()).throw(ValueError("x")),
+                         None)
+
+
+def test_guarded_dispatch_guardfailure_passes_through_untouched():
+    gf = GuardFailure("terminal", FitHealth(), None, [], 0)
+
+    def call(attempt):
+        raise gf
+
+    h = FitHealth()
+    with pytest.raises(GuardFailure) as ei:
+        guarded_dispatch(call, quick_policy(dispatch_retries=5), h)
+    assert ei.value is gf and h.n_dispatch_retries == 0
+
+
+def test_guarded_dispatch_watchdog_recovers_hung_call():
+    import time as _time
+    calls = []
+
+    def hung_then_fine(attempt):
+        calls.append(attempt)
+        if attempt == 0:
+            _time.sleep(2.0)   # "hung d2h": never lands within deadline
+        return "served"
+
+    h = FitHealth()
+    out = guarded_dispatch(
+        hung_then_fine,
+        quick_policy(dispatch_retries=2, dispatch_deadline_s=0.1), h,
+        label="fused fit")
+    assert out == "served" and calls == [0, 1]
+    assert h.n_dispatch_retries == 1
+    assert "watchdog" in h.events[0].detail
+    assert h.events[0].detail.startswith("TimeoutError")
+
+
+def test_guarded_dispatch_injector_seam():
+    inj = FaultInjector().dispatch_failure(at=0)
+    h = FitHealth()
+    out = guarded_dispatch(lambda a: "ok",
+                           quick_policy(wrap_dispatch=inj.wrap_call), h)
+    assert out == "ok"
+    # Retries consume NEW call indices, so a one-shot fault clears.
+    assert inj.log == [(0, "raise")] and inj.calls == 2
+    assert h.n_dispatch_retries == 1
+
+
+# ------------------------------------------------- fused fit guard --
+
+
+def test_fused_injected_failure_retries_to_exact_parity(panel):
+    b = TPUBackend(fused_chunk=4)
+    clean = fit(MODEL, panel, backend=b, fused=True, max_iters=10,
+                tol=0.0, robust=False)
+    inj = FaultInjector().dispatch_failure(at=0)
+    r = fit(MODEL, panel, backend=TPUBackend(fused_chunk=4), fused=True,
+            max_iters=10, tol=0.0,
+            robust=quick_policy(wrap_dispatch=inj.wrap_call))
+    np.testing.assert_array_equal(r.logliks, clean.logliks)
+    np.testing.assert_array_equal(np.asarray(r.params.Lam),
+                                  np.asarray(clean.params.Lam))
+    assert inj.log == [(0, "raise")]
+    assert r.health is not None and r.health.n_dispatch_retries == 1
+    assert [e.kind for e in r.health.events] == ["dispatch_error"]
+
+
+def test_fused_hung_transfer_watchdog_recovers(panel):
+    b = TPUBackend(fused_chunk=4)
+    clean = fit(MODEL, panel, backend=b, fused=True, max_iters=8,
+                tol=0.0, robust=False)
+    # The deadline bounds EVERY attempt (including the clean retry's
+    # real execution), so it must sit above the program wall but below
+    # the injected hang.
+    inj = FaultInjector().hung_transfer(at=0, seconds=30.0)
+    r = fit(MODEL, panel, backend=TPUBackend(fused_chunk=4), fused=True,
+            max_iters=8, tol=0.0,
+            robust=quick_policy(wrap_dispatch=inj.wrap_call,
+                                dispatch_deadline_s=5.0))
+    np.testing.assert_array_equal(r.logliks, clean.logliks)
+    assert inj.log[0] == (0, "hang")
+    assert any("watchdog" in e.detail for e in r.health.events)
+
+
+def test_fused_persistent_failure_degrades_to_cpu(panel):
+    inj = FaultInjector().dispatch_failure(at=0, count=-1)
+    r = fit(MODEL, panel, fused=True, max_iters=6, tol=0.0,
+            robust=quick_policy(dispatch_retries=1, on_failure="cpu",
+                                wrap_dispatch=inj.wrap_call))
+    assert r.health.fallback_backend == "cpu" and not r.health.ok
+    assert len(r.logliks) == 6 and np.isfinite(r.logliks).all()
+    # The degraded fit IS the oracle fit: same init, same budget.
+    ref = fit(MODEL, panel, backend="cpu", max_iters=6, tol=0.0)
+    np.testing.assert_allclose(r.logliks, ref.logliks,
+                               rtol=1e-9, atol=1e-7)
+
+
+def test_fused_persistent_failure_raises_by_default(panel):
+    inj = FaultInjector().dispatch_failure(at=0, count=-1)
+    with pytest.raises(GuardFailure, match="fused fit failed after"):
+        fit(MODEL, panel, fused=True, max_iters=4, tol=0.0,
+            robust=quick_policy(dispatch_retries=1,
+                                wrap_dispatch=inj.wrap_call))
+
+
+# -------------------------------------- scheduler blast-radius --
+
+
+def test_sched_midbucket_retry_keeps_bucket_parity():
+    jobs = _jobs([(40, 10, 2)] * 3, seed=700, max_iters=10, tol=1e-6)
+    inj = FaultInjector().dispatch_failure(at=0)
+    stats = {}
+    res = fit_jobs(jobs, max_buckets=1, dtype="float64", stats=stats,
+                   robust=quick_policy(wrap_dispatch=inj.wrap_call))
+    assert inj.log == [(0, "raise")]
+    assert stats["n_quarantined"] == 0
+    for r, job in zip(res, jobs):
+        _match(r, _ref(job))
+
+
+def test_sched_exhausted_bucket_quarantines_and_requeues():
+    jobs = _jobs([(40, 10, 2)] * 3, seed=710, max_iters=8, tol=1e-6)
+    inj = FaultInjector().dispatch_failure(at=0)
+    stats = {}
+    tr = Tracer()
+    with activate(tr):
+        res = fit_jobs(jobs, max_buckets=1, dtype="float64", stats=stats,
+                       robust=quick_policy(dispatch_retries=0,
+                                           wrap_dispatch=inj.wrap_call))
+    assert stats["n_quarantined"] == 3
+    for i, (r, job) in enumerate(zip(res, jobs)):
+        # Requeued lone guarded fits still match the lone oracle.
+        _match(r, _ref(job))
+        h = r.fit.health
+        assert h is not None and not h.ok
+        ev = h.events[0]
+        assert ev.kind == "quarantine" and ev.action == "requeued"
+        assert ev.tenant == f"t{i}"
+        assert "InjectedDispatchError" in ev.detail
+        assert r.pad_waste_frac == 0.0
+    # The trace carries the quarantines with tenant attribution.
+    s = summarize(tr.events)
+    rb = s["robustness"]
+    assert rb["quarantines"] == 3
+    assert {t for t, pt in rb["per_tenant"].items() if pt["quarantined"]} \
+        == {"t0", "t1", "t2"}
+    _print_text(s)
+
+
+def test_sched_nonretryable_failure_propagates():
+    """Quarantine only catches the policy's retry_exceptions: a failure
+    OUTSIDE that tuple (here the injected error, with retry_exceptions
+    narrowed to ConnectionError) propagates instead of quarantining —
+    programming errors never masquerade as tenant faults."""
+    jobs = _jobs([(40, 10, 2)] * 2, seed=720, max_iters=6, tol=1e-6)
+    inj = FaultInjector().dispatch_failure(at=0, count=-1)
+    pol = RobustPolicy(backoff_base=1e-6, dispatch_retries=0,
+                       retry_exceptions=(ConnectionError,),
+                       wrap_dispatch=inj.wrap_call)
+    with pytest.raises(InjectedDispatchError):
+        fit_jobs(jobs, max_buckets=1, dtype="float64", robust=pol)
+
+
+def test_sched_nan_tenant_quarantined_under_recover_divergence():
+    jobs = _jobs([(40, 12, 2)] * 3, seed=730, max_iters=10, tol=1e-6)
+    bad = cpu_ref.pca_init(
+        np.asarray(jobs[1].Y) / np.asarray(jobs[1].Y).std(axis=0), 2)
+    bad = dataclasses.replace(bad, Lam=np.full_like(bad.Lam, np.nan))
+    jobs[1] = Job(Y=jobs[1].Y, model=jobs[1].model, tenant="poisoned",
+                  init=bad, max_iters=10, tol=1e-6)
+    stats = {}
+    res = fit_jobs(jobs, max_buckets=1, dtype="float64", stats=stats,
+                   robust=quick_policy(recover_divergence=True))
+    assert stats["n_quarantined"] == 1
+    # Bucket-mates keep their IN-BUCKET results, identical to lone fits.
+    for i in (0, 2):
+        _match(res[i], _ref(jobs[i]))
+        assert not any(e.kind == "quarantine"
+                       for e in res[i].fit.health.events)
+    # The poisoned tenant was evicted alone and repaired in its lone
+    # refit: finite trajectory, quarantine + repair on the record.
+    h = res[1].fit.health
+    assert h.events[0].kind == "quarantine"
+    assert h.events[0].tenant == "poisoned"
+    assert "non-finite" in h.events[0].detail
+    assert np.isfinite(np.asarray(res[1].fit.logliks)).all()
+    assert not h.ok
+
+
+def test_sched_nan_tenant_sails_through_by_default():
+    """The PR 8 pinned default is unchanged: without
+    ``recover_divergence`` a NaN-poisoned tenant runs to its cap
+    in-bucket (independent lanes), no quarantine."""
+    jobs = _jobs([(40, 12, 2)] * 2, seed=740, max_iters=8, tol=1e-6)
+    bad = cpu_ref.pca_init(
+        np.asarray(jobs[1].Y) / np.asarray(jobs[1].Y).std(axis=0), 2)
+    bad = dataclasses.replace(bad, Lam=np.full_like(bad.Lam, np.nan))
+    jobs[1] = Job(Y=jobs[1].Y, model=jobs[1].model, tenant="poisoned",
+                  init=bad, max_iters=8, tol=1e-6)
+    stats = {}
+    res = fit_jobs(jobs, max_buckets=1, dtype="float64", stats=stats)
+    assert stats["n_quarantined"] == 0
+    assert len(res[1].fit.logliks) == 8
+    assert not np.isfinite(np.asarray(res[1].fit.logliks)).all()
+    _match(res[0], _ref(jobs[0]))
+
+
+# ---------------------------------------- self-healing sessions --
+
+
+def test_session_injected_failure_retries_to_exact_parity(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=8, tol=1e-6)
+    inj = FaultInjector().dispatch_failure(at=0)
+    kw = dict(capacity=60, max_update_rows=2, max_iters=4, tol=0.0)
+    s_clean = open_session(res0, Y0, robust=False, **kw)
+    s_guard = open_session(
+        res0, Y0, robust=quick_policy(wrap_dispatch=inj.wrap_call), **kw)
+    u_c = s_clean.update(panel[40:42])
+    u_g = s_guard.update(panel[40:42])
+    np.testing.assert_array_equal(u_g.nowcast, u_c.nowcast)
+    np.testing.assert_array_equal(u_g.logliks, u_c.logliks)
+    np.testing.assert_array_equal(u_g.factors, u_c.factors)
+    assert inj.log == [(0, "raise")] and inj.calls == 2
+    h = s_guard.health
+    assert h.n_dispatch_retries == 1
+    assert [e.kind for e in h.events] == ["dispatch_error"]
+    assert h.events[0].session == s_guard.session_id
+    assert s_clean.health.ok    # the unguarded twin recorded nothing
+
+
+def test_session_repeated_divergence_escalates_repair(panel):
+    b = TPUBackend(fused_chunk=4)
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, backend=b, fused=True, max_iters=8, tol=1e-6)
+    sess = open_session(res0, Y0, backend=b, capacity=60,
+                        max_update_rows=2, max_iters=8, tol=0.0,
+                        robust=quick_policy(chunk_retries=1))
+    sess._opts = dataclasses.replace(sess._opts, fault_chunk=1)
+    with pytest.warns(RuntimeWarning, match="diverged"):
+        u1 = sess.update(panel[40:41])
+    assert u1.diverged and "repair_params" not in sess.health.escalations
+    with pytest.warns(RuntimeWarning, match="diverged"):
+        sess.update(panel[41:42])
+    # Second CONSECUTIVE divergence exceeds chunk_retries: the repair
+    # ladder projects the resident params and re-uploads.
+    assert sess.health.escalations == ["repair_params"]
+    acts = [(e.kind, e.action) for e in sess.health.events]
+    assert ("divergence", "restored") in acts
+    assert ("divergence", "repaired") in acts
+    assert all(e.session == sess.session_id for e in sess.health.events)
+    sess._opts = dataclasses.replace(sess._opts, fault_chunk=None)
+    u3 = sess.update(panel[42:43])   # the session survives, healthy
+    assert not u3.diverged and np.isfinite(u3.nowcast).all()
+
+
+@pytest.mark.parametrize("dtype", ["float64", "float32"],
+                         ids=["x64", "f32"])
+def test_snapshot_restore_update_matches_uninterrupted(panel, tmp_path,
+                                                       dtype):
+    b = TPUBackend(dtype=dtype, fused_chunk=4)
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, backend=b, fused=True, max_iters=8, tol=1e-6)
+    sess = open_session(res0, Y0, backend=b, capacity=60,
+                        max_update_rows=2, max_iters=4, tol=0.0)
+    sess.update(panel[40:42])
+    path = sess.snapshot(str(tmp_path / "sess.npz"))
+    rest = open_session(snapshot=path, backend=b)
+    assert rest.t == sess.t == 42
+    assert rest.capacity == 60 and rest.remaining == sess.remaining
+    u_a = sess.update(panel[42:44])
+    u_b = rest.update(panel[42:44])
+    assert u_b.t == u_a.t == 44
+    if dtype == "float64":
+        np.testing.assert_array_equal(u_b.nowcast, u_a.nowcast)
+        np.testing.assert_array_equal(u_b.logliks, u_a.logliks)
+        np.testing.assert_array_equal(u_b.factors, u_a.factors)
+    else:
+        np.testing.assert_allclose(u_b.nowcast, u_a.nowcast,
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(u_b.logliks, u_a.logliks,
+                                   rtol=1e-3, atol=0.5)
+
+
+def test_restored_session_keeps_one_dispatch_budget(panel, tmp_path):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=8, tol=1e-6)
+    sess = open_session(res0, Y0, capacity=60, max_update_rows=2,
+                        max_iters=4, tol=0.0)
+    sess.update(panel[40:42])   # compiles the one executable
+    path = sess.snapshot(str(tmp_path / "sess.npz"))
+    rest = open_session(snapshot=path)
+    tr = Tracer(detector=RecompileDetector())
+    with activate(tr):
+        rest.update(panel[42:44])
+    disp = [e for e in tr.events if e.get("kind") == "dispatch"
+            and e.get("program") == "serve_update"]
+    # Same shape key in-process: the restored session reuses the
+    # compiled executable — one dispatch, no recompile, one barrier.
+    assert len(disp) == 1 and not any(e.get("recompile") for e in disp)
+    s = summarize(tr.events)
+    assert s["blocking_transfers"] <= 1
+
+
+def test_snapshot_restore_validation(panel, tmp_path):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=6, tol=1e-6)
+    sess = open_session(res0, Y0)
+    path = sess.snapshot(str(tmp_path / "sess.npz"))
+    with pytest.raises(ValueError, match="cannot be passed"):
+        open_session(res0, Y0, snapshot=path)
+    with pytest.raises(TypeError, match="open_session needs"):
+        open_session()
+    # A plain EM checkpoint is not a session snapshot.
+    from dfm_tpu.utils.checkpoint import save_checkpoint
+    ck = str(tmp_path / "ck.npz")
+    save_checkpoint(ck, res0.params, 3, [-1.0], fingerprint="x")
+    with pytest.raises(ValueError, match="not a session snapshot"):
+        open_session(snapshot=ck)
+    # Tampered panel values fail the content fingerprint loudly.
+    with np.load(path) as z:
+        d = {k: z[k] for k in z.files}
+    d["Y_live"] = d["Y_live"] + 1.0
+    bad = str(tmp_path / "bad.npz")
+    np.savez(bad, **d)
+    with pytest.raises(ValueError, match="corrupt"):
+        open_session(snapshot=bad)
+
+
+def test_keep_session_carries_per_fit_robust(panel):
+    Y0 = panel[:40]
+    r_off = fit(MODEL, Y0, fused=True, max_iters=6, tol=1e-6,
+                keep_session=True, robust=False)
+    assert r_off.session._policy is None
+    pol = quick_policy()
+    r_on = fit(MODEL, Y0, fused=True, max_iters=6, tol=1e-6,
+               keep_session=True, robust=pol)
+    assert r_on.session._policy is pol
+    r_off.session.close()
+    r_on.session.close()
+
+
+def test_auto_composes_with_robust(panel, tmp_path, monkeypatch):
+    monkeypatch.setenv("DFM_RUNS", str(tmp_path / "runs"))
+    with pytest.warns(RuntimeWarning):   # empty registry -> default fit
+        r = fit(MODEL, panel[:40], auto=True, max_iters=4, tol=0.0,
+                robust=quick_policy())
+    assert r.health is not None and r.health.ok
+
+
+# ------------------------------------------------ observability --
+
+
+def test_summarize_aggregates_session_robustness(panel):
+    b = TPUBackend(fused_chunk=4)
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, backend=b, fused=True, max_iters=8, tol=1e-6)
+    inj = FaultInjector().dispatch_failure(at=0)
+    tr = Tracer()
+    with activate(tr):
+        sess = open_session(
+            res0, Y0, backend=b, capacity=60, max_update_rows=2,
+            max_iters=8, tol=0.0,
+            robust=quick_policy(chunk_retries=0,
+                                wrap_dispatch=inj.wrap_call))
+        sess.update(panel[40:41])        # injected failure -> one retry
+        sess._opts = dataclasses.replace(sess._opts, fault_chunk=1)
+        with pytest.warns(RuntimeWarning, match="diverged"):
+            sess.update(panel[41:42])    # diverged -> degraded + repaired
+    s = summarize(tr.events)
+    rb = s["robustness"]
+    assert rb["dispatch_retries"] == 1
+    assert rb["backoff_s_total"] > 0.0
+    assert rb["degraded_queries"] == 1
+    assert rb["recovered_divergences"] >= 1
+    ps = rb["per_session"][sess.session_id]
+    assert ps["retries"] == 1 and ps["degraded_queries"] == 1
+    assert ps["recovered_divergences"] >= 1
+    _print_text(s)   # the text report renders the robustness section
+
+
+def test_clean_trace_has_no_robustness_section(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=6, tol=1e-6)
+    tr = Tracer()
+    with activate(tr):
+        sess = open_session(res0, Y0, capacity=60, max_update_rows=2,
+                            max_iters=4, tol=0.0)
+        sess.update(panel[40:42])
+    assert "robustness" not in summarize(tr.events)
+
+
+def test_degraded_queries_metric_registered():
+    from dfm_tpu.obs import store
+    assert "serve_degraded_queries" in store._BENCH_NUMERIC_KEYS
+    assert store.lower_is_better("serve_degraded_queries")
+    assert store.noise_floor("serve_degraded_queries") == 0

@@ -1,0 +1,203 @@
+#!/usr/bin/env bash
+# One-command fault-tolerance soak: drive deterministic injected faults
+# through all three serving layers — the fused one-shot fit, a scheduler
+# bucket, and a streaming session — and assert every layer heals to the
+# CLEAN answer, on the record.  Covers (under one trace):
+#
+#   1. fused fit: injected dispatch failure -> retry -> EXACT parity
+#      with the clean run, plus a hung-transfer recovery under the
+#      watchdog deadline;
+#   2. fit_jobs: retry exhaustion quarantines the bucket, every tenant
+#      is requeued as a lone guarded fit matching its lone oracle; a
+#      NaN-poisoned tenant is evicted ALONE under recover_divergence;
+#   3. session: injected failure retries from last-good to the exact
+#      clean nowcast; snapshot -> restore -> update matches the
+#      uninterrupted session; a craterd chunk degrades (and repairs)
+#      without killing the session.
+#
+# The trace gate then asserts the robustness section of the report:
+# retries/quarantines/degraded queries all present, and the session
+# budget holds (<= 1 blocking d2h per query, 0 recompiles after warmup).
+#
+# Usage (from the repo root):
+#   tools/chaos_smoke.sh [trace_path]        # default /tmp/dfm_chaos.jsonl
+#
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRACE="${1:-/tmp/dfm_chaos.jsonl}"
+rm -f "$TRACE"
+
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" python - "$TRACE" <<'PY'
+import dataclasses
+import os
+import sys
+import tempfile
+import warnings
+
+import numpy as np
+
+import jax
+jax.config.update("jax_enable_x64", True)   # the parity asserts are f64
+
+from dfm_tpu import (DynamicFactorModel, Job, fit, fit_jobs, open_session)
+from dfm_tpu.api import TPUBackend
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.robust import FaultInjector, RobustPolicy
+from dfm_tpu.utils import dgp
+
+MODEL = DynamicFactorModel(n_factors=2, standardize=False)
+rng = np.random.default_rng(23)
+Y, _ = dgp.simulate(dgp.dfm_params(14, 2, rng), 66, rng)
+Y0, stream = Y[:56], Y[56:]
+
+
+def pol(**kw):
+    kw.setdefault("backoff_base", 1e-6)
+    return RobustPolicy(**kw)
+
+
+tr = Tracer(path=sys.argv[1], detector=RecompileDetector())
+with activate(tr):
+    # -- 1. fused fit: injected failure -> retry -> exact parity -------
+    b = TPUBackend(fused_chunk=4)
+    clean = fit(MODEL, Y0, backend=b, fused=True, max_iters=10, tol=0.0,
+                robust=False)
+    inj = FaultInjector().dispatch_failure(at=0)
+    r = fit(MODEL, Y0, backend=TPUBackend(fused_chunk=4), fused=True,
+            max_iters=10, tol=0.0, robust=pol(wrap_dispatch=inj.wrap_call))
+    assert np.array_equal(r.logliks, clean.logliks), \
+        "chaos FAILED: fused retry diverged from the clean trajectory"
+    assert r.health.n_dispatch_retries == 1
+    print("fused: 1 injected failure -> 1 retry -> exact parity")
+
+    inj = FaultInjector().hung_transfer(at=0, seconds=30.0)
+    r = fit(MODEL, Y0, backend=TPUBackend(fused_chunk=4), fused=True,
+            max_iters=10, tol=0.0,
+            robust=pol(wrap_dispatch=inj.wrap_call,
+                       dispatch_deadline_s=5.0))
+    assert np.array_equal(r.logliks, clean.logliks), \
+        "chaos FAILED: watchdog recovery diverged from the clean run"
+    assert any("watchdog" in e.detail for e in r.health.events)
+    print("fused: hung transfer -> watchdog deadline -> retry -> parity")
+
+    # -- 2. scheduler: quarantine + NaN blast radius -------------------
+    def jobs3(seed, poison=None):
+        js = []
+        for i in range(3):
+            rg = np.random.default_rng(seed + i)
+            Yj, _ = dgp.simulate(dgp.dfm_params(10, 2, rg), 40, rg)
+            js.append(Job(Y=Yj, model=DynamicFactorModel(n_factors=2),
+                          tenant=f"t{i}", max_iters=8, tol=1e-6))
+        if poison is not None:
+            from dfm_tpu.backends import cpu_ref
+            bad = cpu_ref.pca_init(
+                np.asarray(js[poison].Y)
+                / np.asarray(js[poison].Y).std(axis=0), 2)
+            bad = dataclasses.replace(
+                bad, Lam=np.full_like(bad.Lam, np.nan))
+            js[poison] = dataclasses.replace(js[poison], init=bad,
+                                             tenant="poisoned")
+        return js
+
+    def ref(job):
+        return fit(job.model, job.Y,
+                   backend=TPUBackend(dtype="float64", filter="info"),
+                   max_iters=job.max_iters, tol=job.tol)
+
+    js = jobs3(900)
+    inj = FaultInjector().dispatch_failure(at=0)
+    stats = {}
+    res = fit_jobs(js, max_buckets=1, dtype="float64", stats=stats,
+                   robust=pol(dispatch_retries=0,
+                              wrap_dispatch=inj.wrap_call))
+    assert stats["n_quarantined"] == 3, \
+        f"chaos FAILED: expected 3 quarantined, got {stats}"
+    for rr, job in zip(res, js):
+        assert np.allclose(rr.fit.logliks, ref(job).logliks,
+                           rtol=1e-9, atol=1e-7), \
+            "chaos FAILED: requeued tenant diverged from its lone oracle"
+        assert rr.fit.health.events[0].kind == "quarantine"
+    print("sched: exhausted bucket -> 3 tenants quarantined -> requeued "
+          "lone fits match their oracles")
+
+    js = jobs3(910, poison=1)
+    stats = {}
+    res = fit_jobs(js, max_buckets=1, dtype="float64", stats=stats,
+                   robust=pol(recover_divergence=True))
+    assert stats["n_quarantined"] == 1
+    assert np.isfinite(np.asarray(res[1].fit.logliks)).all(), \
+        "chaos FAILED: poisoned tenant not repaired in its lone refit"
+    for i in (0, 2):
+        assert np.allclose(res[i].fit.logliks, ref(js[i]).logliks,
+                           rtol=1e-9, atol=1e-7), \
+            "chaos FAILED: NaN quarantine perturbed a bucket-mate"
+    print("sched: NaN tenant evicted alone + repaired; mates untouched")
+
+    # -- 3. session: retry parity, degrade/repair, snapshot/restore ----
+    b = TPUBackend(fused_chunk=4)
+    res0 = fit(MODEL, Y0, backend=b, fused=True, max_iters=10, tol=1e-6)
+    kw = dict(capacity=80, max_update_rows=2, max_iters=8, tol=0.0)
+    s_clean = open_session(res0, Y0, backend=b, robust=False, **kw)
+    inj = FaultInjector().dispatch_failure(at=0)
+    sess = open_session(res0, Y0, backend=b,
+                        robust=pol(chunk_retries=0,
+                                   wrap_dispatch=inj.wrap_call), **kw)
+    u_c = s_clean.update(stream[:2])
+    u_g = sess.update(stream[:2])
+    assert np.array_equal(u_g.nowcast, u_c.nowcast), \
+        "chaos FAILED: session retry diverged from the clean update"
+    assert sess.health.n_dispatch_retries == 1
+    print("session: injected failure -> retry from last-good -> exact "
+          "clean nowcast")
+
+    snap = os.path.join(tempfile.mkdtemp(), "sess.npz")
+    sess.snapshot(snap)
+    rest = open_session(snapshot=snap, backend=b)
+    u_a = sess.update(stream[2:4])
+    u_b = rest.update(stream[2:4])
+    assert np.array_equal(u_b.nowcast, u_a.nowcast), \
+        "chaos FAILED: restored session diverged from the uninterrupted one"
+    print(f"session: snapshot -> restore -> update matches uninterrupted "
+          f"(t={u_b.t})")
+
+    # Crater chunk 1's logliks on device (the fused fault seam; a static
+    # change, so it deliberately compiles one extra executable).
+    sess._opts = dataclasses.replace(sess._opts, fault_chunk=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        u_d = sess.update(stream[4:5])
+    assert u_d.diverged, "chaos FAILED: cratered chunk not flagged"
+    sess._opts = dataclasses.replace(sess._opts, fault_chunk=None)
+    u_ok = sess.update(stream[5:6])
+    assert not u_ok.diverged and np.isfinite(u_ok.nowcast).all(), \
+        "chaos FAILED: session did not survive the divergence"
+    print("session: cratered chunk -> degraded query -> session survives")
+tr.close()
+PY
+
+echo "--- chaos smoke gate ($TRACE) ---"
+python -m dfm_tpu.obs.report "$TRACE"
+python -m dfm_tpu.obs.report "$TRACE" --json | python -c '
+import json, sys
+s = json.load(sys.stdin)
+rb = s.get("robustness") or {}
+q = s.get("queries") or {}
+assert rb.get("dispatch_retries", 0) >= 3, \
+    f"chaos smoke FAILED: retries not aggregated ({rb})"
+assert rb.get("quarantines", 0) == 4, \
+    f"chaos smoke FAILED: expected 4 quarantines, got {rb}"
+assert rb.get("degraded_queries", 0) >= 1, \
+    f"chaos smoke FAILED: degraded query not aggregated ({rb})"
+assert rb.get("per_tenant") and rb.get("per_session"), \
+    f"chaos smoke FAILED: per-tenant/session attribution missing ({rb})"
+n = q.get("n_queries", 0)
+# The healthy serve path compiles once; the ONE extra executable is the
+# deliberate fault-seam toggle (a static change), nothing else.
+assert q.get("recompiles_after_warmup", 99) <= 1, \
+    f"chaos smoke FAILED: serve recompiles after warmup ({q})"
+print("chaos smoke OK: %d retries, %d quarantines, %d degraded, "
+      "%d session queries" % (rb["dispatch_retries"], rb["quarantines"],
+                              rb["degraded_queries"], n))'

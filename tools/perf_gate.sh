@@ -81,5 +81,18 @@ for k in need:
 assert store.noise_floor("serve_p50_ms") > 0, \
     "perf_gate: serve walls lost their ms noise floor"'
 
+# The fault-tolerance health metric (bench.serve / tools/chaos_smoke.sh)
+# must stay registered: degraded-mode queries are an exact count (noise
+# floor 0) gated lower-is-better — a serving path quietly leaning on the
+# repair ladder is a regression even when latency holds.
+python -c '
+from dfm_tpu.obs import store
+assert "serve_degraded_queries" in store._BENCH_NUMERIC_KEYS, \
+    "perf_gate: obs.store not recording serve_degraded_queries"
+assert store.lower_is_better("serve_degraded_queries"), \
+    "perf_gate: serve_degraded_queries lost its lower-is-better marker"
+assert store.noise_floor("serve_degraded_queries") == 0, \
+    "perf_gate: serve_degraded_queries must gate exactly (count metric)"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"
