@@ -1,0 +1,68 @@
+"""Shared bench-CLI plumbing: logging, percentiles, timing, run records.
+
+Every bench module (``bench.py``, ``bench.serve``, ``bench.mixed``,
+``bench.fleet``, ``bench.all``) shares the same contract — exactly ONE
+JSON line on stdout, diagnostics on stderr, and an optional RunRecord
+appended to the perf-observatory registry.  This module owns the pieces
+they all duplicate; the contracts themselves are unchanged.
+"""
+
+import sys
+import time
+
+__all__ = ["log", "pct", "timed", "parse_mix", "record_run"]
+
+
+def log(*a):
+    """stderr-only diagnostics (stdout is reserved for the ONE JSON line)."""
+    print(*a, file=sys.stderr, flush=True)
+
+
+def pct(xs, q):
+    """Nearest-rank percentile (same convention as obs.report)."""
+    ys = sorted(xs)
+    return ys[min(int(round(q / 100.0 * (len(ys) - 1))), len(ys) - 1)]
+
+
+def timed(f, reps=3):
+    """Best-of-N wall of ``f()`` after one warm-up/compile call."""
+    f()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        f()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def parse_mix(spec):
+    """Same grammar as ``obs.advise --jobs``: N,T,K[xC] joined by ';'."""
+    shapes = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        mult = 1
+        if "x" in part.rsplit(",", 1)[-1]:
+            part, m = part.rsplit("x", 1)
+            mult = int(m)
+        N, T, k = (int(x) for x in part.split(","))
+        shapes.extend([(N, T, k)] * mult)
+    return shapes
+
+
+def record_run(payload, dev, kind):
+    """Append this run to the perf-observatory registry (obs.store);
+    stderr-only diagnostics, same contract as bench.py."""
+    from dfm_tpu.obs import store as obs_store
+    d = obs_store.runs_dir()
+    if d is None:
+        return
+    try:
+        rec = obs_store.record_from_bench_json(
+            payload, device=f"{dev.platform} ({dev.device_kind})",
+            kind=kind)
+        obs_store.RunStore(d).append(rec)
+        log(f"run {payload['run_id']} recorded in {d}/")
+    except Exception as e:  # registry failure must not fail the bench
+        log(f"WARNING: run registry append failed: {e}")

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Fleet serving benchmark: aggregate query throughput of a
+``dfm_tpu.open_fleet`` under Poisson mixed-tenant load (ONE fused batched
+``serve_update`` dispatch per bucket per tick answers every queued
+tenant's query) vs the loop-over-lone-sessions baseline (one
+``open_session`` per tenant, one dispatch PER query — the only option
+before ``fleet/``).  Prints exactly ONE JSON line to stdout:
+
+    {"metric": ..., "value": N, "unit": "queries/sec",
+     "fleet_qps": N, "fleet_p99_ms": N, "fleet_pad_waste_frac": N, ...}
+
+``value`` is the fleet's aggregate warm queries/sec (total queries
+served / drain wall, d2h barriers included).  ``fleet_p99_ms`` is the
+p99 per-query latency (each query completes with its tick).  The load is
+Poisson: each round every tenant independently queues a query with a
+ragged row count, so ticks carry a realistic mixed active set.
+
+Run on the real chip: ``python -m bench.fleet``.  Smoke-size via
+DFM_BENCH_FLEET_MIX ("N,T,KxC;..." tenant shapes, default 2 groups x 4 =
+8 tenants), DFM_BENCH_ROUNDS (load rounds, default 6), DFM_BENCH_ROWS
+(max rows/query, default 2), DFM_BENCH_SERVE_ITERS (EM iters/query,
+default 5), DFM_BENCH_ITERS (cold-fit budget, default 30),
+DFM_BENCH_MAX_CLASSES, DFM_BENCH_FLEET_BACKEND (tpu|sharded).
+Diagnostics on stderr.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from bench._common import log, parse_mix, pct as _pct, record_run
+
+
+def main():
+    mix = os.environ.get("DFM_BENCH_FLEET_MIX", "16,56,2x4;24,72,2x4")
+    rounds = int(os.environ.get("DFM_BENCH_ROUNDS", 6))
+    r_max = int(os.environ.get("DFM_BENCH_ROWS", 2))
+    serve_iters = int(os.environ.get("DFM_BENCH_SERVE_ITERS", 5))
+    cold_iters = int(os.environ.get("DFM_BENCH_ITERS", 30))
+    max_classes = int(os.environ.get("DFM_BENCH_MAX_CLASSES", 2))
+    backend = os.environ.get("DFM_BENCH_FLEET_BACKEND", "tpu")
+    shapes = parse_mix(mix)
+    B = len(shapes)
+
+    import jax
+    jax.config.update("jax_enable_x64", True)  # f64 loglik assembly
+
+    from dfm_tpu import (DynamicFactorModel, TPUBackend, fit, open_fleet,
+                         open_session)
+    from dfm_tpu.obs.trace import Tracer, activate, current_tracer
+    from dfm_tpu.utils import dgp
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}); {B} tenants "
+        f"[{mix}], {rounds} Poisson rounds, <= {r_max} rows/query, "
+        f"{serve_iters} EM iters/query, backend={backend}")
+
+    # Per-tenant fitted models + a Poisson query schedule.  The fleet is
+    # info-filter-only, so the lone baseline uses the same filter — both
+    # sides run the identical per-query program semantics.
+    be = TPUBackend(filter="info")
+    rng = np.random.default_rng(123)
+    schedule = []       # [round][tenant] -> n_rows (0 = no query)
+    for _ in range(rounds):
+        lam_rows = 1 + rng.poisson(0.6, size=B)
+        arrive = rng.random(B) < 0.75
+        schedule.append([int(min(r_max, lam_rows[i])) if arrive[i] else 0
+                         for i in range(B)])
+    n_total = [1 * r_max + sum(s[i] for s in schedule)
+               for i in range(B)]    # warmup round + load
+
+    model_of, ress, Ys, streams = [], [], [], []
+    with jax.default_matmul_precision("highest"):
+        for i, (N, T, k) in enumerate(shapes):
+            rngi = np.random.default_rng(3000 + i)
+            p_true = dgp.dfm_params(N, k, rngi)
+            Y_all, _ = dgp.simulate(p_true, T + n_total[i], rngi)
+            m = DynamicFactorModel(n_factors=k)
+            model_of.append(m)
+            ress.append(fit(m, Y_all[:T], max_iters=cold_iters,
+                            backend=be, telemetry=False))
+            Ys.append(Y_all[:T])
+            streams.append(Y_all[T:])
+
+    tracer = current_tracer()
+    if tracer is None:
+        tracer = Tracer()
+
+    caps = [Ys[i].shape[0] + n_total[i] + r_max for i in range(B)]
+    with activate(tracer), jax.default_matmul_precision("highest"):
+        fleet = open_fleet(ress, Ys, capacity=caps,
+                           max_update_rows=r_max, max_iters=serve_iters,
+                           tol=0.0, backend=backend if backend != "tpu"
+                           else be, max_classes=max_classes)
+        names = fleet.tenants
+        cursor = [0] * B
+        # Warmup tick: every tenant active (compiles the one executable
+        # per bucket; later ticks reuse it for every active set).
+        for i, t in enumerate(names):
+            fleet.submit(t, streams[i][:r_max])
+            cursor[i] = r_max
+        fleet.drain()
+        base = tracer.summary()
+        base_ticks = fleet._n_ticks
+
+        walls, q_lat = [], []
+        t_load0 = time.perf_counter()
+        n_queries = 0
+        for rnd in schedule:
+            for i, t in enumerate(names):
+                if rnd[i]:
+                    fleet.submit(t, streams[i][cursor[i]:cursor[i]
+                                               + rnd[i]])
+                    cursor[i] += rnd[i]
+                    n_queries += 1
+            t0 = time.perf_counter()
+            out = fleet.drain()
+            w = time.perf_counter() - t0
+            walls.append(w)
+            for t, ups in out.items():
+                q_lat.extend([u.wall_s for u in ups])
+        fleet_wall = time.perf_counter() - t_load0
+        warm = tracer.summary()
+        n_ticks = fleet._n_ticks - base_ticks
+        qps = n_queries / fleet_wall
+        p50_ms = 1e3 * _pct(q_lat, 50)
+        p99_ms = 1e3 * _pct(q_lat, 99)
+        blocking = (warm["blocking_transfers"] - base["blocking_transfers"])
+        per_tick = blocking / max(n_ticks, 1)
+        recomp = (warm["programs"].get("serve_update", {})
+                  .get("recompiles", 0)
+                  - base["programs"].get("serve_update", {})
+                  .get("recompiles", 0))
+        log(f"fleet: {n_queries} queries in {fleet_wall:.3f} s "
+            f"({qps:.1f} q/s) over {n_ticks} ticks "
+            f"({n_queries / max(n_ticks, 1):.2f} queries/dispatch); "
+            f"query p50 {p50_ms:.1f} ms p99 {p99_ms:.1f} ms; "
+            f"{per_tick:.2f} blocking transfers/tick, {recomp} recompiles "
+            f"after warmup; pad waste {100 * fleet.pad_waste_frac:.1f}%")
+
+        # Baseline: one lone session per tenant serving the SAME
+        # schedule (state ends identical) — one dispatch per query.
+        sessions = [open_session(ress[i], Ys[i], capacity=caps[i],
+                                 max_update_rows=r_max,
+                                 max_iters=serve_iters, tol=0.0,
+                                 backend=be) for i in range(B)]
+        cursor = [0] * B
+        for i, s in enumerate(sessions):      # warmup (compile) query
+            s.update(streams[i][:r_max])
+            cursor[i] = r_max
+        t0 = time.perf_counter()
+        for rnd in schedule:
+            for i, s in enumerate(sessions):
+                if rnd[i]:
+                    s.update(streams[i][cursor[i]:cursor[i] + rnd[i]])
+                    cursor[i] += rnd[i]
+        lone_wall = time.perf_counter() - t0
+        lone_qps = n_queries / lone_wall
+        log(f"lone sessions: {lone_wall:.3f} s ({lone_qps:.1f} q/s); "
+            f"fleet speedup {lone_wall / fleet_wall:.2f}x")
+
+    ts_sum = tracer.summary()
+    log(f"telemetry: {ts_sum['dispatches']} dispatches, "
+        f"{ts_sum['recompiles']} recompiles"
+        + (f" -> {tracer.path}" if tracer.path else ""))
+
+    from dfm_tpu.obs.store import new_run_id
+    payload = {
+        "metric": f"fleet_qps_{B}tenants",
+        "value": round(qps, 2),
+        "unit": "queries/sec",
+        "value_definition": ("aggregate warm fleet throughput under "
+                             "Poisson mixed-tenant load: queries served "
+                             "per second of drain wall (one fused "
+                             "batched serve_update dispatch per bucket "
+                             "per tick, d2h barriers included)"),
+        "fleet_qps": round(qps, 2),
+        "fleet_p99_ms": round(p99_ms, 2),
+        "fleet_pad_waste_frac": round(float(fleet.pad_waste_frac), 4),
+        "fleet_p50_ms": round(p50_ms, 2),
+        "fleet_blocking_transfers_per_tick": round(per_tick, 3),
+        "queries_per_dispatch": round(n_queries / max(n_ticks, 1), 3),
+        "recompiles_after_warmup": int(recomp),
+        "speedup_vs_lone_sessions": round(lone_wall / fleet_wall, 2),
+        "lone_sessions_qps": round(lone_qps, 2),
+        "n_tenants": B,
+        "n_queries": n_queries,
+        "n_ticks": n_ticks,
+        "n_classes": fleet.n_buckets,
+        "serve_iters": serve_iters,
+        "mix": mix,
+        "fleet_backend": backend,
+        "dispatches": ts_sum["dispatches"],
+        "recompiles": ts_sum["recompiles"],
+        "run_id": new_run_id(),
+    }
+    print(json.dumps(payload))
+    record_run(payload, dev, "bench_fleet")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,30 +24,11 @@ Diagnostics on stderr.
 
 import json
 import os
-import sys
-import time
 
 import numpy as np
 
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
-def _parse_mix(spec):
-    """Same grammar as ``obs.advise --jobs``: N,T,K[xC] joined by ';'."""
-    shapes = []
-    for part in spec.split(";"):
-        part = part.strip()
-        if not part:
-            continue
-        mult = 1
-        if "x" in part.rsplit(",", 1)[-1]:
-            part, m = part.rsplit("x", 1)
-            mult = int(m)
-        N, T, k = (int(x) for x in part.split(","))
-        shapes.extend([(N, T, k)] * mult)
-    return shapes
+from bench._common import (log, parse_mix as _parse_mix, record_run,
+                           timed)
 
 
 def main():
@@ -106,15 +87,6 @@ def main():
             fit(job.model, job.Y, backend=be, max_iters=n_iters, tol=0.0,
                 telemetry=False)
 
-    def timed(f, reps=3):
-        f()  # warm-up / compile
-        ts = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            f()
-            ts.append(time.perf_counter() - t0)
-        return min(ts)
-
     with activate(tracer), jax.default_matmul_precision("highest"):
         t_s = timed(run_sched)
         agg = total_iters / t_s
@@ -160,24 +132,7 @@ def main():
         "run_id": new_run_id(),
     }
     print(json.dumps(payload))
-    _record_run(payload, dev)
-
-
-def _record_run(payload, dev):
-    """Append this run to the perf-observatory registry (obs.store);
-    stderr-only diagnostics, same contract as bench.py."""
-    from dfm_tpu.obs import store as obs_store
-    d = obs_store.runs_dir()
-    if d is None:
-        return
-    try:
-        rec = obs_store.record_from_bench_json(
-            payload, device=f"{dev.platform} ({dev.device_kind})",
-            kind="bench_mixed")
-        obs_store.RunStore(d).append(rec)
-        log(f"run {payload['run_id']} recorded in {d}/")
-    except Exception as e:  # registry failure must not fail the bench
-        log(f"WARNING: run registry append failed: {e}")
+    record_run(payload, dev, "bench_mixed")
 
 
 if __name__ == "__main__":

@@ -24,20 +24,11 @@ budget, default 50).  Diagnostics on stderr.
 
 import json
 import os
-import sys
 import time
 
 import numpy as np
 
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
-def _pct(xs, q):
-    """Nearest-rank percentile (same convention as obs.report)."""
-    ys = sorted(xs)
-    return ys[min(int(round(q / 100.0 * (len(ys) - 1))), len(ys) - 1)]
+from bench._common import log, pct as _pct, record_run
 
 
 def main():
@@ -174,24 +165,7 @@ def main():
         "run_id": new_run_id(),
     }
     print(json.dumps(payload))
-    _record_run(payload, dev)
-
-
-def _record_run(payload, dev):
-    """Append this run to the perf-observatory registry (obs.store);
-    stderr-only diagnostics, same contract as bench.py."""
-    from dfm_tpu.obs import store as obs_store
-    d = obs_store.runs_dir()
-    if d is None:
-        return
-    try:
-        rec = obs_store.record_from_bench_json(
-            payload, device=f"{dev.platform} ({dev.device_kind})",
-            kind="bench_serve")
-        obs_store.RunStore(d).append(rec)
-        log(f"run {payload['run_id']} recorded in {d}/")
-    except Exception as e:  # registry failure must not fail the bench
-        log(f"WARNING: run registry append failed: {e}")
+    record_run(payload, dev, "bench_serve")
 
 
 if __name__ == "__main__":

@@ -24,9 +24,15 @@ Design constraints this file encodes:
   between chunks (one small transfer — the only execution barrier this
   device class has); dispatches go through the ``robust.guard`` retry seam
   and per-problem ``FitHealth`` records are built from the traces.
-- Unmasked panels only: a per-problem mask would make C_t time-varying
-  ((B, T, k, k) carried through the scan) and the masked M-step needs the
-  (T, k, k) moment tensors — the host-loop path already covers that case.
+- The FIT engine is unmasked-panels-only: a per-problem mask would make
+  C_t time-varying ((B, T, k, k) carried through the scan) and the masked
+  M-step needs the (T, k, k) moment tensors — the host-loop path already
+  covers that case.  The SERVING twins at the bottom of this file
+  (``batched_ragged_append`` + the ``*_masked`` filter/M-step) accept that
+  cost deliberately: they batch ``serve/session.py``'s capacity-padded
+  elementwise-masked program, where the mask IS the live-length/missing
+  encoding and T is session-capacity-sized, for the fleet tier
+  (``dfm_tpu/fleet/``).
 
 The batch members may differ by init (restarts), by data (windows), or by
 ACTIVE factor count (k-grid): problems with k_b < k_max are padded with
@@ -65,7 +71,8 @@ __all__ = ["DFMBatchSpec", "BatchFitResult", "fit_many", "run_batched_em",
            "stack_params", "unstack_params", "pad_params_to_k",
            "slice_params_to_k", "batched_m_step", "Hetero", "make_hetero",
            "pad_panel_to_t", "pad_panel_to_n", "pad_params_to_n",
-           "slice_params_to_n"]
+           "slice_params_to_n", "batched_ragged_append",
+           "batched_filter_masked", "batched_m_step_masked"]
 
 _LOG2PI = 1.8378770664093453
 
@@ -500,6 +507,189 @@ def batched_m_step(Y, x_sm, P_sm, P_lag, p: SSMParams, cfg: EMConfig, Ysq,
         Q = sym((S_cur - matmul_vpu(A, _bT(S_cross))
                  - matmul_vpu(S_cross, _bT(A))
                  + matmul_vpu(matmul_vpu(A, S_lag), _bT(A))) / T_q)
+    mu0, P0 = p.mu0, p.P0
+    if cfg.estimate_init:
+        mu0, P0 = x_sm[:, 0], sym(P_sm[:, 0])
+    return SSMParams(Lam, A, Q, R, mu0, P0)
+
+
+# ---------------------------------------------------------------------------
+# Serving twins: elementwise-masked batched filter/M-step + ragged append
+# (the B-way batch of serve/session.py's capacity-padded program — every
+# formula mirrors the lone masked path op-for-op so a fleet lane pins to
+# the same tenant's lone NowcastSession)
+# ---------------------------------------------------------------------------
+
+def batched_ragged_append(Ybuf, Wbuf, rows, rmask, t_cur):
+    """In-graph ragged per-tenant row append: scatter each tenant's
+    ``rows[b, :n_new_b]`` into its capacity-padded panel slot starting at
+    its OWN live length ``t_cur[b]`` — one executable regardless of which
+    tenants appended or how many rows each brought.
+
+    Exactness across the seams (pinned by tests/test_fleet.py): rows past
+    each tenant's true count arrive exact-zero with an exact-zero row
+    mask (the host pads them that way), so they land zeros on the already
+    -zero pad region — value-inert, bit-identical to the lone session's
+    ``Ybuf.at[idx].set(rows, mode="drop")`` which performs the SAME
+    per-tenant scatter.  A tenant with ``n_new == 0`` (inactive this
+    tick, or a pure re-forecast query) writes only zeros-on-zeros.
+    ``mode="drop"`` discards indices past capacity, exactly as the lone
+    session's scatter does.
+
+    Ybuf/Wbuf (B, T_cap, N); rows/rmask (B, r_max, N); t_cur (B,) int32.
+    """
+    r_max = rows.shape[1]
+    off = jnp.arange(r_max, dtype=t_cur.dtype)
+
+    def one(buf, wbuf, r, m, t0):
+        idx = t0 + off
+        return (buf.at[idx].set(r, mode="drop"),
+                wbuf.at[idx].set(m, mode="drop"))
+
+    return jax.vmap(one)(Ybuf, Wbuf, rows, rmask, t_cur)
+
+
+def _batched_obs_stats_masked(Y, W, Lam, R):
+    """Per-tenant TIME-VARYING info-form observation reductions for
+    elementwise-masked panels: b (B, T, k), C (B, T, k, k), n (B, T),
+    ldR (B, T).  The (B,)-batched twin of the masked branch of
+    ``ssm.info_filter.obs_stats`` — W encodes everything (missing cells,
+    the dead capacity tail past each tenant's live length, and inert
+    N-pad series), so no separate shape masks are needed: a fully-masked
+    step contributes b_t = 0, C_t = 0, n_t = 0, ldR_t = 0 and the filter
+    step degenerates to the exact prediction-only update."""
+    acc = accum_dtype(Y.dtype)
+    Yw = W * jnp.nan_to_num(Y)
+    Rinv = 1.0 / R
+    logR = jnp.log(R).astype(acc)
+    G = Lam * Rinv[..., None]                       # (B, N, k)
+    b = jnp.einsum("btn,bnk->btk", Yw, G)
+    C = jnp.einsum("bnk,btn,bn,bnl->btkl", Lam, W, Rinv, Lam)
+    n = jnp.sum(W, axis=-1).astype(acc)             # (B, T)
+    ldR = jnp.einsum("btn,bn->bt", W.astype(acc), logR)
+    return b, C, n, ldR
+
+
+def _batched_info_scan_tv(b_seq, C_seq, A, Q, mu0, P0):
+    """Info-form time scan with TIME-VARYING per-step stats (B-batched
+    twin of ``ssm.info_filter.info_scan`` with a time-varying C_t), every
+    op an unrolled/VPU form over (B,).
+
+    NO freeze machinery here, deliberately: the lone session filter runs
+    masked updates over the FULL capacity buffer — a dead step has
+    C_t = 0 (G = I, P_f = P_p, x_f = x_p: an exact no-op update) but the
+    prediction still advances through the tail, and the RTS backward
+    corrections through that tail are exactly zero by induction, leaving
+    the live prefix exact.  Reproducing that (rather than ``Hetero``'s
+    carry-freeze, which changes the prediction semantics) is what pins a
+    fleet lane bit-for-bit to its lone session.
+
+    b_seq (T, B, k) / C_seq (T, B, k, k) time-major; returns time-major
+    (x_pred, P_pred, x_filt, P_filt, logdetG)."""
+    k = A.shape[-1]
+    I_k = jnp.eye(k, dtype=b_seq.dtype)
+
+    def step(carry, inp):
+        b_t, C_t = inp
+        x, P = carry                                # (B, k), (B, k, k)
+        Lp = bchol(P)
+        CL = matmul_vpu(C_t, Lp)
+        G = I_k + matmul_vpu(_bT(Lp), CL)           # >= I: no jitter needed
+        Lg = bchol(G, jitter=0.0)
+        P_f = sym(matmul_vpu(Lp, bchol_solve(Lg, _bT(Lp))))
+        u = b_t - matvec_vpu(C_t, x)
+        x_f = x + matvec_vpu(P_f, u)
+        x_n = matvec_vpu(A, x_f)
+        P_n = sym(matmul_vpu(matmul_vpu(A, P_f), _bT(A)) + Q)
+        return (x_n, P_n), (x, P, x_f, P_f, chol_logdet(Lg))
+
+    return lax.scan(step, (mu0, P0), (b_seq, C_seq))[1]
+
+
+def _batched_loglik_masked(Y, W, p, b, C, n, ldR, x_pred, P_filt, logdetG):
+    """Per-tenant loglik (B,) for the elementwise-masked filter — the
+    batched twin of ``info_filter.loglik_from_terms`` fed by the masked
+    ``quad_local``/``u_from_stats``: residual-pass quad_R, U from the
+    time-varying stats, U'P_f U in compute dtype, assembly in accum
+    dtype.  Fully-masked steps contribute exact zeros, so summing over
+    the full capacity axis equals the live-prefix sum."""
+    acc = accum_dtype(Y.dtype)
+    V = W * jnp.nan_to_num(Y - jnp.einsum("btk,bnk->btn", x_pred, p.Lam))
+    quad_R = jnp.sum((V * (V / p.R[:, None, :])).astype(acc), axis=-1)
+    U = b - jnp.einsum("btkl,btl->btk", C, x_pred)
+    upu = jnp.einsum("btk,btkl,btl->bt", U.astype(P_filt.dtype), P_filt,
+                     U.astype(P_filt.dtype))
+    lls = -0.5 * (n * _LOG2PI + ldR + logdetG.astype(acc) + quad_R
+                  - upu.astype(acc))
+    return jnp.sum(lls, axis=1)
+
+
+def batched_filter_masked(Y, W, p):
+    """Elementwise-masked info-form filter over the batch: returns
+    (loglik (B,), batch-major (x_pred, P_pred, x_filt, P_filt)).  The
+    B-way twin of ``info_filter.info_filter(Y, p, mask=W)`` as the serve
+    session drives it (capacity-padded panel, W zero past each tenant's
+    live length)."""
+    b, C, n, ldR = _batched_obs_stats_masked(Y, W, p.Lam, p.R)
+    tm = lambda a: jnp.moveaxis(a, 1, 0)            # noqa: E731
+    outs = _batched_info_scan_tv(tm(b), tm(C), p.A, p.Q, p.mu0, p.P0)
+    xp, Pp, xf, Pf, ldG = (jnp.moveaxis(o, 0, 1) for o in outs)
+    ll = _batched_loglik_masked(Y, W, p, b, C, n, ldR, xp, Pf, ldG)
+    return ll, (xp, Pp, xf, Pf)
+
+
+def batched_m_step_masked(Y, W, x_sm, P_sm, P_lag, p: SSMParams,
+                          cfg: EMConfig, t_new):
+    """Closed-form masked M-step per tenant — the batched twin of
+    ``em._m_step(Y, mask, ..., n_steps=t_new)`` with TRACED per-tenant
+    live lengths ``t_new`` (B,) int32: observation rows follow
+    ``em.mstep_rows``'s masked path (never-observed series get identity
+    S_ff and thus exact-zero loading rows — which is also what keeps
+    N-pad series inert), dynamics follow ``em.mstep_dynamics_tmasked``
+    with per-tenant {0,1} time weights and a traced ``t_new - 1``
+    transition divisor, so ONE executable serves every live-length
+    vector a fleet bucket can reach."""
+    dt = Y.dtype
+    B, T, N = Y.shape
+    k = p.A.shape[-1]
+    Wz = W.astype(dt)
+    Yz = jnp.where(Wz > 0, jnp.nan_to_num(Y), 0.0)
+    EffT = P_sm + jnp.einsum("bti,btj->btij", x_sm, x_sm)   # (B, T, k, k)
+    cross = P_lag[:, 1:] + jnp.einsum("bti,btj->btij",
+                                      x_sm[:, 1:], x_sm[:, :-1])
+    # -- observation rows (em.mstep_rows, masked branch) -----------------
+    S_yf_i = jnp.einsum("btn,btk->bnk", Yz, x_sm)           # (B, N, k)
+    S_ff_i = jnp.einsum("btn,btkl->bnkl", Wz, EffT)         # (B, N, k, k)
+    never = (Wz.sum(1) == 0)[..., None, None]
+    S_ff_i = jnp.where(never, jnp.eye(k, dtype=dt), S_ff_i)
+    Lam = bchol_solve(bchol(S_ff_i), S_yf_i)                # (B, N, k)
+    counts = jnp.maximum(Wz.sum(1), 1.0)
+    resid_sq = jnp.einsum(
+        "btn,btn->bn", Wz,
+        (Yz - jnp.einsum("btk,bnk->btn", x_sm, Lam)) ** 2)
+    PV = jnp.einsum("btn,btkl->bnkl", Wz, P_sm)
+    smear = jnp.einsum("bnk,bnkl,bnl->bn", Lam, PV, Lam)
+    R = jnp.maximum((resid_sq + smear) / counts, cfg.r_floor)
+    # -- dynamics (em.mstep_dynamics_tmasked, per-tenant weights) --------
+    A, Q = p.A, p.Q
+    if cfg.estimate_A or cfg.estimate_Q:
+        t_idx = jnp.arange(T)[None, :]
+        tn = t_new[:, None]
+        w_lag = (t_idx < tn - 1).astype(dt)
+        w_cur = ((t_idx >= 1) & (t_idx < tn)).astype(dt)
+        w_x = (jnp.arange(T - 1)[None, :] < tn - 1).astype(dt)
+        S_lag = jnp.einsum("bt,btkl->bkl", w_lag, EffT)
+        S_cur = jnp.einsum("bt,btkl->bkl", w_cur, EffT)
+        S_cross = jnp.einsum("bt,btkl->bkl", w_x, cross)
+        T_q = (t_new.astype(dt) - 1.0)[:, None, None]
+        if cfg.estimate_A:
+            A = _bsolve_rows(S_lag, S_cross)
+            if cfg.estimate_Q:
+                Q = sym((S_cur - matmul_vpu(A, _bT(S_cross))) / T_q)
+        elif cfg.estimate_Q:
+            Q = sym((S_cur - matmul_vpu(A, _bT(S_cross))
+                     - matmul_vpu(S_cross, _bT(A))
+                     + matmul_vpu(matmul_vpu(A, S_lag), _bT(A))) / T_q)
     mu0, P0 = p.mu0, p.P0
     if cfg.estimate_init:
         mu0, P0 = x_sm[:, 0], sym(P_sm[:, 0])

@@ -1,0 +1,21 @@
+"""Fleet serving: batched session multiplexing across tenants.
+
+``open_fleet(results, panels)`` keeps B tenants' params + capacity-padded
+panels device-resident in shape-bucketed batched buffers (admission
+control assigns tenants to capacity classes via the calibrated cost
+model); ``fleet.submit(tenant, rows)`` enqueues and ``fleet.drain()``
+serves the queue as ONE fused batched ``serve_update`` program per bucket
+per tick — ragged per-tenant appends, independent warm EM freezes, RTS
+smooth, nowcast + forecasts — with at most one blocking d2h per tick,
+one executable per bucket shape, and per-tenant answers numerically
+pinned to the same tenant's lone ``NowcastSession``.  Ticks run under the
+PR 10 dispatch guard with per-tenant quarantine: a poisoned tenant is
+evicted to a lone guarded session without stalling its bucket-mates.
+"""
+
+from .admission import ClassAssignment, fleet_pad_waste, plan_admission
+from .buffers import FleetBucket, TenantSlot
+from .driver import SessionFleet, open_fleet
+
+__all__ = ["SessionFleet", "open_fleet", "FleetBucket", "TenantSlot",
+           "ClassAssignment", "plan_admission", "fleet_pad_waste"]

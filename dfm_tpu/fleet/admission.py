@@ -1,0 +1,122 @@
+"""Admission control: assign fleet tenants to capacity classes.
+
+A capacity class is one bucket shape — every member tenant's panel is
+resident padded to the class dims and one fused ``serve_update`` dispatch
+per tick answers all of its queued queries.  More classes means tighter
+padding (less per-tick flop waste) but one more executable AND one more
+~60-100 ms tunnel dispatch per tick; ``sched.buckets.plan_capacity_classes``
+runs the calibrated cost-model DP over exactly that trade.
+
+Tenants whose models differ in estimation flags (estimate_A/Q/init)
+cannot share a program (the flags are jit statics), so admission first
+partitions by config and plans classes within each group — deterministic:
+groups are visited in first-tenant submit order, and the DP itself is
+deterministic given the profile registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from ..obs.cost import em_iter_work, fit_cost_model
+from ..sched.buckets import plan_capacity_classes
+
+__all__ = ["ClassAssignment", "plan_admission", "fleet_pad_waste"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassAssignment:
+    """One planned capacity class: padded ``dims`` = (T_cap, N_max, k_max)
+    and the submit-order tenant indices assigned to it."""
+
+    dims: Tuple[int, int, int]
+    members: Tuple[int, ...]
+
+
+def _load_model(runs: Optional[str], device: Optional[str]):
+    from ..obs.store import RunStore, runs_dir
+    d = runs_dir(runs)
+    profiles = []
+    if d is not None:
+        profiles = [r for r in RunStore(d).load()
+                    if r.get("kind") == "profile"]
+    return fit_cost_model(profiles, device=device)
+
+
+def plan_admission(shapes: Sequence[Tuple[int, int, int]],
+                   iters: Sequence[int],
+                   cfg_keys: Optional[Sequence[tuple]] = None, *,
+                   max_classes: int = 3, model=None,
+                   runs: Optional[str] = None,
+                   device: Optional[str] = None) -> List[ClassAssignment]:
+    """Plan capacity classes for tenants with per-tenant resident shapes
+    ``[(T_capacity, N, k), ...]`` and per-tick EM budgets ``iters``.
+
+    ``cfg_keys`` (optional, one hashable per tenant) force tenants with
+    different keys into different classes; ``max_classes`` bounds the
+    TOTAL class count (each config group gets at least one).  ``model``
+    overrides the cost model (default: calibrate from the profile
+    registry, device priors when empty — same resolution as
+    ``obs.advise``).  Deterministic given a fixed registry.
+    """
+    B = len(shapes)
+    if B == 0:
+        return []
+    if len(iters) != B:
+        raise ValueError("iters must match shapes length")
+    keys = [()] * B if cfg_keys is None else list(cfg_keys)
+    if len(keys) != B:
+        raise ValueError("cfg_keys must match shapes length")
+    m = model if model is not None else _load_model(runs, device)
+    groups: List[Tuple[tuple, List[int]]] = []
+    for i, key in enumerate(keys):
+        for gk, members in groups:
+            if gk == key:
+                members.append(i)
+                break
+        else:
+            groups.append((key, [i]))
+    if max_classes < len(groups):
+        raise ValueError(
+            f"max_classes={max_classes} but the fleet has {len(groups)} "
+            "incompatible model configs (each needs its own class)")
+    # Budget split: every group gets one class; the extras round-robin
+    # over groups largest-first (deterministic, and generous where the
+    # padding waste can actually accrue).
+    extra = max_classes - len(groups)
+    alloc = [1] * len(groups)
+    order = sorted(range(len(groups)), key=lambda gi: -len(groups[gi][1]))
+    gi = 0
+    while extra > 0 and any(alloc[j] < len(groups[j][1]) for j in order):
+        j = order[gi % len(order)]
+        if alloc[j] < len(groups[j][1]):
+            alloc[j] += 1
+            extra -= 1
+        gi += 1
+    out: List[ClassAssignment] = []
+    for (gk, members), mc in zip(groups, alloc):
+        plan = plan_capacity_classes(
+            [shapes[i] for i in members], [iters[i] for i in members],
+            max_classes=mc, model=m)
+        for b in plan.buckets:
+            out.append(ClassAssignment(
+                dims=b.dims,
+                members=tuple(members[j] for j in b.jobs)))
+    return out
+
+
+def fleet_pad_waste(shapes: Sequence[Tuple[int, int, int]],
+                    iters: Sequence[int],
+                    classes: Sequence[ClassAssignment]) -> float:
+    """Aggregate padded-flop waste of an admission plan: 1 - true/padded
+    EM flops over all tenants at their per-tick budgets (the bench's
+    ``fleet_pad_waste_frac``)."""
+    true_fl = padded_fl = 0.0
+    for ca in classes:
+        bT, bN, bk = ca.dims
+        for i in ca.members:
+            T, N, k = shapes[i]
+            true_fl += em_iter_work(N, T, k)[0] * iters[i]
+            padded_fl += em_iter_work(bN, bT, bk)[0] * iters[i]
+    return 1.0 - true_fl / padded_fl if padded_fl > 0 else 0.0

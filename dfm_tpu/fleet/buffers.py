@@ -1,0 +1,171 @@
+"""Device-resident fleet state: tenant slots packed into bucket buffers.
+
+A ``TenantSlot`` is the host-side record of one tenant: its frozen
+standardizer, model, live length, budgets, and the ORIGINAL-UNITS live
+panel (the eviction seed — a quarantined tenant is rebuilt as a lone
+``NowcastSession`` from exactly this state).  A ``FleetBucket`` packs B
+slots of one capacity class into (B, T_cap, N_max)-shaped device panel
+buffers plus one stacked params pytree, built with the PR 8 inert-padding
+seams (``pad_panel_to_t``/``pad_panel_to_n`` exact-zero panels,
+``pad_params_to_k``/``pad_params_to_n`` inert factors/series) — so lane b
+of the bucket IS tenant b's lone session buffer, bit-for-bit, under the
+masked serving twins.
+
+Host shadows (f64 numpy panels + per-lane cpu_ref params) mirror the
+device state exactly, serving the same two roles they do in
+``serve/session.py``: the donated-retry rebuild source (``_redeploy``)
+and the quarantine/eviction seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..estim.batched import (pad_panel_to_n, pad_panel_to_t, pad_params_to_k,
+                             pad_params_to_n, stack_params, unstack_params)
+from ..estim.em import EMConfig, noise_floor_for
+from ..obs.trace import shape_key
+from ..ops.precision import accum_dtype
+from ..serve.batched import FleetOptions
+from ..ssm.params import SSMParams as JaxParams
+from ..utils.data import build_mask
+
+__all__ = ["TenantSlot", "FleetBucket"]
+
+
+@dataclasses.dataclass
+class TenantSlot:
+    """Host record of one fleet tenant (see module docstring)."""
+
+    name: str
+    lane: int                  # index along the bucket's batch axis
+    N: int
+    k: int
+    t: int                     # live panel length (rows so far)
+    capacity: int              # this tenant's own row budget (<= T_cap)
+    max_iters: int
+    tol: float
+    std: object                # frozen Standardizer (or None)
+    model: object              # DynamicFactorModel
+    Y_orig: np.ndarray         # (t, N) live panel, ORIGINAL units, NaNs
+    W_orig: np.ndarray         # (t, N) {0,1} observation mask
+    quarantined: bool = False
+    div_run: int = 0           # consecutive diverged ticks (escalation)
+    n_queries: int = 0
+    evicted: Optional[object] = None   # lone NowcastSession after eviction
+
+    def append_orig(self, rows: np.ndarray, W_rows: np.ndarray):
+        """Track an accepted update in original units (eviction seed)."""
+        self.Y_orig = np.concatenate([self.Y_orig, rows], axis=0)
+        self.W_orig = np.concatenate([self.W_orig, W_rows], axis=0)
+        self.t += rows.shape[0]
+
+
+class FleetBucket:
+    """One capacity class: B tenants resident in batched device buffers.
+
+    ``entries`` is a list of ``(name, res, Y, mask, capacity, max_iters,
+    tol)`` tuples; ``dims = (T_cap, N_max, k_max)`` the class shape every
+    member is padded to.  ``pad_lanes`` appends that many FILLER lanes
+    (copies of lane 0, permanently ``tick_act=False``) so the batch axis
+    divides a mesh — value-inert by the freeze algebra.
+    """
+
+    def __init__(self, entries, dims, *, r_max: int, backend, opts,
+                 pad_lanes: int = 0):
+        T_cap, N_max, k_max = dims
+        self.dims = dims
+        self.r_max = int(r_max)
+        self.opts = opts
+        self.backend = backend
+        self.dt = backend._dtype()
+        self.acc = accum_dtype(self.dt)
+        self.slots: List[TenantSlot] = []
+        Yh, Wh, ps = [], [], []
+        est = None
+        for lane, (name, res, Y, mask, cap, m_it, tol) in enumerate(entries):
+            Y = np.asarray(Y, dtype=np.float64)
+            T0, N = Y.shape
+            W = build_mask(Y, mask)
+            std = res.standardizer
+            Yz = std.transform(Y) if std is not None else Y
+            Yz = np.where(W > 0, np.nan_to_num(Yz), 0.0)
+            Yh.append(pad_panel_to_t(pad_panel_to_n(Yz, N_max), T_cap))
+            Wh.append(pad_panel_to_t(pad_panel_to_n(W, N_max), T_cap))
+            k = res.params.Lam.shape[1]
+            ps.append(pad_params_to_n(pad_params_to_k(res.params, k_max),
+                                      N_max))
+            m = res.model
+            e = (m.estimate_A, m.estimate_Q, m.estimate_init)
+            if est is None:
+                est = e
+            elif e != est:   # admission groups by config; belt-and-braces
+                raise ValueError(
+                    f"tenant {name!r} has estimation flags {e} but the "
+                    f"bucket was planned for {est}")
+            self.slots.append(TenantSlot(
+                name=name, lane=lane, N=N, k=k, t=T0, capacity=int(cap),
+                max_iters=int(m_it), tol=float(tol), std=std, model=m,
+                Y_orig=Y.copy(), W_orig=W.copy()))
+        for _ in range(int(pad_lanes)):     # frozen mesh-filler lanes
+            Yh.append(Yh[0].copy())
+            Wh.append(Wh[0].copy())
+            ps.append(ps[0])
+        self.B = len(Yh)
+        self.Yhost = np.stack(Yh).astype(np.float64)
+        self.Whost = np.stack(Wh).astype(np.float64)
+        self.p_host = ps                      # padded cpu_ref params, f64
+        # One static iteration cap per bucket (the scan length — per-lane
+        # budgets ride the traced iter_cap vector below it).
+        self.max_iters = max(s.max_iters for s in self.slots)
+        self.cfg = EMConfig(estimate_A=est[0], estimate_Q=est[1],
+                            estimate_init=est[2], filter="info", debug=False)
+        with backend._precision_ctx():
+            self.Ybuf = jnp.asarray(self.Yhost, self.dt)
+            self.Wbuf = jnp.asarray(self.Whost, self.dt)
+            self.p = stack_params(self.p_host, dtype=self.dt)
+        self.key = shape_key(self.Ybuf, "info", f"rows{self.r_max}",
+                             f"max{self.max_iters}", f"fleetB{self.B}")
+        self.n_ticks = 0
+
+    # -- per-tick traced vectors ---------------------------------------
+    def floor_for(self, slot: TenantSlot, t_new: int) -> float:
+        """Per-tenant ABSOLUTE loglik noise floor at the TRUE live size —
+        the exact float the same tenant's lone session would compute."""
+        return float(noise_floor_for(self.dt, t_new * slot.N,
+                                     mult=self.cfg.noise_floor_mult))
+
+    # -- self-healing --------------------------------------------------
+    def redeploy(self):
+        """Rebuild device state from the host shadows (donated-retry
+        path: a failed donated dispatch consumed the buffers).  The
+        shadows hold the exact f64 values originally uploaded, so the
+        cast reproduces the device state bit-for-bit."""
+        with self.backend._precision_ctx():
+            self.Ybuf = jnp.asarray(self.Yhost, self.dt)
+            self.Wbuf = jnp.asarray(self.Whost, self.dt)
+            self.p = stack_params(self.p_host, dtype=self.dt)
+
+    def rebind(self, out):
+        """Adopt a tick's output buffers as the resident state."""
+        self.Ybuf, self.Wbuf = out["Ybuf"], out["Wbuf"]
+        self.p = out["p"]
+
+    def params_host(self, out_p: Optional[JaxParams] = None):
+        """Per-lane padded cpu_ref params from a (possibly fresh) stacked
+        pytree — one small d2h when reading the resident params."""
+        return unstack_params(out_p if out_p is not None else self.p)
+
+    def __repr__(self):
+        T, N, k = self.dims
+        return (f"FleetBucket(B={self.B}, T_cap={T}, N_max={N}, "
+                f"k_max={k}, {len(self.slots)} tenants)")
+
+
+# Re-exported for driver convenience (the jitted statics live with the
+# core in serve/batched.py).
+_ = FleetOptions

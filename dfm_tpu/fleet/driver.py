@@ -1,0 +1,619 @@
+"""Fleet driver: batched session multiplexing over shape-bucketed tenants.
+
+``open_fleet(results, panels)`` packs B fitted tenants into capacity
+classes (``admission.plan_admission`` — the calibrated cost-model DP) and
+keeps every class device-resident in one ``FleetBucket``; ``submit``
+enqueues per-tenant ragged row updates (host-side validation only) and
+``drain`` serves the queue in TICKS: one fused batched ``serve_update``
+program per bucket per tick answers every member's queued query — ragged
+scatter-append, per-tenant warm EM with independent freezes, RTS smooth,
+nowcast + forecasts — with at most ONE blocking d2h per tick and ONE
+executable per bucket shape for the fleet's lifetime (active set, row
+counts and live lengths are traced vectors).
+
+Per-tenant answers are the lone session's: lane b of a tick pins to the
+same tenant's ``NowcastSession.update`` at the same budget
+(tests/test_fleet.py).  Tenants with no query this tick are frozen
+bit-inert; a tick with Q active tenants costs the same dispatch as one.
+
+Self-healing mirrors the serving stack (PR 10): every tick runs under
+``robust.dispatch.guarded_dispatch`` with the tenant fan-out (a bucket
+dispatch failure is every member's failure), donated-retry rebuilds from
+host shadows, and per-tenant quarantine — a tenant diverging more than
+``policy.chunk_retries`` consecutive ticks is EVICTED to a lone guarded
+``NowcastSession`` rebuilt from its host state (params + original-units
+live panel), its lane frozen, its future queries routed to the lone
+session; bucket-mates never stall and their trajectories are untouched
+(no op crosses the batch axis).  A tick exhausting dispatch retries
+quarantines the whole bucket the same way, from last-good shadows.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+import warnings
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..estim.batched import (CONVERGED, DIVERGED, slice_params_to_k,
+                             slice_params_to_n)
+from ..obs.trace import current_tracer
+from ..robust.dispatch import guarded_dispatch
+from ..robust.health import FitHealth, HealthEvent
+from ..serve.batched import (FleetOptions, _fleet_impl, _fleet_impl_donated,
+                             fleet_impl_sharded)
+from ..serve.session import NowcastSession, SessionUpdate
+from ..utils.data import build_mask
+from .admission import fleet_pad_waste, plan_admission
+from .buffers import FleetBucket
+
+__all__ = ["SessionFleet", "open_fleet"]
+
+_FLEET_IDS = itertools.count(1)
+
+
+class _Query:
+    """One queued tenant update (host units, validated at submit)."""
+
+    __slots__ = ("tenant", "rows", "W_rows", "rz", "n_new", "t_submit",
+                 "seq")
+
+    def __init__(self, tenant, rows, W_rows, rz, n_new, seq):
+        self.tenant = tenant
+        self.rows = rows            # (n, N) original units, NaNs kept
+        self.W_rows = W_rows        # (n, N) {0,1}
+        self.rz = rz                # (n, N) standardized, zero-filled
+        self.n_new = n_new
+        self.seq = seq
+        self.t_submit = time.perf_counter()
+
+
+def _per_tenant(value, B, name, cast):
+    """Broadcast a scalar knob or validate a per-tenant sequence."""
+    if value is None or np.isscalar(value):
+        return [value] * B
+    vals = [cast(x) for x in value]
+    if len(vals) != B:
+        raise ValueError(f"{name} must be a scalar or one value per "
+                         f"tenant; got {len(vals)} for {B} tenants")
+    return vals
+
+
+class SessionFleet:
+    """Batched multi-tenant serving fleet (see module docstring).
+
+    Open via :func:`open_fleet`; then ``submit(tenant, rows)`` enqueues
+    and ``drain()`` serves the whole queue, returning per-tenant
+    ``SessionUpdate`` lists in submit order.
+    """
+
+    def __init__(self, results, panels, masks=None, *,
+                 tenants: Optional[Sequence[str]] = None,
+                 capacity=None, max_update_rows: int = 8, max_iters=5,
+                 tol=1e-6, horizon: Optional[int] = None,
+                 di: Optional[bool] = None, backend=None, robust=None,
+                 max_classes: int = 3, runs: Optional[str] = None):
+        from ..api import (CPUBackend, DynamicFactorModel, FitResult,
+                           ShardedBackend, _resolve_policy, get_backend)
+        results = list(results)
+        panels = list(panels)
+        B = len(results)
+        if B == 0:
+            raise ValueError("open_fleet needs at least one tenant")
+        if len(panels) != B:
+            raise ValueError(
+                f"{B} results but {len(panels)} panels")
+        masks = [None] * B if masks is None else list(masks)
+        if len(masks) != B:
+            raise ValueError(f"{B} results but {len(masks)} masks")
+        names = ([f"t{i}" for i in range(B)] if tenants is None
+                 else [str(t) for t in tenants])
+        if len(names) != B or len(set(names)) != B:
+            raise ValueError("tenants must be one UNIQUE name per tenant")
+        b = get_backend(backend if backend is not None else "tpu")
+        if isinstance(b, CPUBackend) or not hasattr(b, "_fused_panel"):
+            raise ValueError(
+                f"backend {b.name!r} has no fused device programs; "
+                "fleets need a JAX backend (backend=\"tpu\"/\"sharded\" "
+                "or a TPUBackend instance)")
+        self._opts = FleetOptions(
+            horizon=1 if horizon is None else max(1, int(horizon)),
+            di=True if di is None else bool(di))
+        caps = _per_tenant(capacity, B, "capacity", int)
+        m_its = _per_tenant(max_iters, B, "max_iters", int)
+        tols = _per_tenant(tol, B, "tol", float)
+        shapes, cfg_keys, entries = [], [], []
+        for i, (res, Y) in enumerate(zip(results, panels)):
+            if not isinstance(res, FitResult):
+                raise TypeError(
+                    f"tenant {names[i]!r}: open_fleet needs FitResults; "
+                    f"got {type(res).__name__}")
+            if not isinstance(res.model, DynamicFactorModel):
+                raise TypeError(
+                    f"tenant {names[i]!r}: fleets support "
+                    f"DynamicFactorModel fits only; got "
+                    f"{type(res.model).__name__}")
+            Y = np.asarray(Y, dtype=np.float64)
+            if Y.ndim != 2:
+                raise ValueError(
+                    f"tenant {names[i]!r}: Y must be (T, N); got shape "
+                    f"{Y.shape}")
+            T0, N = Y.shape
+            Lam = np.asarray(res.params.Lam)
+            if Lam.shape[0] != N:
+                raise ValueError(
+                    f"tenant {names[i]!r}: params are for "
+                    f"N={Lam.shape[0]} series but the panel has N={N}")
+            if T0 < self._opts.horizon + 3:
+                raise ValueError(
+                    f"tenant {names[i]!r}: needs T >= horizon + 3 = "
+                    f"{self._opts.horizon + 3} live rows; got T={T0}")
+            cap = 2 * T0 if caps[i] is None else int(caps[i])
+            if cap < T0:
+                raise ValueError(
+                    f"tenant {names[i]!r}: capacity={cap} < panel "
+                    f"length T={T0}")
+            m_it = max(1, 5 if m_its[i] is None else int(m_its[i]))
+            tl = 1e-6 if tols[i] is None else float(tols[i])
+            k = Lam.shape[1]
+            shapes.append((cap, N, k))
+            m = res.model
+            cfg_keys.append((m.estimate_A, m.estimate_Q, m.estimate_init))
+            entries.append((names[i], res, Y, masks[i], cap, m_it, tl))
+        self._iters = [e[5] for e in entries]
+        classes = plan_admission(shapes, self._iters, cfg_keys,
+                                 max_classes=max_classes, runs=runs)
+        self.pad_waste_frac = fleet_pad_waste(shapes, self._iters, classes)
+        self._sharded = isinstance(b, ShardedBackend)
+        self._mesh = None
+        mesh_d = 1
+        if self._sharded:
+            from ..parallel.batched import make_batch_mesh
+            self._mesh = make_batch_mesh(getattr(b, "n_devices", None))
+            mesh_d = self._mesh.devices.size
+        self._r_max = max(1, int(max_update_rows))
+        self._backend = b
+        self._buckets: List[FleetBucket] = []
+        self._slot_of = {}           # tenant -> (bucket, slot)
+        for ca in classes:
+            ents = [entries[i] for i in ca.members]
+            pad = (-len(ents)) % mesh_d
+            bk = FleetBucket(ents, ca.dims, r_max=self._r_max, backend=b,
+                             opts=self._opts, pad_lanes=pad)
+            self._buckets.append(bk)
+            for s in bk.slots:
+                self._slot_of[s.name] = (bk, s)
+        self._policy = _resolve_policy(
+            getattr(b, "robust", True) if robust is None else robust)
+        self.health = FitHealth(engine="fleet")
+        self._fid = f"f{next(_FLEET_IDS)}"
+        self._pending: List[_Query] = []
+        self._seq = itertools.count()
+        self._closed = False
+        self._n_ticks = 0
+        self._n_queries = 0
+
+    # -- introspection -------------------------------------------------
+    @property
+    def fleet_id(self) -> str:
+        return self._fid
+
+    @property
+    def tenants(self) -> List[str]:
+        return list(self._slot_of)
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self._buckets)
+
+    @property
+    def classes(self) -> List[dict]:
+        """The admission plan: padded dims + members per capacity class."""
+        return [{"dims": {"T": bk.dims[0], "N": bk.dims[1],
+                          "k": bk.dims[2]},
+                 "tenants": [s.name for s in bk.slots]}
+                for bk in self._buckets]
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def tenant_length(self, tenant: str) -> int:
+        """Live panel length of one tenant (accepted rows only)."""
+        _, slot = self._slot_of[tenant]
+        return slot.t
+
+    def quarantined(self) -> List[str]:
+        return [t for t, (_, s) in self._slot_of.items() if s.quarantined]
+
+    def _check_open(self):
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+
+    # -- the queue -----------------------------------------------------
+    def submit(self, tenant: str, rows=None, mask=None) -> int:
+        """Enqueue one tenant update ((n, N) or (N,) original-units rows,
+        NaN = missing; ``rows=None`` queues a pure re-forecast — warm EM
+        + smooth + forecast with no append).  All capacity/shape
+        validation happens here, against the PROJECTED live length (rows
+        already queued count) — an invalid submit raises without touching
+        the queue.  Returns the queue depth after the submit."""
+        self._check_open()
+        if tenant not in self._slot_of:
+            raise KeyError(f"unknown tenant {tenant!r} (fleet has "
+                           f"{sorted(self._slot_of)})")
+        _, slot = self._slot_of[tenant]
+        if rows is None:
+            if mask is not None:
+                raise ValueError("mask requires rows")
+            r = np.zeros((0, slot.N))
+            W_rows = np.zeros((0, slot.N))
+            rz = r
+        else:
+            r = np.asarray(rows, dtype=np.float64)
+            if r.ndim == 1:
+                r = r[None, :]
+            if r.ndim != 2 or r.shape[1] != slot.N:
+                raise ValueError(
+                    f"tenant {tenant!r}: rows must be (n, {slot.N}) or "
+                    f"({slot.N},); got shape {np.asarray(rows).shape}")
+            if r.shape[0] > self._r_max:
+                raise ValueError(
+                    f"tenant {tenant!r}: update has {r.shape[0]} rows "
+                    f"but the fleet was opened with max_update_rows="
+                    f"{self._r_max}")
+            W_rows = build_mask(r, mask)
+            rz = slot.std.transform(r) if slot.std is not None else r
+            rz = np.where(W_rows > 0, np.nan_to_num(rz), 0.0)
+        queued = sum(q.n_new for q in self._pending if q.tenant == tenant)
+        if slot.t + queued + r.shape[0] > slot.capacity:
+            raise ValueError(
+                f"tenant {tenant!r}: capacity overflow — holds {slot.t} "
+                f"rows (+{queued} queued) of {slot.capacity} and cannot "
+                f"take {r.shape[0]} more")
+        self._pending.append(_Query(tenant, r, W_rows, rz, r.shape[0],
+                                    next(self._seq)))
+        return len(self._pending)
+
+    def drain(self) -> Dict[str, List[SessionUpdate]]:
+        """Serve the whole queue: repeated TICKS (one fused dispatch per
+        bucket with work, each answering every member's next query) until
+        empty.  Returns per-tenant ``SessionUpdate`` lists in submit
+        order.  Quarantined tenants' queries route to their lone evicted
+        sessions (guarded there)."""
+        self._check_open()
+        out: Dict[str, List[SessionUpdate]] = {}
+        while self._pending:
+            # Evicted tenants first: their queries never wait on a tick.
+            still = []
+            for q in self._pending:
+                _, slot = self._slot_of[q.tenant]
+                if slot.quarantined:
+                    upd = self._serve_evicted(slot, q)
+                    out.setdefault(q.tenant, []).append(upd)
+                else:
+                    still.append(q)
+            self._pending = still
+            if not self._pending:
+                break
+            # One query per tenant per tick, FIFO.
+            picks: Dict[int, Dict[int, _Query]] = {}
+            taken = set()
+            for q in self._pending:
+                bk, slot = self._slot_of[q.tenant]
+                bi = self._buckets.index(bk)
+                if (bi, slot.lane) not in taken:
+                    picks.setdefault(bi, {})[slot.lane] = q
+                    taken.add((bi, slot.lane))
+            served = []
+            for bi, lane_q in picks.items():
+                for tenant, upd in self._tick(self._buckets[bi], lane_q):
+                    out.setdefault(tenant, []).append(upd)
+                served.extend(lane_q.values())
+            self._pending = [q for q in self._pending
+                             if q not in served]
+        return out
+
+    # -- the tick ------------------------------------------------------
+    def _tick(self, bucket: FleetBucket, lane_q: Dict[int, "_Query"]):
+        """One fused batched dispatch answering every picked lane."""
+        from ..robust.guard import GuardFailure
+        T_cap, N_max, _ = bucket.dims
+        B, r_max = bucket.B, bucket.r_max
+        rows_b = np.zeros((B, r_max, N_max))
+        rmask_b = np.zeros((B, r_max, N_max))
+        n_new = np.zeros(B, np.int32)
+        t_cur = np.zeros(B, np.int32)
+        tolv = np.zeros(B)
+        floorv = np.zeros(B)
+        capv = np.zeros(B, np.int32)
+        act = np.zeros(B, bool)
+        for lane in range(B):
+            # Mesh-filler lanes (lane >= len(slots)) carry lane 0's knobs:
+            # their buffers are lane-0 copies, so the zero-row scatter at
+            # slot 0's live length lands on pad (zeros over zeros).
+            slot = bucket.slots[lane if lane < len(bucket.slots) else 0]
+            t_cur[lane] = slot.t
+            tolv[lane] = slot.tol
+            capv[lane] = slot.max_iters
+            floorv[lane] = bucket.floor_for(slot, slot.t)
+        active = []
+        for lane, q in sorted(lane_q.items()):
+            slot = bucket.slots[lane]
+            rows_b[lane, :q.n_new, :slot.N] = q.rz
+            rmask_b[lane, :q.n_new, :slot.N] = q.W_rows
+            n_new[lane] = q.n_new
+            act[lane] = True
+            floorv[lane] = bucket.floor_for(slot, slot.t + q.n_new)
+            active.append(slot.name)
+        if self._sharded:
+            impl, donated = fleet_impl_sharded, False
+            kw = dict(cfg=bucket.cfg, max_iters=bucket.max_iters,
+                      opts=bucket.opts, mesh=self._mesh)
+        else:
+            donated = jax.default_backend() != "cpu"
+            impl = _fleet_impl_donated if donated else _fleet_impl
+            kw = dict(cfg=bucket.cfg, max_iters=bucket.max_iters,
+                      opts=bucket.opts)
+        pol = self._policy
+        tr = current_tracer()
+        acc, dt = bucket.acc, bucket.dt
+        t0 = time.perf_counter()
+        with self._backend._precision_ctx():
+            rows_j = jnp.asarray(rows_b, dt)
+            rmask_j = jnp.asarray(rmask_b, dt)
+            consts = (jnp.asarray(n_new), jnp.asarray(t_cur),
+                      jnp.asarray(tolv, acc), jnp.asarray(floorv, acc),
+                      jnp.asarray(capv), jnp.asarray(act))
+
+            def _once(attempt):
+                if attempt > 0 and donated:
+                    # The failed dispatch consumed the donated buffers;
+                    # rebuild from host shadows (one recovery h2d of the
+                    # exact original values).
+                    bucket.redeploy()
+                args = (bucket.Ybuf, bucket.Wbuf, rows_j, rmask_j,
+                        consts[0], consts[1], bucket.p, consts[2],
+                        consts[3], consts[4], consts[5])
+                if tr is None:
+                    o = impl(*args, **kw)
+                    return o, self._read(o, donated and pol is not None)
+                if attempt == 0:
+                    tr.maybe_cost("serve_update", bucket.key, impl, *args,
+                                  **kw)
+                extra = {"attempt": attempt} if pol is not None else {}
+                with tr.dispatch("serve_update", bucket.key, barrier=True,
+                                 fused=True, n_iters=bucket.max_iters,
+                                 batch=B, **extra) as rec:
+                    o = impl(*args, **kw)
+                    host = self._read(o, donated and pol is not None)
+                    if rec is not None:
+                        rec["n_iters"] = int(host["n_iters"].max())
+                return o, host
+
+            try:
+                if pol is None:
+                    out, host = _once(0)
+                else:
+                    out, host = guarded_dispatch(
+                        _once, pol, self.health, label="fleet tick",
+                        session=self._fid, tenants=active,
+                        iteration=self._n_ticks,
+                        last_good=lambda: bucket.p_host)
+            except GuardFailure as e:
+                # The bucket program cannot be dispatched: quarantine
+                # EVERY member from the last-good host shadows and serve
+                # this tick's queries on the lone evicted sessions.
+                warnings.warn(
+                    f"fleet bucket dispatch failed ({e}); quarantining "
+                    f"{len(bucket.slots)} tenants to lone sessions",
+                    RuntimeWarning, stacklevel=3)
+                results = []
+                for slot in bucket.slots:
+                    if not slot.quarantined:
+                        self._quarantine(
+                            bucket, slot, "bucket dispatch exhausted "
+                            "retries", p_pad=bucket.p_host[slot.lane])
+                for lane, q in sorted(lane_q.items()):
+                    slot = bucket.slots[lane]
+                    results.append(
+                        (slot.name, self._serve_evicted(slot, q)))
+                return results
+        wall = time.perf_counter() - t0
+        bucket.rebind(out)
+        if "p_list" in host:      # guarded donated path: last-good shadow
+            bucket.p_host = host["p_list"]
+        bucket.n_ticks += 1
+        self._n_ticks += 1
+        results = []
+        for lane, q in sorted(lane_q.items()):
+            slot = bucket.slots[lane]
+            t_new = slot.t + q.n_new
+            # Host shadows track the same append in numpy (standardized
+            # units, exactly what the device scatter landed).
+            bucket.Yhost[lane, slot.t:t_new, :slot.N] = q.rz
+            bucket.Whost[lane, slot.t:t_new, :slot.N] = q.W_rows
+            slot.append_orig(q.rows, q.W_rows)
+            slot.n_queries += 1
+            self._n_queries += 1
+            upd = self._lane_update(bucket, host, slot, t_new, wall)
+            diverged = int(host["status"][lane]) == DIVERGED
+            if diverged:
+                slot.div_run += 1
+                warnings.warn(
+                    f"fleet tenant {slot.name!r} diverged after "
+                    f"{int(host['good_it'][lane])} good iterations; "
+                    "kept the rolled-back params", RuntimeWarning,
+                    stacklevel=3)
+                if pol is not None:
+                    self.health.record(HealthEvent(
+                        chunk=-1, iteration=slot.t, kind="divergence",
+                        action="restored", tenant=slot.name,
+                        session=self._fid,
+                        detail=(f"tick update diverged after "
+                                f"{int(host['good_it'][lane])} good "
+                                "iterations; kept rolled-back params")))
+                    if slot.div_run > pol.chunk_retries:
+                        self._quarantine(
+                            bucket, slot,
+                            f"{slot.div_run} consecutive diverged ticks",
+                            p_pad=(host["p_list"][lane]
+                                   if "p_list" in host else None))
+            else:
+                slot.div_run = 0
+            if tr is not None:
+                degraded = bool(diverged or slot.quarantined)
+                tr.emit("query", session=self._fid, tenant=slot.name,
+                        t_rows=int(t_new), n_new=int(q.n_new), wall=wall,
+                        queue_wait=max(0.0, t0 - q.t_submit),
+                        n_iters=int(host["n_iters"][lane]),
+                        converged=bool(int(host["status"][lane])
+                                       == CONVERGED),
+                        diverged=diverged,
+                        **({"degraded": True} if degraded else {}))
+            results.append((slot.name, upd))
+        if tr is not None:
+            tr.emit("tick", session=self._fid,
+                    bucket=self._buckets.index(bucket), batch=B,
+                    n_active=len(lane_q), wall=wall,
+                    n_tenants=len(bucket.slots))
+        return results
+
+    def _read(self, out, want_params: bool = False):
+        """Materialize the host-bound outputs inside the dispatch span
+        (one blocking d2h per tick).  ``want_params`` (guarded donated
+        path) also reads the resulting stacked params so the last-good
+        host shadow stays current for donated-retry rebuilds."""
+        host = {
+            "status": np.asarray(out["status"], np.int32),
+            "n_iters": np.asarray(out["n_iters"], np.int32),
+            "good_it": np.asarray(out["good_it"], np.int32),
+            "lls": np.asarray(out["lls"], np.float64),
+            "nowcast": np.asarray(out["nowcast"], np.float64),
+            "f_fore": np.asarray(out["f_fore"], np.float64),
+            "y_fore": np.asarray(out["y_fore"], np.float64),
+            "di": (np.asarray(out["di"], np.float64)
+                   if out["di"] is not None else None),
+            "x_sm": np.asarray(out["x_sm"], np.float64),
+            "P_sm": np.asarray(out["P_sm"], np.float64),
+        }
+        if want_params:
+            from ..estim.batched import unstack_params
+            host["p_list"] = unstack_params(out["p"])
+        return host
+
+    def _lane_update(self, bucket, host, slot, t_new, wall):
+        """Slice lane ``slot.lane`` out of the tick's host outputs and
+        destandardize — the fleet's ``SessionUpdate`` for this tenant."""
+        ln, N, k = slot.lane, slot.N, slot.k
+        inv = (slot.std.inverse if slot.std is not None else (lambda a: a))
+        n = min(int(host["n_iters"][ln]), slot.max_iters)
+        di = host["di"]
+        return SessionUpdate(
+            nowcast=np.asarray(inv(host["nowcast"][ln][:N])),
+            forecasts={
+                "y": np.asarray(inv(host["y_fore"][ln][:, :N])),
+                "f": host["f_fore"][ln][:, :k],
+                "di": (np.asarray(inv(di[ln][:N]))
+                       if di is not None else None)},
+            logliks=host["lls"][ln][:n],
+            n_iters=n,
+            converged=bool(int(host["status"][ln]) == CONVERGED),
+            diverged=bool(int(host["status"][ln]) == DIVERGED),
+            factors=host["x_sm"][ln][:t_new, :k],
+            factor_cov=host["P_sm"][ln][:t_new, :k, :k],
+            t=t_new,
+            wall_s=wall)
+
+    # -- quarantine / eviction -----------------------------------------
+    def _quarantine(self, bucket, slot, reason: str, p_pad=None):
+        """Evict one tenant to a lone guarded ``NowcastSession`` rebuilt
+        from its host state and freeze its lane forever.  Bucket-mates
+        are untouched (the frozen lane is value-inert by construction)."""
+        from ..api import FitResult
+        if p_pad is None:
+            p_pad = bucket.params_host()[slot.lane]
+        p = slice_params_to_n(slice_params_to_k(p_pad, slot.k), slot.N)
+        res = FitResult(
+            params=p, logliks=np.zeros(0),
+            factors=np.zeros((0, slot.k)),
+            factor_cov=np.zeros((0, slot.k, slot.k)),
+            converged=False, n_iters=0, standardizer=slot.std,
+            model=slot.model, backend=self._backend.name, history=[])
+        sess = NowcastSession(
+            res, slot.Y_orig, slot.W_orig,
+            capacity=slot.capacity, max_update_rows=self._r_max,
+            max_iters=slot.max_iters, tol=slot.tol,
+            horizon=self._opts.horizon, di=self._opts.di,
+            backend=self._backend, robust=self._policy)
+        slot.evicted = sess
+        slot.quarantined = True
+        slot.div_run = 0
+        self.health.record(HealthEvent(
+            chunk=-1, iteration=slot.t, kind="quarantine",
+            action="evicted", tenant=slot.name, session=self._fid,
+            detail=(f"{reason}; evicted to lone session "
+                    f"{sess.session_id}")))
+        warnings.warn(
+            f"fleet tenant {slot.name!r} quarantined ({reason}); future "
+            f"queries route to lone session {sess.session_id}",
+            RuntimeWarning, stacklevel=3)
+
+    def _serve_evicted(self, slot, q: "_Query") -> SessionUpdate:
+        """Route one queued query to the tenant's lone evicted session."""
+        slot.n_queries += 1
+        self._n_queries += 1
+        if q.n_new == 0:
+            return slot.evicted.update(None)
+        upd = slot.evicted.update(q.rows, mask=q.W_rows)
+        slot.append_orig(q.rows, q.W_rows)
+        return upd
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self):
+        """Release the device buffers; further submits/drains raise."""
+        for bk in self._buckets:
+            bk.Ybuf = bk.Wbuf = bk.p = None
+            bk.Yhost = bk.Whost = None
+            bk.p_host = None
+        for _, slot in self._slot_of.values():
+            if slot.evicted is not None:
+                slot.evicted.close()
+        self._pending = []
+        self._closed = True
+
+    def __repr__(self):
+        state = "closed" if self._closed else (
+            f"{len(self._slot_of)} tenants / {len(self._buckets)} "
+            f"buckets, {self._n_queries} queries, "
+            f"{len(self._pending)} pending")
+        return f"SessionFleet({self._fid}, {state})"
+
+
+def open_fleet(results, panels, masks=None, **kwargs) -> SessionFleet:
+    """Open a batched serving fleet over B fitted tenants.
+
+    results : per-tenant ``FitResult`` of a ``DynamicFactorModel`` fit.
+    panels  : per-tenant (T, N) panels the models were fitted on
+              (original units; NaNs = missing), ``masks`` as in ``fit``.
+    tenants : unique names (default ``t0..t{B-1}``).
+    capacity        : per-tenant row budget, scalar or sequence
+                      (default 2*T per tenant).
+    max_update_rows : largest per-query row count (default 8) — one
+                      executable per bucket serves every count up to it.
+    max_iters / tol : per-tenant warm EM budget per query (scalar or
+                      sequence; default 5 / 1e-6).
+    horizon / di    : forecast steps and diffusion-index toggle.
+    backend         : "tpu" (default), "sharded" (bucket batch axes
+                      split over the mesh), or a TPUBackend instance.
+    robust          : ``RobustPolicy`` / True / False — the tick guard +
+                      quarantine; default inherits the backend's policy.
+    max_classes     : capacity-class budget for admission control.
+    runs            : profile registry for the admission cost model
+                      (default: ambient ``DFM_RUNS`` / ``.dfm_runs``).
+    """
+    return SessionFleet(results, panels, masks, **kwargs)

@@ -22,7 +22,8 @@ import json
 import sys
 from typing import List, Optional
 
-__all__ = ["advise", "advise_jobs", "candidate_plans", "main"]
+__all__ = ["advise", "advise_fleet", "advise_jobs", "candidate_plans",
+           "main"]
 
 
 def candidate_plans(chunk: int = 8) -> List[dict]:
@@ -129,6 +130,59 @@ def advise_jobs(shapes, *, max_iters: int = 50, chunk: int = 8,
             "model": model.to_dict()}
 
 
+def advise_fleet(shapes, *, tick_iters: int = 5,
+                 runs: Optional[str] = None,
+                 device: Optional[str] = None) -> dict:
+    """Rank capacity-CLASS layouts for a serving fleet (the
+    ``fleet.open_fleet`` admission problem — see
+    ``sched.plan_capacity_classes``): each class is one resident batched
+    buffer costing ONE fused ``serve_update`` dispatch per tick, so the
+    sweep trades per-tick padded-EM waste against extra executables +
+    dispatches.  ``shapes`` is a list of per-tenant (N, T_capacity, k)
+    triples; ``tick_iters`` the per-tick warm-EM budget.  Deterministic
+    given a fixed profile registry: ties prefer fewer classes, then the
+    smaller class-dims tuple."""
+    from ..sched.buckets import plan_capacity_classes
+    from .cost import fit_cost_model
+    from .store import RunStore, runs_dir
+
+    d = runs_dir(runs)
+    profiles: List[dict] = []
+    if d is not None:
+        profiles = [r for r in RunStore(d).load()
+                    if r.get("kind") == "profile"]
+    model = fit_cost_model(profiles, device=device)
+
+    tnk = [(int(T), int(N), int(k)) for (N, T, k) in shapes]
+    iters = [int(tick_iters)] * len(tnk)
+    layouts, seen = [], set()
+    for mc in range(1, min(len(tnk), 4) + 1):
+        plan = plan_capacity_classes(tnk, iters, max_classes=mc,
+                                     model=model)
+        sig = tuple(sorted((b.dims, b.jobs) for b in plan.buckets))
+        if sig in seen:     # a larger budget the DP declined to use
+            continue
+        seen.add(sig)
+        layouts.append({
+            "max_classes": mc, "n_classes": len(plan.buckets),
+            "classes": [{"dims": {"T": b.dims[0], "N": b.dims[1],
+                                  "k": b.dims[2]},
+                         "tenants": list(b.jobs)}
+                        for b in plan.buckets],
+            "pad_waste_frac": plan.pad_waste_frac,
+            "predicted_tick_wall_s": plan.predicted_wall_s})
+    layouts.sort(key=lambda l: (l["predicted_tick_wall_s"], l["n_classes"],
+                                tuple(tuple(c["dims"].values())
+                                      for c in l["classes"])))
+    for i, l in enumerate(layouts):
+        l["rank"] = i + 1
+    return {"tenants": [{"N": N, "T": T, "k": k} for (N, T, k) in shapes],
+            "tick_iters": int(tick_iters), "device": model.device,
+            "calibrated": model.calibrated,
+            "n_profiles": model.n_profiles, "layouts": layouts,
+            "model": model.to_dict()}
+
+
 def _parse_jobs(spec: str):
     """``N,T,K[xC]`` triples joined by ``;`` — e.g. ``20,60,2;26,80,2x3``
     is one (20, 60, 2) job plus three (26, 80, 2) jobs."""
@@ -166,9 +220,15 @@ def main(argv=None) -> int:
                       help="rank bucket layouts for a mixed-shape job mix "
                            "(the sched.submit planning problem) instead of "
                            "single-fit plans")
+    what.add_argument("--fleet", metavar="N,T,K[xC];...",
+                      help="rank serving capacity-class layouts for a "
+                           "tenant mix (T = per-tenant panel capacity; "
+                           "the fleet.open_fleet admission problem)")
     ap.add_argument("--max-iters", type=int, default=50)
     ap.add_argument("--chunk", type=int, default=8,
                     help="base fused_chunk for the plan grid")
+    ap.add_argument("--tick-iters", type=int, default=5,
+                    help="per-tick warm-EM budget for --fleet layouts")
     ap.add_argument("--runs", default=None,
                     help="registry dir (default: DFM_RUNS or .dfm_runs)")
     ap.add_argument("--device", default=None,
@@ -176,6 +236,39 @@ def main(argv=None) -> int:
                          "default: the latest profile's)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+    if args.fleet is not None:
+        try:
+            shapes = _parse_jobs(args.fleet)
+        except ValueError:
+            print(f"error: --fleet wants N,T,K[xC] triples joined by "
+                  f"';', got {args.fleet!r}", file=sys.stderr)
+            return 2
+        res = advise_fleet(shapes, tick_iters=args.tick_iters,
+                           runs=args.runs, device=args.device)
+        if not res["calibrated"]:
+            big = max(shapes)
+            print("warning: no profile records in the registry — "
+                  "predictions use device priors only; run `python -m "
+                  "dfm_tpu.obs.profile --shape "
+                  f"{big[0]},{big[1]},{big[2]}` to calibrate",
+                  file=sys.stderr)
+        if args.json:
+            json.dump(res, sys.stdout, indent=2, default=str)
+            print()
+            return 0
+        cal = ("calibrated from %d profile(s)" % res["n_profiles"]
+               if res["calibrated"] else "PRIORS ONLY")
+        print(f"advise fleet of {len(res['tenants'])} tenants "
+              f"tick_iters={res['tick_iters']} [{res['device']}, {cal}]")
+        for l in res["layouts"]:
+            dims = " + ".join(
+                f"({c['dims']['T']},{c['dims']['N']},{c['dims']['k']})"
+                f"x{len(c['tenants'])}" for c in l["classes"])
+            print(f"  #{l['rank']}: {l['n_classes']} class"
+                  f"{'es' if l['n_classes'] != 1 else ''} {dims:40s} "
+                  f"predicted tick {l['predicted_tick_wall_s']:.3f}s, "
+                  f"pad waste {100 * l['pad_waste_frac']:.1f}%")
+        return 0
     if args.jobs is not None:
         try:
             shapes = _parse_jobs(args.jobs)

@@ -314,6 +314,53 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
                 and e.get("recompile")),
             "per_session": per_session,
         }
+    # Fleet serving (fleet.SessionFleet): one event per drained tick with
+    # the bucket's occupancy (active lanes / batch width), plus queue-wait
+    # accounting on the per-tenant query events.  Queries-per-dispatch is
+    # the multiplexing win itself: how many tenant answers each fused
+    # batched serve_update dispatch produced.
+    ticks = [{k: v for k, v in e.items() if k != "kind"}
+             for e in events if e.get("kind") == "tick"]
+    if ticks:
+        occ = [float(t["n_active"]) / float(t["batch"]) for t in ticks
+               if isinstance(t.get("n_active"), (int, float))
+               and t.get("batch")]
+        tick_walls = [float(t["wall"]) for t in ticks
+                      if isinstance(t.get("wall"), (int, float))]
+        fleet_q = [q for q in queries if q.get("queue_wait") is not None]
+        per_tenant_q: dict = {}
+        for q in fleet_q:
+            pt = per_tenant_q.setdefault(str(q.get("tenant", "?")),
+                                         {"queries": 0, "waits": []})
+            pt["queries"] += 1
+            if isinstance(q.get("queue_wait"), (int, float)):
+                pt["waits"].append(float(q["queue_wait"]))
+        for pt in per_tenant_q.values():
+            st = _stats(pt.pop("waits"))
+            if st:
+                pt["queue_wait_s"] = st
+        per_bucket: dict = {}
+        for t in ticks:
+            bid = str(t.get("bucket", "?"))
+            pb = per_bucket.setdefault(bid, {"ticks": 0, "occ": []})
+            pb["ticks"] += 1
+            if (isinstance(t.get("n_active"), (int, float))
+                    and t.get("batch")):
+                pb["occ"].append(float(t["n_active"]) / float(t["batch"]))
+        for pb in per_bucket.values():
+            os_ = pb.pop("occ")
+            if os_:
+                pb["occupancy_mean"] = sum(os_) / len(os_)
+        out["fleet"] = {
+            "n_ticks": len(ticks),
+            "n_buckets": len(per_bucket),
+            "n_queries": len(fleet_q),
+            "queries_per_dispatch": len(fleet_q) / len(ticks),
+            "occupancy_mean": (sum(occ) / len(occ)) if occ else None,
+            "tick_wall_s": _stats(tick_walls),
+            "per_bucket": per_bucket,
+            "per_tenant": per_tenant_q,
+        }
     # Serving-grade fault tolerance (robust.dispatch / sched quarantine /
     # self-healing sessions): the guard's forensic trail aggregated next
     # to the fairness/queries tables — retries + backoff paid, tenants
@@ -548,6 +595,33 @@ def _print_text(s: dict) -> None:
             if pw:
                 bits.append(f"wall p50 {_fmt_s(pw['p50'])} / "
                             f"p99 {_fmt_s(pw['p99'])}")
+            print(", ".join(bits))
+    fl = s.get("fleet")
+    if fl:
+        tw = fl.get("tick_wall_s") or {}
+        line = (f"fleet: {fl['n_queries']} queries over {fl['n_ticks']} "
+                f"tick{'s' if fl['n_ticks'] != 1 else ''} in "
+                f"{fl['n_buckets']} bucket{'s' if fl['n_buckets'] != 1 else ''}"
+                f" ({fl['queries_per_dispatch']:.2f} queries/dispatch)")
+        if isinstance(fl.get("occupancy_mean"), (int, float)):
+            line += f"; mean occupancy {100 * fl['occupancy_mean']:.0f}%"
+        if tw:
+            line += (f"; tick wall p50 {_fmt_s(tw['p50'])} / "
+                     f"p99 {_fmt_s(tw['p99'])}")
+        print(line)
+        for bid, pb in fl.get("per_bucket", {}).items():
+            bits = [f"  bucket {bid}: {pb['ticks']} "
+                    f"tick{'s' if pb['ticks'] != 1 else ''}"]
+            if isinstance(pb.get("occupancy_mean"), (int, float)):
+                bits.append(f"occupancy {100 * pb['occupancy_mean']:.0f}%")
+            print(", ".join(bits))
+        for tid, pt in fl.get("per_tenant", {}).items():
+            bits = [f"  {tid:12s} {pt['queries']} "
+                    f"quer{'ies' if pt['queries'] != 1 else 'y'}"]
+            qw = pt.get("queue_wait_s") or {}
+            if qw:
+                bits.append(f"queue wait p50 {_fmt_s(qw['p50'])} / "
+                            f"p99 {_fmt_s(qw['p99'])}")
             print(", ".join(bits))
     a = s.get("advice")
     if a:

@@ -265,6 +265,10 @@ _BENCH_NUMERIC_KEYS = (
     "scheduler_overhead_ms",
     "serve_p50_ms", "serve_p99_ms", "serve_blocking_transfers_per_query",
     "serve_degraded_queries",
+    # Fleet serving (bench.fleet): aggregate queries/sec is the headline
+    # (higher-is-better, no floor); the p99 latency and the admission
+    # plan's pad waste ride the "_ms" / "pad_waste" marker rows above.
+    "fleet_qps", "fleet_p99_ms", "fleet_pad_waste_frac",
 )
 
 

@@ -32,6 +32,7 @@ dispatch counts byte-identical.
 from __future__ import annotations
 
 import contextvars
+import dataclasses
 import threading
 import time
 from typing import Callable, Optional, Sequence
@@ -79,8 +80,8 @@ def _call_with_deadline(fn: Callable[[], object],
 def guarded_dispatch(call: Callable[[int], object], policy,
                      health: Optional[FitHealth] = None, *,
                      label: str = "dispatch", tenant: str = "",
-                     session: str = "", chunk: int = -1,
-                     iteration: int = 0, last_good=None,
+                     tenants: Sequence[str] = (), session: str = "",
+                     chunk: int = -1, iteration: int = 0, last_good=None,
                      lls: Sequence[float] = (), p_iters: int = 0):
     """Run ``call(attempt)`` under ``policy``'s retry/backoff/watchdog.
 
@@ -92,9 +93,18 @@ def guarded_dispatch(call: Callable[[int], object], policy,
     whose payload carries ``last_good`` (called first if callable — the
     site's cheapest route to host params), ``lls`` and ``p_iters`` so
     ``on_failure="cpu"`` degradation can resume from the last good state.
+
+    ``tenants`` (fleet buckets): ONE dispatch serves many tenants, so a
+    dispatch failure is every bucket member's failure — each retry/abort
+    event is emitted once to the trace and then fanned out to the health
+    record per tenant (``emit=False`` replays, the batched engine's
+    convention), keeping per-tenant accountability for a shared program.
+    Mutually exclusive with the singular ``tenant``.
     """
     if policy is None:
         return call(0)
+    if tenant and tenants:
+        raise ValueError("pass tenant= or tenants=, not both")
     from .guard import GuardFailure
     run = call if policy.wrap_dispatch is None else policy.wrap_dispatch(call)
     h = health if health is not None else FitHealth()
@@ -109,15 +119,20 @@ def guarded_dispatch(call: Callable[[int], object], policy,
                 raise
             h.n_dispatch_retries += 1
             last = attempt >= policy.dispatch_retries
-            h.record(HealthEvent(
+            ev = HealthEvent(
                 chunk=chunk, iteration=iteration, kind="dispatch_error",
                 detail=f"{type(e).__name__}: {e}"[:200],
                 action="abort" if last else "retried",
-                tenant=tenant, session=session,
-                backoff_s=0.0 if last else float(delay)))
+                tenant=tenants[0] if tenants else tenant, session=session,
+                backoff_s=0.0 if last else float(delay))
+            h.record(ev)
+            for t in tenants[1:]:
+                h.record(dataclasses.replace(ev, tenant=t), emit=False)
             if last:
                 scope = ""
-                if tenant:
+                if tenants:
+                    scope += f" (tenants {', '.join(tenants)})"
+                elif tenant:
                     scope += f" (tenant {tenant})"
                 if session:
                     scope += f" (session {session})"

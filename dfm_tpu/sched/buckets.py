@@ -27,7 +27,7 @@ from typing import List, Optional, Sequence, Tuple
 
 from ..obs.cost import CostModel, DEFAULT_COEFFS, em_iter_work
 
-__all__ = ["Bucket", "BucketPlan", "plan_buckets"]
+__all__ = ["Bucket", "BucketPlan", "plan_buckets", "plan_capacity_classes"]
 
 
 @dataclass(frozen=True)
@@ -161,3 +161,29 @@ def plan_buckets(shapes: Sequence[Tuple[int, int, int]],
         job_waste[ji] = 1.0 - f_true / f_pad if f_pad > 0 else 0.0
     agg = 1.0 - true_fl / padded_fl if padded_fl > 0 else 0.0
     return BucketPlan(buckets, bucket_of, job_waste, agg, final[0])
+
+
+def plan_capacity_classes(shapes: Sequence[Tuple[int, int, int]],
+                          iters: Optional[Sequence[int]] = None, *,
+                          max_classes: int = 3,
+                          model: Optional[CostModel] = None) -> BucketPlan:
+    """Assign fleet tenants to serving CAPACITY CLASSES.
+
+    ``shapes`` are per-tenant (T_capacity, N, k) — the padded panel each
+    tenant needs resident — and ``iters`` the per-TICK warm-EM budget
+    (default 5, the serve default).  A class is a bucket whose dims every
+    member is padded to; each class costs ONE fused ``serve_update``
+    dispatch per tick, so the DP runs with the chunk set to the largest
+    budget (the whole tick is one program: ``dispatches == 1`` per class
+    in the cost), trading per-tick padded-iteration waste against one
+    extra dispatch + executable per additional class — the same
+    calibrated coefficients ``obs.advise`` uses, jax-free and
+    deterministic.  Returned as a plain :class:`BucketPlan` (class ==
+    bucket; ``pad_waste_frac`` is the fleet bench's
+    ``fleet_pad_waste_frac``).
+    """
+    its = ([5] * len(shapes) if iters is None
+           else [int(x) for x in iters])
+    cap = max(its) if its else 1
+    return plan_buckets(shapes, its, max_buckets=max_classes, model=model,
+                        chunk=max(1, cap))

@@ -1,0 +1,240 @@
+"""Batched ``serve_update`` core: ONE program answers B tenants' queries.
+
+The lone session (``serve/session.py``) fuses append + warm EM + smooth +
+nowcast/forecasts into one dispatch per QUERY; at fleet scale the query
+stream is concurrent and the ~60-100 ms tunnel dispatch dominates, so this
+module batches the same program over a leading tenant axis: one dispatch
+per bucket TICK answers every queued query in the bucket.
+
+Numerics are the point, not an afterthought: every stage is the
+``estim.batched`` masked serving twin of exactly the op the lone session
+runs — ``batched_ragged_append`` mirrors the per-tenant scatter,
+``batched_filter_masked`` mirrors ``info_filter(Y, p, mask=W)``,
+``batched_m_step_masked`` mirrors the t-masked ``em._m_step``, and the
+final smooth/nowcast/forecast stage mirrors ``_session_core`` line for
+line — so lane b of a fleet tick pins to the same tenant's lone
+``NowcastSession.update`` at the same budget (tests/test_fleet.py, x64 +
+f32 variants).  The fleet is info-filter-only (the batched twins are
+info-form); parity references use ``TPUBackend(filter="info")``.
+
+Per-tenant independence inside the one program:
+
+- ``tick_act`` (B,) bool: tenants with no query this tick are FROZEN via
+  the same ``jnp.where`` selects the batched EM engine uses — their
+  params, buffers and state are bit-identical before and after the tick
+  (no contraction ever crosses the batch axis, so a bucket-mate's NaN
+  stays in its own lane).
+- ``iter_cap`` / ``tol`` / ``floor`` (B,): per-tenant budgets and the
+  per-tenant ABSOLUTE loglik noise floor at each tenant's true live size
+  (the host computes it exactly as the lone session does).
+- Stopping reproduces ``estim.fused._em_while_core`` per iteration:
+  relative-tol convergence, plateau, divergence on a drop past the noise
+  floor (non-finite logliks included), divergence rolling params back to
+  the entry of the offending update (``p_prev`` in the carry).  At
+  ``tol=0.0`` a healthy lane runs exactly its cap — the same trajectory
+  as the lone session, which is what the parity tests pin.
+
+The EM scan is STATIC-length (no early exit): serve budgets are a few
+iterations, and a static scan is what keeps ONE executable per bucket
+shape serving every (active-set, row-count, live-length) combination.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as _PSpec
+
+from ..estim.batched import (CONVERGED, DIVERGED, RUNNING, _batched_rts,
+                             _bmask, _bT, batched_filter_masked,
+                             batched_m_step_masked, batched_ragged_append)
+from ..estim.fused import _di_forecast_core_masked
+from ..ops.linalg import matmul_vpu, matvec_vpu
+from ..ops.precision import accum_dtype
+
+__all__ = ["FleetOptions", "_fleet_core", "_fleet_impl",
+           "_fleet_impl_donated", "fleet_impl_sharded"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetOptions:
+    """Static per-bucket program options (hashable jit static).
+
+    ``fault_tenant``/``fault_iter``/``fault_drop`` are the deterministic
+    chaos seam (the fleet twin of ``FusedOptions.fault_chunk``): subtract
+    ``fault_drop`` from lane ``fault_tenant``'s loglik at EM iteration
+    ``fault_iter``, forcing that lane — and ONLY that lane — through the
+    divergence path while its bucket-mates sail through bit-identically.
+    Single-device twins only (a sharded lane index would be shard-local).
+    """
+
+    horizon: int = 1
+    di: bool = True
+    fault_tenant: Optional[int] = None
+    fault_iter: int = 1
+    fault_drop: float = 1e6
+
+
+def _fleet_em_scan(Ybuf, Wbuf, p0, tol, floor, iter_cap, tick_act, t_new,
+                   cfg, max_iters, opts):
+    """Per-lane warm EM: a static ``max_iters`` scan with per-tenant
+    in-carry freezes.  Returns (p, state (B,), n_iters (B,), good_it (B,),
+    lls (B, max_iters) — NaN past each lane's own trace length)."""
+    acc = accum_dtype(Ybuf.dtype)
+    i32 = jnp.int32
+    B = Ybuf.shape[0]
+    tmap = jax.tree_util.tree_map
+
+    def body(c, j):
+        p, p_prev, ll_prev, state, n_lls, good_it = c
+        ll, (xp, Pp, xf, Pf) = batched_filter_masked(Ybuf, Wbuf, p)
+        ll = ll.astype(acc)
+        if opts.fault_tenant is not None:   # static chaos seam
+            ll = ll.at[opts.fault_tenant].add(jnp.where(
+                j == opts.fault_iter,
+                -jnp.asarray(opts.fault_drop, acc), jnp.zeros((), acc)))
+        x_sm, P_sm, P_lag = _batched_rts(xp, Pp, xf, Pf, p.A)
+        p_new = batched_m_step_masked(Ybuf, Wbuf, x_sm, P_sm, P_lag, p,
+                                      cfg, t_new)
+        live = (state == RUNNING) & (n_lls < iter_cap) & tick_act
+        n_out = n_lls + live.astype(i32)
+        # Per-iteration mirror of _em_while_core's decision block.  On
+        # each lane's FIRST iteration ll_prev is NaN: every comparison is
+        # False, so only the non-finite rule can fire — exactly the lone
+        # driver's has_prev gating.
+        rel = (ll - ll_prev) / jnp.maximum(jnp.abs(ll_prev), 1e-12)
+        drop = ll_prev - ll
+        small = (tol > 0) & (jnp.abs(rel) < tol)
+        diver = ~small & (drop > floor)
+        plateau = ~small & ~diver & (drop > 0) & (tol > 0)
+        prog = jnp.where(small | plateau, CONVERGED,
+                         jnp.where(diver, DIVERGED, RUNNING)).astype(i32)
+        prog = jnp.where(jnp.isfinite(ll), prog,
+                         jnp.asarray(DIVERGED, i32))
+        new_state = jnp.where(live, prog, state).astype(i32)
+        advance = live & (prog != DIVERGED)
+        roll = live & (prog == DIVERGED)
+        # 3-way per-lane select: advancing lanes take the M-step update,
+        # a diverging lane rolls back to the params that ENTERED the
+        # offending update (ll_j is evaluated at p_j, so a drop at j
+        # blames the p_{j-1} -> p_j update; last-good = p_prev), frozen
+        # lanes hold bit-exactly.
+        p_out = tmap(
+            lambda n, pv, cur: jnp.where(
+                _bmask(advance, n), n, jnp.where(_bmask(roll, pv), pv, cur)),
+            p_new, p_prev, p)
+        p_prev_out = tmap(
+            lambda cur, pv: jnp.where(_bmask(live, cur), cur, pv), p, p_prev)
+        ll_prev_out = jnp.where(live, ll, ll_prev)
+        good_out = jnp.where(roll, jnp.maximum(n_out - 2, 0).astype(i32),
+                             good_it)
+        rec = jnp.where(live, ll, jnp.asarray(jnp.nan, acc))
+        return ((p_out, p_prev_out, ll_prev_out, new_state, n_out,
+                 good_out), rec)
+
+    c0 = (p0, p0, jnp.full((B,), jnp.nan, acc),
+          jnp.zeros((B,), i32), jnp.zeros((B,), i32), jnp.zeros((B,), i32))
+    (p, _, _, state, n_lls, good_it), lls = lax.scan(
+        body, c0, jnp.arange(max_iters))
+    return p, state, n_lls, good_it, jnp.moveaxis(lls, 0, 1)
+
+
+def _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol, floor,
+                iter_cap, tick_act, cfg, max_iters, opts):
+    """One fleet tick: ragged append, per-lane warm EM, smooth, nowcast +
+    forecasts for every lane — the (B,)-batched ``_session_core``.
+
+    Ybuf/Wbuf (B, T_cap, N); rows/rmask (B, r_max, N) with exact-zero
+    fill past each tenant's true count; n_new/t_cur/iter_cap (B,) int32;
+    tol/floor (B,) accum dtype; tick_act (B,) bool.
+    """
+    Ybuf, Wbuf = batched_ragged_append(Ybuf, Wbuf, rows, rmask, t_cur)
+    t_new = t_cur + n_new
+    p_fit, state, n_iters, good_it, lls = _fleet_em_scan(
+        Ybuf, Wbuf, p0, tol, floor, iter_cap, tick_act, t_new, cfg,
+        max_iters, opts)
+    # Smooth + forecast at the fitted params, same program — the exact
+    # masked filter/smoother pair the lone session core runs.
+    _, (xp, Pp, xf, Pf) = batched_filter_masked(Ybuf, Wbuf, p_fit)
+    x_sm, P_sm, _ = _batched_rts(xp, Pp, xf, Pf, p_fit.A)
+    take = lambda a, t: jnp.take(a, t, axis=0, mode="clip")  # noqa: E731
+    x_T = jax.vmap(take)(x_sm, t_new - 1)
+    P_T = jax.vmap(take)(P_sm, t_new - 1)
+    nowcast = jnp.einsum("bnk,bk->bn", p_fit.Lam, x_T)
+
+    def fstep(carry, _):
+        x, Pc = carry
+        x1 = matvec_vpu(p_fit.A, x)
+        P1 = matmul_vpu(matmul_vpu(p_fit.A, Pc), _bT(p_fit.A)) + p_fit.Q
+        return (x1, P1), (x1, jnp.einsum("bnk,bk->bn", p_fit.Lam, x1))
+
+    _, (f_fore, y_fore) = lax.scan(fstep, (x_T, P_T), None,
+                                   length=opts.horizon)
+    di = None
+    if opts.di:
+        di = jax.vmap(
+            lambda F, Yb, tn: _di_forecast_core_masked(F, Yb, tn,
+                                                       opts.horizon)
+        )(x_sm, Ybuf, t_new)
+    return {
+        "Ybuf": Ybuf,
+        "Wbuf": Wbuf,
+        "p": p_fit,
+        "good_it": good_it,
+        "lls": lls,
+        "n_iters": n_iters,
+        "status": state,
+        "x_sm": x_sm,
+        "P_sm": P_sm,
+        "nowcast": nowcast,
+        "f_fore": jnp.moveaxis(f_fore, 0, 1),    # (B, h, k)
+        "y_fore": jnp.moveaxis(y_fore, 0, 1),    # (B, h, N)
+        "di": di,
+    }
+
+
+_FLEET_STATICS = ("cfg", "max_iters", "opts")
+
+
+@partial(jax.jit, static_argnames=_FLEET_STATICS)
+def _fleet_impl(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol, floor,
+                iter_cap, tick_act, *, cfg, max_iters, opts):
+    return _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
+                       floor, iter_cap, tick_act, cfg, max_iters, opts)
+
+
+# Donated twin: panel buffers (0, 1) and params (6) consumed in place —
+# the fleet rebinds the returned arrays, so device memory stays one
+# bucket-buffer set deep.  CPU backends use the plain twin.
+@partial(jax.jit, static_argnames=_FLEET_STATICS, donate_argnums=(0, 1, 6))
+def _fleet_impl_donated(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
+                        floor, iter_cap, tick_act, *, cfg, max_iters, opts):
+    return _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
+                       floor, iter_cap, tick_act, cfg, max_iters, opts)
+
+
+@partial(jax.jit, static_argnames=_FLEET_STATICS + ("mesh",))
+def fleet_impl_sharded(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
+                       floor, iter_cap, tick_act, *, cfg, max_iters, opts,
+                       mesh):
+    """shard_map'd tick: the bucket's batch axis split over the mesh.
+
+    The lanes are INDEPENDENT (no op contracts across B), so every input
+    and every output leaf shards with the same P("batch") pytree-prefix
+    spec and the body needs no collectives — the ``parallel.batched``
+    recipe applied to the serving tick.  The caller pads B to a multiple
+    of the mesh size with ``tick_act=False`` copies of lane 0 (frozen
+    from the start, value-inert)."""
+    from ..parallel.batched import BATCH_AXIS
+    from ..parallel.mesh import shard_map
+    Pb = _PSpec(BATCH_AXIS)
+    body = lambda *a: _fleet_core(*a, cfg=cfg, max_iters=max_iters,  # noqa: E731
+                                  opts=opts)
+    return shard_map(body, mesh=mesh, in_specs=(Pb,) * 11,
+                     out_specs=Pb)(Ybuf, Wbuf, rows, rmask, n_new, t_cur,
+                                   p0, tol, floor, iter_cap, tick_act)

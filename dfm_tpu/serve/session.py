@@ -293,25 +293,38 @@ class NowcastSession:
             raise RuntimeError("session is closed")
 
     # -- the query path ------------------------------------------------
-    def update(self, new_rows, mask=None) -> SessionUpdate:
+    def update(self, new_rows=None, mask=None) -> SessionUpdate:
         """Append ``new_rows`` ((n, N) or (N,), original units; NaN =
         missing, ``mask`` optional {0,1}) and re-estimate: m warm EM
         iterations + smooth + nowcast/forecast in ONE program dispatch.
+
+        ``new_rows=None`` is a pure RE-FORECAST query: no append, same
+        single dispatch (warm EM + smooth + nowcast/forecast on the
+        resident panel), same executable — refresh the nowcast after a
+        budget change or on a schedule without feeding data.
 
         All capacity/shape validation happens on host BEFORE any device
         work — an oversized update raises without touching the session.
         """
         self._check_open()
-        rows = np.asarray(new_rows, dtype=np.float64)
-        if rows.ndim == 1:
-            rows = rows[None, :]
-        if rows.ndim != 2 or rows.shape[1] != self._N:
-            raise ValueError(
-                f"new_rows must be (n, {self._N}) or ({self._N},); got "
-                f"shape {np.asarray(new_rows).shape}")
+        if new_rows is None:
+            if mask is not None:
+                raise ValueError(
+                    "mask requires new_rows (a pure re-forecast query "
+                    "appends nothing)")
+            rows = np.zeros((0, self._N))
+        else:
+            rows = np.asarray(new_rows, dtype=np.float64)
+            if rows.ndim == 1:
+                rows = rows[None, :]
+            if rows.ndim != 2 or rows.shape[1] != self._N:
+                raise ValueError(
+                    f"new_rows must be (n, {self._N}) or ({self._N},); "
+                    f"got shape {np.asarray(new_rows).shape}")
+            if rows.shape[0] == 0:
+                raise ValueError("new_rows is empty (pass None for a "
+                                 "pure re-forecast query)")
         n_new = rows.shape[0]
-        if n_new == 0:
-            raise ValueError("new_rows is empty")
         if n_new > self._r_max:
             raise ValueError(
                 f"update has {n_new} rows but the session was opened with "

@@ -1,0 +1,543 @@
+"""Fleet serving (dfm_tpu/fleet/ + the serve/batched fleet core).
+
+The operative contracts of ``open_fleet`` (ISSUE 11), verified on the
+fake 8-device CPU mesh (conftest):
+
+- PER-TENANT PARITY: lane b of a fleet tick answers exactly what the
+  same tenant's lone ``NowcastSession`` would at the same budget —
+  x64 nowcasts/factors/forecasts pin to ~1e-10 across ragged mixed-row
+  ticks AND a tick the tenant sits out (its lane frozen bit-inert);
+  an f32 variant holds to f32 tolerance; ``backend="sharded"`` splits
+  the bucket batch axis over the mesh and matches the single-device
+  fleet.
+- SCATTER-APPEND INERTNESS (satellite): the in-graph ragged row scatter
+  touches ONLY the [t, t+n) x [:N] target region — pad rows/columns
+  stay exactly zero per padded axis (T, N, k), the live prefix equals
+  the host shadow bit-for-bit, and an inactive-tenant tick leaves the
+  lane's panel AND params bit-unchanged.  Cross-padding numerics agree
+  to fp-reduction tolerance (XLA reassociates across shapes).
+- ONE-EXECUTABLE BUDGET: a traced fleet pays 1 serve_update first-call
+  per bucket, 0 recompiles after warmup across varying active sets /
+  row counts, and exactly one blocking d2h per tick; ``summarize()``
+  gains the fleet section (occupancy, queue waits, queries/dispatch).
+- QUARANTINE: a tenant diverging past ``policy.chunk_retries`` ticks is
+  evicted to a lone guarded session; bucket-mates stay BIT-IDENTICAL
+  to a fault-free twin fleet and the evicted tenant's next query heals.
+- PLANNING: ``plan_admission`` / ``plan_capacity_classes`` /
+  ``obs.advise --fleet`` are jax-free and deterministic; the fleet
+  bench metrics stay registered in the observatory.
+"""
+
+import dataclasses
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dfm_tpu import (DynamicFactorModel, SessionFleet, fit, open_fleet,
+                     open_session)
+from dfm_tpu.api import TPUBackend
+from dfm_tpu.estim.batched import unstack_params
+from dfm_tpu.fleet import fleet_pad_waste, plan_admission
+from dfm_tpu.fleet.buffers import FleetBucket
+from dfm_tpu.obs.advise import advise_fleet
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.report import _print_text, summarize
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.robust import RobustPolicy
+from dfm_tpu.robust.health import FitHealth
+from dfm_tpu.sched.buckets import plan_capacity_classes
+from dfm_tpu.serve.batched import FleetOptions, _fleet_impl
+from dfm_tpu.utils import dgp
+
+# The fleet core is info-filter-only; parity references must run the
+# same filter (the auto heuristic would pick dense at these small N).
+BE = TPUBackend(filter="info")
+_PF = ("Lam", "A", "Q", "R", "mu0", "P0")
+
+
+def _tenant(N, T, k, seed, extra=10, backend=BE):
+    rng = np.random.default_rng(seed)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y, _ = dgp.simulate(p_true, T + extra, rng)
+    res = fit(DynamicFactorModel(n_factors=k), Y[:T], max_iters=8,
+              backend=backend, telemetry=False)
+    return res, Y[:T], Y[T:]
+
+
+@pytest.fixture(scope="module")
+def trio():
+    """Three tenants, two shapes — one bucket pads, one is exact."""
+    return [_tenant(10, 40, 2, 21), _tenant(12, 44, 2, 22),
+            _tenant(12, 44, 2, 23)]
+
+
+def _open(trio_, **kw):
+    kw.setdefault("capacity", 56)
+    kw.setdefault("max_update_rows", 3)
+    kw.setdefault("max_iters", 4)
+    kw.setdefault("tol", 0.0)
+    kw.setdefault("backend", BE)
+    kw.setdefault("max_classes", 1)
+    return open_fleet([t[0] for t in trio_], [t[1] for t in trio_], **kw)
+
+
+def _lone(res, Y, **kw):
+    kw.setdefault("capacity", 56)
+    kw.setdefault("max_update_rows", 3)
+    kw.setdefault("max_iters", 4)
+    kw.setdefault("tol", 0.0)
+    kw.setdefault("backend", BE)
+    return open_session(res, Y, **kw)
+
+
+def _assert_matches(u, ref, tol=1e-9, atol=1e-10, ll_rtol=1e-7):
+    assert u.t == ref.t and u.n_iters == ref.n_iters
+    assert u.converged == ref.converged and u.diverged == ref.diverged
+    np.testing.assert_allclose(u.nowcast, ref.nowcast, rtol=tol, atol=atol)
+    np.testing.assert_allclose(u.factors, ref.factors, rtol=tol, atol=atol)
+    np.testing.assert_allclose(u.forecasts["y"], ref.forecasts["y"],
+                               rtol=tol, atol=atol)
+    np.testing.assert_allclose(u.forecasts["f"], ref.forecasts["f"],
+                               rtol=tol, atol=atol)
+    if ref.forecasts["di"] is not None:
+        np.testing.assert_allclose(u.forecasts["di"], ref.forecasts["di"],
+                                   rtol=tol, atol=atol)
+    # Logliks differ by summation ORDER only (bucket T_cap/N pad terms
+    # are exactly zero but reassociate): fp-reduction tolerance.
+    np.testing.assert_allclose(u.logliks, ref.logliks, rtol=ll_rtol,
+                               atol=1e-6)
+
+
+# ------------------------------------------------------------- parity --
+
+def test_fleet_matches_lone_sessions_ragged_and_inactive(trio):
+    """The acceptance pin: every tenant's fleet answer IS its lone
+    session's, across ragged mixed-row ticks, a tick it sits out, and
+    the query after (the frozen lane resumed exactly where it was)."""
+    fl = _open(trio)
+    assert fl.n_buckets == 1 and sorted(fl.tenants) == ["t0", "t1", "t2"]
+    lone = [_lone(t[0], t[1]) for t in trio]
+    bk = fl._buckets[0]
+
+    # Tick 1: all three active with DIFFERENT row counts (one executable).
+    ns1 = (1, 3, 2)
+    for i, n in enumerate(ns1):
+        fl.submit(f"t{i}", trio[i][2][:n])
+    out1 = fl.drain()
+    for i, n in enumerate(ns1):
+        _assert_matches(out1[f"t{i}"][0], lone[i].update(trio[i][2][:n]))
+        assert fl.tenant_length(f"t{i}") == trio[i][1].shape[0] + n
+
+    # Tick 2: t1 sits out — its lane must be frozen BIT-inert.
+    p1_before = unstack_params(bk.p)[1]
+    Y1_before = np.asarray(bk.Ybuf[1])
+    fl.submit("t0", trio[0][2][1:3])
+    fl.submit("t2", trio[2][2][2:3])
+    out2 = fl.drain()
+    _assert_matches(out2["t0"][0], lone[0].update(trio[0][2][1:3]))
+    _assert_matches(out2["t2"][0], lone[2].update(trio[2][2][2:3]))
+    p1_after = unstack_params(bk.p)[1]
+    for f in _PF:
+        np.testing.assert_array_equal(np.asarray(getattr(p1_after, f)),
+                                      np.asarray(getattr(p1_before, f)),
+                                      err_msg=f"inactive lane params {f}")
+    np.testing.assert_array_equal(np.asarray(bk.Ybuf[1]), Y1_before,
+                                  err_msg="inactive lane panel")
+
+    # Tick 3: t1 comes back — still pins to its (uninterrupted) lone
+    # session, proving the inactive tick changed nothing downstream.
+    fl.submit("t1", trio[1][2][3:5])
+    out3 = fl.drain()
+    _assert_matches(out3["t1"][0], lone[1].update(trio[1][2][3:5]))
+    fl.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        fl.submit("t0", trio[0][2][:1])
+
+
+def test_fleet_pure_reforecast_query(trio):
+    """``submit(tenant, None)`` re-runs warm EM + forecast with NO
+    append — same answer as the lone session's ``update(None)``.
+    (Same bucket shape/statics as the parity test: executable reused.)"""
+    fl = _open(trio)
+    lone0 = _lone(trio[0][0], trio[0][1])
+    fl.submit("t0", trio[0][2][:2])
+    lone0.update(trio[0][2][:2])
+    fl.drain()
+    fl.submit("t0", None)
+    with pytest.raises(ValueError, match="mask requires rows"):
+        fl.submit("t0", None, mask=np.ones((1, 10)))
+    u = fl.drain()["t0"][0]
+    ref = lone0.update(None)
+    assert u.t == ref.t == 42    # nothing appended
+    _assert_matches(u, ref)
+    assert fl.tenant_length("t0") == 42
+    fl.close()
+
+
+def test_fleet_matches_lone_sessions_f32(trio):
+    # Same-shape tenants on purpose: one f32 fit/serve executable pair
+    # covers both lanes (the cross-shape seams are pinned in x64 above).
+    b32 = TPUBackend(dtype=jnp.float32, filter="info")
+    tens = [_tenant(12, 44, 2, 31, backend=b32),
+            _tenant(12, 44, 2, 32, backend=b32)]
+    fl = _open(tens, backend=b32, max_iters=3)
+    lone = [_lone(t[0], t[1], backend=b32, max_iters=3) for t in tens]
+    for i, n in enumerate((2, 1)):
+        fl.submit(f"t{i}", tens[i][2][:n])
+    out = fl.drain()
+    for i, n in enumerate((2, 1)):
+        u, ref = out[f"t{i}"][0], lone[i].update(tens[i][2][:n])
+        assert u.n_iters == ref.n_iters
+        np.testing.assert_allclose(u.nowcast, ref.nowcast, rtol=5e-3,
+                                   atol=5e-3)
+        np.testing.assert_allclose(u.factors, ref.factors, rtol=5e-3,
+                                   atol=5e-3)
+    fl.close()
+
+
+def test_sharded_fleet_matches_single_device(trio):
+    """backend="sharded" splits the bucket batch axis over the fake mesh
+    (filler lanes pad to a multiple of the device count) and must match
+    the single-device fleet to reduction tolerance."""
+    outs = []
+    for backend in (BE, "sharded"):
+        fl = _open(trio, backend=backend)
+        for tick in ((1, 2, 2), (2, 0, 1)):
+            for i, n in enumerate(tick):
+                if n:
+                    off = 3 if tick[0] == 2 else 0
+                    fl.submit(f"t{i}", trio[i][2][off:off + n])
+            outs_tick = fl.drain()
+        outs.append(outs_tick)
+        fl.close()
+    for t in ("t0", "t2"):
+        a, b = outs[0][t][0], outs[1][t][0]
+        np.testing.assert_allclose(a.nowcast, b.nowcast, rtol=1e-9,
+                                   atol=1e-10)
+        np.testing.assert_allclose(a.forecasts["y"], b.forecasts["y"],
+                                   rtol=1e-9, atol=1e-10)
+        assert a.n_iters == b.n_iters
+
+
+# ------------------------------------- scatter-append padding seams --
+
+def _tick_direct(bk, rows, n_new, active=True):
+    """Drive ONE lane of ``_fleet_impl`` directly (the satellite-3
+    property harness: full control over dims and activity)."""
+    B, r_max = bk.B, bk.r_max
+    T_cap, N_max, _k = bk.dims
+    slot = bk.slots[0]
+    rows_b = np.zeros((B, r_max, N_max))
+    rmask_b = np.zeros((B, r_max, N_max))
+    if n_new:
+        W = np.isfinite(rows).astype(float)
+        rz = slot.std.transform(rows) if slot.std is not None else rows
+        rz = np.where(W > 0, np.nan_to_num(rz), 0.0)
+        rows_b[0, :n_new, :slot.N] = rz
+        rmask_b[0, :n_new, :slot.N] = W
+    return _fleet_impl(
+        bk.Ybuf, bk.Wbuf, jnp.asarray(rows_b, bk.dt),
+        jnp.asarray(rmask_b, bk.dt),
+        jnp.asarray([n_new], np.int32), jnp.asarray([slot.t], np.int32),
+        bk.p, jnp.asarray([0.0], bk.acc),
+        jnp.asarray([bk.floor_for(slot, slot.t + n_new)], bk.acc),
+        jnp.asarray([slot.max_iters], np.int32), jnp.asarray([active]),
+        cfg=bk.cfg, max_iters=bk.max_iters, opts=bk.opts)
+
+
+@pytest.mark.parametrize("pad", [(6, 0, 0), (0, 3, 0), (0, 0, 1)],
+                         ids=["T", "N", "k"])
+def test_scatter_append_inert_across_padding_seams(trio, pad):
+    """Per padded axis: the ragged scatter lands ONLY on the target
+    region (pad rows/cols exactly zero, live prefix == host shadow
+    bit-for-bit) and the tick's answers match the unpadded bucket."""
+    res, Y0, stream = trio[0]          # (40, 10), k=2
+    ent = ("a", res, Y0, None, 46, 3, 0.0)
+    dims1 = (46 + pad[0], 10 + pad[1], 2 + pad[2])
+    bk0 = FleetBucket([ent], (46, 10, 2), r_max=2, backend=BE,
+                      opts=FleetOptions())
+    bk1 = FleetBucket([ent], dims1, r_max=2, backend=BE,
+                      opts=FleetOptions())
+    out0 = _tick_direct(bk0, stream[:2], 2)
+    out1 = _tick_direct(bk1, stream[:2], 2)
+
+    # The scatter-append itself is EXACT: live prefix == host shadow,
+    # appended rows land at [40:42) x [:10], everything else stays 0.
+    Yb = np.asarray(out1["Ybuf"])
+    np.testing.assert_array_equal(Yb[0, :40, :10], bk1.Yhost[0, :40, :10])
+    slot = bk1.slots[0]
+    rz = slot.std.transform(stream[:2]) if slot.std is not None \
+        else stream[:2]
+    np.testing.assert_array_equal(Yb[0, 40:42, :10], rz)
+    assert not Yb[0, 42:, :].any(), "T-pad rows written"
+    assert not Yb[0, :, 10:].any(), "N-pad columns written"
+    Wb = np.asarray(out1["Wbuf"])
+    assert not Wb[0, 42:, :].any() and not Wb[0, :, 10:].any()
+
+    # Downstream numerics agree across the seam to fp-reduction
+    # tolerance (XLA reassociates the exactly-zero pad terms).
+    assert int(out1["n_iters"][0]) == int(out0["n_iters"][0])
+    assert int(out1["status"][0]) == int(out0["status"][0])
+    for key, a_sl, b_sl in (
+            ("nowcast", np.s_[0, :10], np.s_[0, :10]),
+            ("y_fore", np.s_[0, :, :10], np.s_[0, :, :10]),
+            ("f_fore", np.s_[0, :, :2], np.s_[0, :, :2]),
+            ("x_sm", np.s_[0, :42, :2], np.s_[0, :42, :2]),
+            ("lls", np.s_[0, :3], np.s_[0, :3])):
+        np.testing.assert_allclose(np.asarray(out1[key])[b_sl],
+                                   np.asarray(out0[key])[a_sl],
+                                   rtol=1e-9, atol=1e-10, err_msg=key)
+
+
+def test_inactive_tick_is_bit_inert(trio):
+    """A tick the tenant sits out changes NOTHING in its lane: panel,
+    mask and params all bit-identical (act=False freezes + the zero
+    scatter lands on already-zero pad)."""
+    res, Y0, _stream = trio[0]
+    bk = FleetBucket([("a", res, Y0, None, 46, 3, 0.0)], (46, 10, 2),
+                     r_max=2, backend=BE, opts=FleetOptions())
+    Y_before = np.asarray(bk.Ybuf)
+    W_before = np.asarray(bk.Wbuf)
+    p_before = unstack_params(bk.p)[0]
+    out = _tick_direct(bk, None, 0, active=False)
+    np.testing.assert_array_equal(np.asarray(out["Ybuf"]), Y_before)
+    np.testing.assert_array_equal(np.asarray(out["Wbuf"]), W_before)
+    p_after = unstack_params(out["p"])[0]
+    for f in _PF:
+        np.testing.assert_array_equal(np.asarray(getattr(p_after, f)),
+                                      np.asarray(getattr(p_before, f)),
+                                      err_msg=f)
+    assert int(out["n_iters"][0]) == 0
+
+
+# ----------------------------------------------- one-executable budget --
+
+def test_fleet_trace_budget_and_report_section(trio):
+    """Warmup + 3 ticks with varying active sets / row counts: ONE
+    serve_update executable (0 recompiles after warmup), exactly one
+    blocking d2h per tick, and the summarize() fleet section."""
+    tr = Tracer(detector=RecompileDetector())
+    with activate(tr):
+        fl = _open(trio)
+        for tick in ((2, 1, 1), (1, 3, 0), (0, 1, 2), (1, 0, 0)):
+            for i, n in enumerate(tick):
+                if n:
+                    fl.submit(f"t{i}", trio[i][2][:n])
+            fl.drain()
+        fl.close()
+    disp = [e for e in tr.events if e.get("kind") == "dispatch"
+            and e.get("program") == "serve_update"]
+    assert len(disp) == 4
+    assert sum(1 for e in disp if e.get("first_call")) == 1
+    assert sum(1 for e in disp if e.get("recompile")) == 0
+    assert all(e.get("barrier") and e.get("batch") == 3 for e in disp)
+
+    s = summarize(tr.events)
+    assert s["blocking_transfers"] == 4          # exactly one per tick
+    fs = s["fleet"]
+    assert fs["n_ticks"] == 4 and fs["n_buckets"] == 1
+    assert fs["n_queries"] == 8
+    assert fs["queries_per_dispatch"] == pytest.approx(8 / 4)
+    assert 0 < fs["occupancy_mean"] <= 1
+    assert fs["per_bucket"]["0"]["ticks"] == 4
+    for t in ("t0", "t1", "t2"):
+        assert fs["per_tenant"][t]["queue_wait_s"]["p99"] >= 0
+    q = s["queries"]
+    assert q["recompiles_after_warmup"] == 0
+    assert q["per_session"][fl.fleet_id]["queries"] == 8
+    _print_text(s)    # the text report renders the fleet stanza
+
+
+def test_summarize_without_ticks_has_no_fleet_section():
+    s = summarize([{"kind": "dispatch", "program": "x", "key": "k",
+                    "t": 0.0, "dur": 0.01, "barrier": True}])
+    assert "fleet" not in s
+
+
+# ------------------------------------------------------- quarantine --
+
+def test_divergent_tenant_quarantined_bucket_mates_bit_identical(trio):
+    """The chaos pin: a deterministically-poisoned tenant is evicted to
+    a lone guarded session after policy.chunk_retries diverged ticks;
+    its bucket-mates' answers are BIT-IDENTICAL to a fault-free twin
+    fleet, and the evicted tenant's next query heals."""
+    def run(fleet, n_ticks, start=0):
+        outs = []
+        for t in range(start, start + n_ticks):
+            for i, name in enumerate(fleet.tenants):
+                fleet.submit(name, trio[i][2][2 * t:2 * t + 2])
+            outs.append(fleet.drain())
+        return outs
+
+    clean = _open(trio)
+    clean_out = run(clean, 2)
+    clean.close()
+
+    fl = _open(trio,
+               robust=RobustPolicy(chunk_retries=0, backoff_base=1e-6))
+    bk = fl._buckets[0]
+    bk.opts = dataclasses.replace(bk.opts, fault_tenant=1, fault_iter=1)
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        faulted = run(fl, 2)
+    bk.opts = dataclasses.replace(bk.opts, fault_tenant=None)
+
+    assert fl.quarantined() == ["t1"]
+    assert any(e.kind == "quarantine" and e.tenant == "t1"
+               for e in fl.health.events)
+    for t in range(2):
+        for name in ("t0", "t2"):
+            a, c = faulted[t][name][0], clean_out[t][name][0]
+            assert np.array_equal(a.nowcast, c.nowcast), (name, t)
+            assert np.array_equal(a.forecasts["y"], c.forecasts["y"])
+            assert np.array_equal(a.factors, c.factors)
+
+    # The evicted tenant keeps serving — on its lone guarded session.
+    fl.submit("t1", trio[1][2][4:6])
+    u = fl.drain()["t1"][0]
+    assert np.isfinite(u.nowcast).all() and not u.diverged
+    assert u.t == trio[1][1].shape[0] + 6
+    fl.close()
+
+
+def test_guarded_dispatch_tenants_fanout():
+    """One bucket dispatch serves many tenants: a retry is recorded
+    per-tenant (first emitted, rest replayed), and the singular/plural
+    attribution kwargs are mutually exclusive."""
+    pol = RobustPolicy(dispatch_retries=1, backoff_base=1e-6)
+    h = FitHealth(engine="test")
+    calls = []
+
+    def flaky(attempt):
+        calls.append(attempt)
+        if attempt == 0:
+            raise RuntimeError("injected")
+        return 42
+
+    from dfm_tpu.robust.dispatch import guarded_dispatch
+    assert guarded_dispatch(flaky, pol, h, label="tick",
+                            tenants=["a", "b"]) == 42
+    assert calls == [0, 1] and h.n_dispatch_retries == 1
+    evs = [e for e in h.events if e.kind == "dispatch_error"]
+    assert sorted(e.tenant for e in evs) == ["a", "b"]
+    with pytest.raises(ValueError, match="not both"):
+        guarded_dispatch(flaky, pol, h, tenant="a", tenants=["b"])
+
+
+# --------------------------------------------- admission / planning --
+
+def test_plan_admission_deterministic_and_partitioned():
+    shapes = [(60, 10, 2), (60, 10, 2), (80, 14, 2), (80, 14, 3)]
+    iters = [4, 4, 4, 4]
+    classes = plan_admission(shapes, iters, max_classes=3)
+    seen = sorted(i for c in classes for i in c.members)
+    assert seen == [0, 1, 2, 3]          # every tenant in exactly one
+    for c in classes:
+        for i in c.members:              # dims dominate every member
+            assert all(d >= s for d, s in zip(c.dims, shapes[i]))
+    assert classes == plan_admission(shapes, iters, max_classes=3)
+    w = fleet_pad_waste(shapes, iters, classes)
+    assert 0.0 <= w < 1.0
+
+    # Estimation-flag groups can NEVER share a class: a frozen-A tenant
+    # next to an estimated-A one needs max_classes >= the group count.
+    keys = [(True, True, True), (True, True, True),
+            (False, True, True), (False, True, True)]
+    cs = plan_admission(shapes, iters, keys, max_classes=2)
+    for c in cs:
+        assert len({keys[i] for i in c.members}) == 1
+    with pytest.raises(ValueError, match="max_classes"):
+        plan_admission(shapes, iters, keys, max_classes=1)
+
+
+def test_plan_capacity_classes_is_one_dispatch_per_tick():
+    shapes = [(50, 10, 2)] * 3 + [(90, 20, 2)] * 2
+    plan = plan_capacity_classes(shapes, [5] * 5, max_classes=2)
+    assert 1 <= len(plan.buckets) <= 2
+    assert sorted(j for b in plan.buckets for j in b.jobs) == list(range(5))
+    assert plan == plan_capacity_classes(shapes, [5] * 5, max_classes=2)
+
+
+def test_advise_fleet_deterministic(tmp_path):
+    shapes = [(10, 60, 2)] * 3 + [(20, 90, 2)] * 2
+    a = advise_fleet(shapes, tick_iters=5, runs=str(tmp_path))
+    b = advise_fleet(shapes, tick_iters=5, runs=str(tmp_path))
+    assert a == b
+    assert a["layouts"][0]["rank"] == 1
+    assert [l["rank"] for l in a["layouts"]] == \
+        list(range(1, len(a["layouts"]) + 1))
+    for l in a["layouts"]:
+        names = sorted(t for c in l["classes"] for t in c["tenants"])
+        assert names == list(range(5))
+        assert l["predicted_tick_wall_s"] > 0
+    assert a["calibrated"] is False      # empty registry -> priors only
+
+
+def test_advise_fleet_cli(capsys, tmp_path):
+    from dfm_tpu.obs.advise import main
+    assert main(["--fleet", "10,60,2x2;20,90,2", "--runs",
+                 str(tmp_path)]) == 0
+    out = capsys.readouterr()
+    assert "advise fleet of 3 tenants" in out.out
+    assert "PRIORS ONLY" in out.out
+    assert "no profile records in the registry" in out.err
+    assert main(["--fleet", "bogus"]) == 2
+
+
+# ------------------------------------------------------ host guards --
+
+def test_open_fleet_validation(trio):
+    res, Y0, _ = trio[0]
+    with pytest.raises(ValueError, match="at least one"):
+        open_fleet([], [])
+    with pytest.raises(ValueError, match="panels"):
+        open_fleet([res], [])
+    with pytest.raises(TypeError, match="FitResult"):
+        open_fleet(["nope"], [Y0])
+    with pytest.raises(ValueError, match="UNIQUE"):
+        open_fleet([res, res], [Y0, Y0], tenants=["a", "a"])
+    with pytest.raises(ValueError, match="fused device programs"):
+        open_fleet([res], [Y0], backend="cpu")
+    with pytest.raises(ValueError, match="capacity"):
+        open_fleet([res], [Y0], capacity=10)
+    with pytest.raises(ValueError, match="N=10"):
+        open_fleet([res], [Y0[:, :4]])
+    with pytest.raises(ValueError, match="one value per"):
+        open_fleet([res], [Y0], max_iters=[3, 4])
+
+
+def test_submit_validation_touches_nothing(trio):
+    # t0 capped at 43; bucket dims match the parity test's executable.
+    fl = _open(trio, capacity=[43, 56, 56])
+    res, Y0, stream = trio[0]
+    with pytest.raises(KeyError, match="unknown tenant"):
+        fl.submit("nope", stream[:1])
+    with pytest.raises(ValueError, match="max_update_rows"):
+        fl.submit("t0", stream[:4])
+    with pytest.raises(ValueError, match="rows must be"):
+        fl.submit("t0", np.zeros((1, 3)))
+    assert fl.submit("t0", stream[:2]) == 1       # 40 -> 42 queued
+    with pytest.raises(ValueError, match="capacity overflow"):
+        fl.submit("t0", stream[2:4])              # projected 44 > 43
+    assert fl.pending == 1
+    out = fl.drain()
+    assert out["t0"][0].t == 42 and fl.pending == 0
+    assert "SessionFleet" in repr(fl)
+    fl.close()
+    assert "closed" in repr(fl)
+
+
+# ------------------------------------------------------ obs plumbing --
+
+def test_fleet_metrics_registered_in_store():
+    from dfm_tpu.obs import store
+    for k in ("fleet_qps", "fleet_p99_ms", "fleet_pad_waste_frac"):
+        assert k in store._BENCH_NUMERIC_KEYS
+    assert not store.lower_is_better("fleet_qps")
+    assert store.lower_is_better("fleet_p99_ms")
+    assert store.lower_is_better("fleet_pad_waste_frac")
+    assert store.noise_floor("fleet_p99_ms") == 2.0
+    assert store.noise_floor("fleet_pad_waste_frac") == 0.02

@@ -140,6 +140,38 @@ def test_update_matches_cold_fit_f32(panel):
     assert u.n_iters == ref.n_iters
 
 
+def test_pure_reforecast_update(panel):
+    """Satellite (ISSUE 11): ``update(None)`` is a pure RE-FORECAST —
+    no append, t unchanged, SAME executable and exactly one blocking
+    d2h, answer pinned to a cold fused fit of the resident panel from
+    the same params at the same budget."""
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=12, tol=1e-6)
+    tr = Tracer(detector=RecompileDetector())
+    with activate(tr):
+        # Same session config as the 5-update budget test: the
+        # serve_update executable is reused within this module.
+        sess = open_session(res0, Y0, capacity=80, max_update_rows=3,
+                            max_iters=3, tol=0.0)
+        u1 = sess.update(panel[40:42])
+        u2 = sess.update(None)
+        assert u2.t == sess.t == 42        # nothing appended
+        with pytest.raises(ValueError, match="mask requires new_rows"):
+            sess.update(None, mask=np.ones((1, 12)))
+        assert sess.t == 42
+    ref1 = _cold_ref(panel[:42], res0.params, 3)
+    ref2 = _cold_ref(panel[:42], ref1.params, 3)
+    _assert_update_matches(u2, ref2)
+    disp = [e for e in tr.events if e.get("kind") == "dispatch"
+            and e.get("program") == "serve_update"]
+    assert len(disp) == 2                  # the re-forecast dispatched
+    assert sum(1 for e in disp if e.get("recompile")) == 0
+    s = summarize(tr.events)
+    assert s["blocking_transfers"] == 2    # one d2h per query, incl. None
+    q = [e for e in tr.events if e.get("kind") == "query"]
+    assert q[-1]["n_new"] == 0 and q[-1]["t_rows"] == 42
+
+
 # ----------------------------------------------- one-executable budget --
 
 def test_five_updates_one_executable_one_barrier(panel):

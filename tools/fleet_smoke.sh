@@ -1,0 +1,123 @@
+#!/usr/bin/env bash
+# One-command fleet-serving check, two legs:
+#
+#   1. Throughput + budget: run the smoke-size bench.fleet Poisson load
+#      and assert the ISSUE 11 acceptance bar from its ONE JSON line —
+#      >= 3x aggregate queries/sec vs the loop-over-lone-sessions
+#      baseline, 0 serve_update recompiles after warmup (one executable
+#      per bucket serves every active set / row count), and <= 1
+#      blocking d2h transfer per tick.
+#   2. Chaos: inject a deterministic divergence into ONE tenant's lane
+#      (the FleetOptions fault seam), assert it is quarantined to a lone
+#      guarded session while its bucket-mates stay BIT-IDENTICAL to a
+#      fault-free twin fleet, and that the evicted tenant's next query
+#      still answers (healed on the lone session).
+#
+# Usage (from the repo root):
+#   tools/fleet_smoke.sh
+#
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time;
+# DFM_RUNS is cleared so the smoke run never pollutes the registry.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "--- fleet smoke: bench.fleet Poisson load ---"
+OUT=$(JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" DFM_RUNS= \
+      DFM_BENCH_FLEET_MIX="${DFM_BENCH_FLEET_MIX:-12,40,2x4;16,48,2x4}" \
+      DFM_BENCH_ROUNDS="${DFM_BENCH_ROUNDS:-5}" \
+      DFM_BENCH_SERVE_ITERS="${DFM_BENCH_SERVE_ITERS:-3}" \
+      DFM_BENCH_ITERS="${DFM_BENCH_ITERS:-20}" \
+      python -m bench.fleet)
+echo "$OUT"
+
+printf '%s' "$OUT" | python -c '
+import json, sys
+d = json.loads(sys.stdin.readline())
+sp = d["speedup_vs_lone_sessions"]
+rc = d["recompiles_after_warmup"]
+bt = d["fleet_blocking_transfers_per_tick"]
+qpd = d["queries_per_dispatch"]
+assert d["n_tenants"] >= 8, \
+    f"fleet smoke FAILED: needs B>=8 tenants, got {d['n_tenants']}"
+assert sp >= 3.0, \
+    f"fleet smoke FAILED: {sp}x vs lone sessions (bar: >= 3x)"
+assert rc == 0, \
+    f"fleet smoke FAILED: {rc} serve_update recompiles after warmup"
+assert bt <= 1.0, \
+    f"fleet smoke FAILED: {bt} blocking transfers per tick (bar: <= 1)"
+print(f"fleet smoke OK: {sp}x vs lone sessions, "
+      f"{qpd} queries/dispatch, {bt} blocking "
+      f"transfer(s)/tick, 0 recompiles after warmup")'
+
+echo "--- fleet smoke: quarantine chaos leg ---"
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" DFM_RUNS= python - <<'PY'
+import dataclasses
+import warnings
+
+import numpy as np
+
+import jax
+jax.config.update("jax_enable_x64", True)   # bit-identical twin asserts
+
+from dfm_tpu import DynamicFactorModel, TPUBackend, fit, open_fleet
+from dfm_tpu.robust import RobustPolicy
+from dfm_tpu.utils import dgp
+
+be = TPUBackend(filter="info")
+ress, Ys, streams = [], [], []
+for i in range(4):
+    rg = np.random.default_rng(400 + i)
+    Yi, _ = dgp.simulate(dgp.dfm_params(12, 2, rg), 46, rg)
+    ress.append(fit(DynamicFactorModel(n_factors=2), Yi[:40],
+                    max_iters=15, backend=be, telemetry=False))
+    Ys.append(Yi[:40])
+    streams.append(Yi[40:])
+
+kw = dict(capacity=52, max_update_rows=2, max_iters=4, tol=0.0,
+          backend=be, max_classes=1)
+
+
+def run(fleet, n_ticks):
+    outs = []
+    for t in range(n_ticks):
+        for i, name in enumerate(fleet.tenants):
+            fleet.submit(name, streams[i][2 * t:2 * t + 2])
+        outs.append(fleet.drain())
+    return outs
+
+
+clean = run(open_fleet(ress, Ys, **kw), 2)
+
+fleet = open_fleet(ress, Ys, robust=RobustPolicy(chunk_retries=0),
+                   **kw)
+bk = fleet._buckets[0]
+bk.opts = dataclasses.replace(bk.opts, fault_tenant=1, fault_iter=1)
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore", RuntimeWarning)
+    faulted = run(fleet, 2)
+bk.opts = dataclasses.replace(bk.opts, fault_tenant=None)
+
+assert fleet.quarantined() == ["t1"], \
+    f"fleet chaos FAILED: expected ['t1'] quarantined, " \
+    f"got {fleet.quarantined()}"
+for t in range(2):
+    for name in ("t0", "t2", "t3"):
+        a = faulted[t][name][0]
+        c = clean[t][name][0]
+        assert np.array_equal(a.nowcast, c.nowcast) \
+            and np.array_equal(a.forecasts["y"], c.forecasts["y"]), \
+            f"fleet chaos FAILED: bucket-mate {name} perturbed at tick {t}"
+print("chaos: t1 quarantined; 3 bucket-mates BIT-IDENTICAL to the "
+      "fault-free twin across 2 ticks")
+
+# The evicted tenant's next query answers on its lone guarded session.
+fleet.submit("t1", streams[1][4:6])
+upd = fleet.drain()["t1"][0]
+assert np.isfinite(upd.nowcast).all() and not upd.diverged, \
+    "fleet chaos FAILED: evicted tenant's query did not heal"
+fleet.close()
+print(f"chaos: post-quarantine t1 query healed on its lone session "
+      f"(t={upd.t})")
+PY
+
+echo "fleet smoke: all gates passed"

@@ -94,5 +94,24 @@ assert store.lower_is_better("serve_degraded_queries"), \
 assert store.noise_floor("serve_degraded_queries") == 0, \
     "perf_gate: serve_degraded_queries must gate exactly (count metric)"'
 
+# The fleet-serving metrics (bench.fleet / tools/fleet_smoke.sh) must stay
+# registered: aggregate queries/sec gates higher-is-better; the p99 query
+# latency rides the ms noise floor and the admission plan's pad waste the
+# pad_waste floor, both lower-is-better.
+python -c '
+from dfm_tpu.obs import store
+need = ("fleet_qps", "fleet_p99_ms", "fleet_pad_waste_frac")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+assert not store.lower_is_better("fleet_qps"), \
+    "perf_gate: fleet_qps must gate higher-is-better"
+for k in ("fleet_p99_ms", "fleet_pad_waste_frac"):
+    assert store.lower_is_better(k), \
+        f"perf_gate: {k} lost its lower-is-better marker"
+assert store.noise_floor("fleet_p99_ms") > 0, \
+    "perf_gate: fleet_p99_ms lost its ms noise floor"
+assert store.noise_floor("fleet_pad_waste_frac") > 0, \
+    "perf_gate: fleet_pad_waste_frac lost its pad_waste noise floor"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"
