@@ -21,7 +21,9 @@ DFM_BENCH_FLEET_MIX ("N,T,KxC;..." tenant shapes, default 2 groups x 4 =
 (max rows/query, default 2), DFM_BENCH_SERVE_ITERS (EM iters/query,
 default 5), DFM_BENCH_ITERS (cold-fit budget, default 30),
 DFM_BENCH_MAX_CLASSES, DFM_BENCH_FLEET_BACKEND (tpu|sharded).
-Diagnostics on stderr.
+The live plane's SLO is armed for the run (DFM_BENCH_SLO_P99_MS,
+default 60000) so the line carries ``fleet_slo_burn_rate`` /
+``flight_dumps`` (~0 healthy).  Diagnostics on stderr.
 """
 
 import json
@@ -49,8 +51,18 @@ def main():
 
     from dfm_tpu import (DynamicFactorModel, TPUBackend, fit, open_fleet,
                          open_session)
+    from dfm_tpu.obs.live import plane, set_slo
+    from dfm_tpu.obs.slo import SLOConfig
     from dfm_tpu.obs.trace import Tracer, activate, current_tracer
     from dfm_tpu.utils import dgp
+
+    # Arm the live plane's SLO with a generous default so the bench line
+    # always carries a burn-rate reading (~0 on a healthy run; a tunnel
+    # stall or divergence storm shows up as burn > 0 + flight dumps).
+    slo_p99 = float(os.environ.get("DFM_BENCH_SLO_P99_MS", 60000.0))
+    set_slo(SLOConfig(p99_ms=slo_p99,
+                      error_rate=float(os.environ.get(
+                          "DFM_BENCH_SLO_ERROR_RATE", 0.05))))
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind}); {B} tenants "
@@ -194,6 +206,9 @@ def main():
         "fleet_backend": backend,
         "dispatches": ts_sum["dispatches"],
         "recompiles": ts_sum["recompiles"],
+        "fleet_slo_burn_rate": round(float(
+            plane().slo.status().get("burn_rate_max") or 0.0), 4),
+        "flight_dumps": int(plane().flight_dumps),
         "run_id": new_run_id(),
     }
     print(json.dumps(payload))

@@ -1199,6 +1199,15 @@ def fit(model,                     # DynamicFactorModel | family spec
                             wall=time.perf_counter() - t0)
                 if res.advice is not None:
                     tracer.emit("advice", **res.advice)
+            elif isinstance(res, FitResult):
+                # Untraced: the always-on live plane still counts the fit
+                # (same payload the tracer would carry).
+                from .obs.live import observe as live_observe
+                live_observe({"t": t0, "kind": "fit", "engine": res.backend,
+                              "shape": shape_key(Y),
+                              "n_iters": res.n_iters,
+                              "converged": bool(res.converged),
+                              "wall": time.perf_counter() - t0})
     finally:
         if owned:
             tracer.close()

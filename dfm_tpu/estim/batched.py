@@ -1018,6 +1018,13 @@ def run_batched_em(Y, p0: SSMParams, cfg: EMConfig, max_iters: int,
                     tr.emit("health", t=ev.t, event=ev.kind, chunk=ev.chunk,
                             iteration=ev.iteration, action=ev.action,
                             detail=ev.detail, engine=ev.engine)
+                else:
+                    from ..obs.live import observe as live_observe
+                    live_observe({"t": ev.t, "kind": "health",
+                                  "event": ev.kind, "chunk": ev.chunk,
+                                  "iteration": ev.iteration,
+                                  "action": ev.action, "detail": ev.detail,
+                                  "engine": ev.engine})
                 if last:
                     raise
                 n_retries += 1

@@ -56,6 +56,17 @@ __all__ = ["SessionFleet", "open_fleet"]
 _FLEET_IDS = itertools.count(1)
 
 
+def live_observe(ev: dict) -> None:
+    """Feed the always-on live plane (lazy import, see serve.session)."""
+    from ..obs.live import observe
+    observe(ev)
+
+
+def _live_accounting(session: str) -> dict:
+    from ..obs.live import accounting
+    return accounting(session)
+
+
 class _Query:
     """One queued tenant update (host units, validated at submit)."""
 
@@ -229,6 +240,30 @@ class SessionFleet:
 
     def quarantined(self) -> List[str]:
         return [t for t, (_, s) in self._slot_of.items() if s.quarantined]
+
+    def accounting(self) -> dict:
+        """Per-tenant live-plane resource ledger for this fleet: queries
+        answered, attributed device-wall ms (tick wall split over the
+        tick's active lanes), EM iterations, estimated flops
+        (``obs.cost.em_iter_work``), retries and degraded/quarantined
+        counts.  Quarantined tenants keep accumulating under their name
+        via their lone evicted session.  Always on, host-side only."""
+        out = _live_accounting(self._fid)
+        # merge lone-session rows field-by-field (a quarantined tenant's
+        # post-eviction queries are accounted under its lone session id)
+        for tenant, (_, slot) in self._slot_of.items():
+            if slot.evicted is None:
+                continue
+            for row in _live_accounting(slot.evicted.session_id).values():
+                dst = out.get(tenant)
+                if dst is None:
+                    out[tenant] = dict(row)
+                    continue
+                for f, v in row.items():
+                    if f == "pad_waste_frac":
+                        continue
+                    dst[f] = dst.get(f, 0) + v
+        return dict(sorted(out.items()))
 
     def _check_open(self):
         if self._closed:
@@ -465,22 +500,35 @@ class SessionFleet:
                                    if "p_list" in host else None))
             else:
                 slot.div_run = 0
+            degraded = bool(diverged or slot.quarantined)
+            # wall_share: this tenant's attributed slice of the tick's
+            # wall (split equally over the tick's active lanes), so the
+            # per-tenant ledger sums back to the tick walls.
+            qev = dict(session=self._fid, tenant=slot.name,
+                       t_rows=int(t_new), n_new=int(q.n_new), wall=wall,
+                       wall_share=wall / max(len(lane_q), 1),
+                       queue_wait=max(0.0, t0 - q.t_submit),
+                       n_iters=int(host["n_iters"][lane]),
+                       N=int(slot.N), k=int(slot.k),
+                       converged=bool(int(host["status"][lane])
+                                      == CONVERGED),
+                       diverged=diverged,
+                       **({"degraded": True} if degraded else {}))
             if tr is not None:
-                degraded = bool(diverged or slot.quarantined)
-                tr.emit("query", session=self._fid, tenant=slot.name,
-                        t_rows=int(t_new), n_new=int(q.n_new), wall=wall,
-                        queue_wait=max(0.0, t0 - q.t_submit),
-                        n_iters=int(host["n_iters"][lane]),
-                        converged=bool(int(host["status"][lane])
-                                       == CONVERGED),
-                        diverged=diverged,
-                        **({"degraded": True} if degraded else {}))
+                tr.emit("query", **qev)
+            else:
+                live_observe({"t": t0 + wall, "kind": "query", **qev})
             results.append((slot.name, upd))
+        tev = dict(session=self._fid,
+                   bucket=self._buckets.index(bucket), batch=B,
+                   n_active=len(lane_q), wall=wall,
+                   n_tenants=len(bucket.slots))
         if tr is not None:
-            tr.emit("tick", session=self._fid,
-                    bucket=self._buckets.index(bucket), batch=B,
-                    n_active=len(lane_q), wall=wall,
-                    n_tenants=len(bucket.slots))
+            tr.emit("tick", **tev)
+        else:
+            # Untraced serving still feeds the always-on live plane from
+            # the timestamps this tick already took.
+            live_observe({"t": t0 + wall, "kind": "tick", **tev})
         return results
 
     def _read(self, out, want_params: bool = False):

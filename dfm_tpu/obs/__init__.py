@@ -14,11 +14,35 @@ per-variant program profiles into the registry
 calibrated cost model from them, and ``obs.advise`` ranks execution
 plans (``python -m dfm_tpu.obs.advise --shape N,T,K``) — applied by
 ``fit(auto=True)``, drift-gated via the ``advice`` trace event.
+
+Live serving telemetry plane (PR 12): ``obs.metrics`` (process-local
+jax-free counters/gauges/streaming-quantile histograms + per-tenant
+``Ledger``), ``obs.slo`` (error-budget burn-rate monitor + latency
+anomaly detector), ``obs.live`` (the always-on singleton fed by every
+tracer emit AND every untraced serving seam; flight recorder ring).
+Inspect live: ``python -m dfm_tpu.obs.live [snapshot|prom]``;
+disable: ``DFM_METRICS=0``.
 """
 
 from .cost import (RecompileDetector, global_detector, program_cost,
                    reset_global_detector)
+from .metrics import Histogram, Ledger, MetricsRegistry, record_event
+from .slo import SLOConfig
 from .trace import Tracer, activate, current_tracer, fit_tracer, shape_key
+
+# Live-plane surface, PEP 562-lazy: ``python -m dfm_tpu.obs.live`` first
+# imports this package, and an eager ``from .live import ...`` here would
+# put the module in sys.modules before runpy executes it (RuntimeWarning
+# + two module objects).  Same policy as the lazy ``summarize`` below.
+_LIVE_NAMES = ("accounting", "observe", "plane", "reset_plane", "set_slo",
+               "status")
+
+
+def __getattr__(name):
+    if name in _LIVE_NAMES:
+        from . import live
+        return getattr(live, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def summarize(events_or_path):
@@ -42,4 +66,7 @@ __all__ = [
     "Tracer", "activate", "current_tracer", "fit_tracer", "shape_key",
     "RecompileDetector", "global_detector", "reset_global_detector",
     "program_cost", "summarize", "run_store",
+    "Histogram", "Ledger", "MetricsRegistry", "record_event",
+    "SLOConfig", "plane", "observe", "reset_plane", "set_slo",
+    "accounting", "status",
 ]

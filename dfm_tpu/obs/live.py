@@ -1,0 +1,418 @@
+"""The always-on live telemetry plane (jax-free).
+
+One process-local ``LivePlane`` singleton aggregates every serving seam —
+fleet ticks/queries, session updates, scheduler bucket jobs, guard
+retries, fit drivers — into bounded-memory live state:
+
+- a ``MetricsRegistry`` (counters/gauges/streaming quantiles) and a
+  per-tenant ``Ledger``, fed through ``metrics.record_event``;
+- an ``SLOMonitor`` evaluating rolling error-budget burn rate (armed via
+  ``set_slo`` or ``DFM_SLO_P99_MS``/``DFM_SLO_ERROR_RATE``/
+  ``DFM_SLO_WINDOW``; disarmed by default) plus an ``AnomalyDetector``
+  for p99 spikes vs the rolling baseline;
+- a flight recorder: a bounded ring of the most recent trace events,
+  always on, auto-dumped to an ``obs.report``-compatible JSONL when an
+  SLO breach or latency anomaly fires (dumps only when
+  ``DFM_FLIGHT_DIR`` is set — the library never creates files as a side
+  effect of serving).
+
+The plane is fed from timestamps the trace layer already takes: when a
+tracer is active, ``Tracer.emit`` forwards every event here (post-lock);
+when NOT traced, the serving seams build the same event dict they would
+have traced and call ``observe`` directly.  Either way the device hot
+path is untouched — no extra dispatches, no extra transfers, no clock
+reads beyond the ones the seams already make — and ``DFM_METRICS=0``
+turns the whole plane into a no-op.
+
+Live surfaces: ``plane().registry.render_prom()``, ``accounting()``,
+``status()``, periodic JSON snapshots to ``DFM_METRICS_SNAPSHOT`` (every
+``DFM_METRICS_INTERVAL_S``, atomic rename), and the jax-free CLI::
+
+    python -m dfm_tpu.obs.live [snapshot|prom] [--json] [--watch]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from typing import Optional
+
+from .metrics import Ledger, MetricsRegistry, record_event
+from .slo import AnomalyDetector, SLOConfig, SLOMonitor, slo_from_env
+
+__all__ = ["LivePlane", "plane", "observe", "reset_plane", "set_slo",
+           "accounting", "status"]
+
+
+def _json_default(o):
+    for attr in ("item", "tolist"):
+        f = getattr(o, attr, None)
+        if f is not None:
+            try:
+                return f()
+            except Exception:
+                break
+    return repr(o)
+
+
+class LivePlane:
+    """Always-on, bounded-memory live metrics for one process."""
+
+    def __init__(self, enabled: bool = True,
+                 slo: Optional[SLOConfig] = None,
+                 ring_events: int = 4096,
+                 flight_dir: Optional[str] = None,
+                 flight_min_interval_s: float = 10.0,
+                 snapshot_path: Optional[str] = None,
+                 snapshot_interval_s: float = 5.0):
+        self.enabled = enabled
+        self.registry = MetricsRegistry()
+        self.ledger = Ledger()
+        self.slo = SLOMonitor(slo)
+        self.anomaly = AnomalyDetector()
+        self.ring: deque = deque(maxlen=int(ring_events))
+        self.health_events: list = []       # HealthEvent(kind="slo_burn"/..)
+        self.flight_dir = flight_dir
+        self.flight_min_interval_s = float(flight_min_interval_s)
+        self.flight_dumps = 0
+        self.snapshot_path = snapshot_path
+        self.snapshot_interval_s = float(snapshot_interval_s)
+        self.errors = 0
+        self._dump_seq = 0
+        self._last_dump_t: Optional[float] = None
+        self._last_snap_t: Optional[float] = None
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    @classmethod
+    def from_env(cls) -> "LivePlane":
+        env = os.environ.get
+        enabled = env("DFM_METRICS", "1").lower() not in ("0", "off", "false")
+        return cls(
+            enabled=enabled,
+            slo=slo_from_env(),
+            ring_events=int(env("DFM_FLIGHT_EVENTS", "4096")),
+            flight_dir=env("DFM_FLIGHT_DIR") or None,
+            flight_min_interval_s=float(env("DFM_FLIGHT_MIN_INTERVAL_S",
+                                            "10.0")),
+            snapshot_path=env("DFM_METRICS_SNAPSHOT") or None,
+            snapshot_interval_s=float(env("DFM_METRICS_INTERVAL_S", "5.0")))
+
+    # -- the single entry point ------------------------------------------
+
+    def observe(self, ev: dict) -> None:
+        """Fold one trace-event dict into the live state.  Never raises,
+        never touches the device, reentrancy-safe (events emitted while
+        handling an event — e.g. the slo_burn mirror through an active
+        tracer — are dropped rather than recursed)."""
+        if not self.enabled:
+            return
+        if getattr(self._tls, "busy", False):
+            return
+        self._tls.busy = True
+        try:
+            with self._lock:
+                self.ring.append(ev)
+                record_event(self.registry, self.ledger, ev)
+                transitions = self._feed_guards(ev)
+            for name, action, detail in transitions:
+                self._emit_burn(ev, name, action, detail)
+            self._maybe_snapshot(ev.get("t"))
+        except Exception:
+            self.errors += 1
+        finally:
+            self._tls.busy = False
+
+    # -- SLO / anomaly plumbing ------------------------------------------
+
+    def _feed_guards(self, ev: dict) -> list:
+        out = []
+        kind = ev.get("kind")
+        t = ev.get("t")
+        if not isinstance(t, (int, float)):
+            return out
+        if kind == "query":
+            wall = ev.get("wall")
+            wall_ms = wall * 1e3 if isinstance(wall, (int, float)) else 0.0
+            bad = bool(ev.get("diverged")) or bool(ev.get("error"))
+            trans = self.slo.observe(t, wall_ms, error=bad)
+            if trans == "fire":
+                out.append(("slo_burn", "fired",
+                            f"burn_rate={self.slo.burn_rate:.2f}"))
+            elif trans == "clear":
+                out.append(("slo_burn", "cleared",
+                            f"burn_rate={self.slo.burn_rate:.2f}"))
+            if self.anomaly.observe(wall_ms):
+                out.append(("latency_anomaly", "spike",
+                            f"p99 vs baseline "
+                            f"{self.anomaly.baseline_ms:.3f}ms"))
+        elif (kind == "health" and ev.get("event") == "dispatch_error"):
+            self.slo.observe(t, 0.0, error=True)
+        return out
+
+    def _emit_burn(self, src: dict, name: str, action: str,
+                   detail: str) -> None:
+        """Record an slo_burn / latency_anomaly health event: into the
+        flight ring + registry directly (the reentrancy guard is up), as
+        a ``HealthEvent``, mirrored to any active tracer, and — the whole
+        point of the flight recorder — dump the ring to JSONL."""
+        t = src.get("t")
+        from ..robust.health import HealthEvent
+        he = HealthEvent(chunk=-1, iteration=-1, kind=name, detail=detail,
+                         action=action, t=t if isinstance(t, (int, float))
+                         else 0.0, engine="live",
+                         tenant=str(src.get("tenant", "")),
+                         session=str(src.get("session", "")))
+        ev = {"t": he.t, "kind": "health", "event": name, "chunk": -1,
+              "iteration": -1, "action": action, "detail": detail,
+              "engine": "live",
+              "burn_rate": round(self.slo.burn_rate, 6)}
+        if he.tenant:
+            ev["tenant"] = he.tenant
+        if he.session:
+            ev["session"] = he.session
+        with self._lock:
+            self.health_events.append(he)
+            self.ring.append(ev)
+            record_event(self.registry, self.ledger, ev)
+        from .trace import current_tracer
+        tr = current_tracer()
+        if tr is not None:
+            payload = {k: v for k, v in ev.items() if k not in ("t", "kind")}
+            tr.emit("health", t=he.t, **payload)
+        if action in ("fired", "spike"):
+            self._maybe_dump(he.t)
+
+    # -- flight recorder --------------------------------------------------
+
+    def dump_flight(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the ring to an ``obs.report``-compatible JSONL; returns
+        the path (None when no destination is configured)."""
+        if path is None:
+            if not self.flight_dir:
+                return None
+            os.makedirs(self.flight_dir, exist_ok=True)
+            self._dump_seq += 1
+            path = os.path.join(
+                self.flight_dir,
+                f"flight-{os.getpid()}-{self._dump_seq}.jsonl")
+        with self._lock:
+            events = list(self.ring)
+        with open(path, "w", encoding="utf-8") as fh:
+            for ev in events:
+                fh.write(json.dumps(ev, default=_json_default) + "\n")
+        self.flight_dumps += 1
+        return path
+
+    def _maybe_dump(self, t: float) -> None:
+        if not self.flight_dir:
+            return
+        if (self._last_dump_t is not None
+                and t - self._last_dump_t < self.flight_min_interval_s):
+            return
+        self._last_dump_t = t
+        self.dump_flight()
+
+    # -- snapshots --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "v": 1,
+            "registry": self.registry.snapshot(),
+            "ledger": self.ledger.snapshot(),
+            "slo": self.slo.status(),
+            "anomaly": self.anomaly.status(),
+            "flight": {"ring_events": len(self.ring),
+                       "dumps": self.flight_dumps,
+                       "dir": self.flight_dir},
+            "errors": self.errors,
+        }
+
+    def write_snapshot(self, path: Optional[str] = None) -> Optional[str]:
+        """Atomically write the snapshot JSON (tmp + rename)."""
+        path = path or self.snapshot_path
+        if not path:
+            return None
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(self.snapshot(), fh, default=_json_default)
+        os.replace(tmp, path)
+        return path
+
+    def _maybe_snapshot(self, t) -> None:
+        if not self.snapshot_path or not isinstance(t, (int, float)):
+            return
+        if (self._last_snap_t is not None
+                and t - self._last_snap_t < self.snapshot_interval_s):
+            return
+        self._last_snap_t = t
+        self.write_snapshot()
+
+    # -- queries ----------------------------------------------------------
+
+    def accounting(self, session: Optional[str] = None) -> dict:
+        return self.ledger.accounting(session)
+
+    def status(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "n_series": self.registry.n_series,
+            "slo": self.slo.status(),
+            "anomaly": self.anomaly.status(),
+            "flight_dumps": self.flight_dumps,
+            "ring_events": len(self.ring),
+            "errors": self.errors,
+        }
+
+
+# -- process singleton ----------------------------------------------------
+
+_PLANE: Optional[LivePlane] = None
+_PLANE_LOCK = threading.Lock()
+
+
+def plane() -> LivePlane:
+    """The process-local live plane (created lazily from the environment)."""
+    global _PLANE
+    p = _PLANE
+    if p is None:
+        with _PLANE_LOCK:
+            p = _PLANE
+            if p is None:
+                p = _PLANE = LivePlane.from_env()
+    return p
+
+
+def observe(ev: dict) -> None:
+    """Module-level fast path used by ``Tracer.emit`` and the untraced
+    serving seams."""
+    plane().observe(ev)
+
+
+def reset_plane() -> None:
+    """Drop the singleton so the next ``plane()`` re-reads the
+    environment (tests / forked workers)."""
+    global _PLANE
+    with _PLANE_LOCK:
+        _PLANE = None
+
+
+def set_slo(config: Optional[SLOConfig]) -> None:
+    """Arm (or disarm, with None) the live plane's SLO monitor."""
+    plane().slo.set_config(config)
+
+
+def accounting(session: Optional[str] = None) -> dict:
+    return plane().accounting(session)
+
+
+def status() -> dict:
+    return plane().status()
+
+
+# -- CLI ------------------------------------------------------------------
+
+def _fmt_snapshot(snap: dict) -> str:
+    lines = []
+    reg = snap.get("registry", {})
+    lines.append("== live metrics snapshot ==")
+    slo = snap.get("slo", {})
+    lines.append(
+        f"slo: armed={slo.get('armed')} breached={slo.get('breached')} "
+        f"burn_rate={slo.get('burn_rate')} (max {slo.get('burn_rate_max')}, "
+        f"fired {slo.get('n_fired')}x)")
+    an = snap.get("anomaly", {})
+    lines.append(f"anomaly: baseline_ms={an.get('baseline_ms')} "
+                 f"spiking={an.get('spiking')} n_spikes={an.get('n_spikes')}")
+    fl = snap.get("flight", {})
+    lines.append(f"flight: ring={fl.get('ring_events')} events, "
+                 f"dumps={fl.get('dumps')}")
+    counters = reg.get("counters", {})
+    if counters:
+        lines.append("-- counters --")
+        for k, v in counters.items():
+            lines.append(f"  {k:<56s} {v:g}")
+    gauges = reg.get("gauges", {})
+    if gauges:
+        lines.append("-- gauges --")
+        for k, v in gauges.items():
+            lines.append(f"  {k:<56s} {v:g}")
+    hists = reg.get("histograms", {})
+    if hists:
+        from .metrics import Histogram
+        lines.append("-- quantiles --")
+        for k, d in hists.items():
+            h = Histogram.from_dict(d)
+            p50, p99 = h.quantile(0.5), h.quantile(0.99)
+            lines.append(
+                f"  {k:<44s} n={h.count:<7d} p50={p50:.4g} p99={p99:.4g}")
+    ledger = snap.get("ledger", [])
+    if ledger:
+        lines.append("-- ledger (per session x tenant) --")
+        for row in ledger:
+            lines.append(
+                f"  {row.get('session')}/{row.get('tenant')}: "
+                f"queries={int(row.get('queries', 0))} "
+                f"jobs={int(row.get('jobs', 0))} "
+                f"device_ms={row.get('device_ms', 0.0):.2f} "
+                f"em_iters={int(row.get('em_iters', 0))} "
+                f"est_flops={row.get('est_flops', 0.0):.3g} "
+                f"retries={int(row.get('retries', 0))} "
+                f"degraded={int(row.get('degraded', 0))}")
+    return "\n".join(lines)
+
+
+def _render(snap: dict, mode: str, as_json: bool) -> str:
+    if mode == "prom":
+        return MetricsRegistry.from_snapshot(
+            snap.get("registry", {})).render_prom()
+    if as_json:
+        return json.dumps(snap, default=_json_default)
+    return _fmt_snapshot(snap)
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m dfm_tpu.obs.live",
+        description="Read live-plane metric snapshots (jax-free). The "
+                    "serving process writes them when DFM_METRICS_SNAPSHOT "
+                    "is set; point --file (or the same env var) here.")
+    ap.add_argument("mode", nargs="?", default="snapshot",
+                    choices=("snapshot", "prom"))
+    ap.add_argument("--file", default=os.environ.get("DFM_METRICS_SNAPSHOT"),
+                    help="snapshot JSON path (default: $DFM_METRICS_SNAPSHOT)")
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the text rendering")
+    ap.add_argument("--watch", action="store_true",
+                    help="re-read and re-render every --interval seconds")
+    ap.add_argument("--interval", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    if not args.file:
+        ap.error("no snapshot file: set DFM_METRICS_SNAPSHOT or pass --file")
+
+    def once() -> int:
+        try:
+            with open(args.file, "r", encoding="utf-8") as fh:
+                snap = json.load(fh)
+        except FileNotFoundError:
+            print(f"obs.live: no snapshot at {args.file} yet", flush=True)
+            return 1
+        except json.JSONDecodeError as e:
+            print(f"obs.live: unreadable snapshot ({e})", flush=True)
+            return 1
+        print(_render(snap, args.mode, args.json), flush=True)
+        return 0
+
+    if not args.watch:
+        return once()
+    import time
+    while True:     # pragma: no cover - interactive loop
+        once()
+        time.sleep(max(0.1, args.interval))
+
+
+if __name__ == "__main__":      # pragma: no cover
+    raise SystemExit(main())

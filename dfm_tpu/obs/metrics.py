@@ -1,0 +1,499 @@
+"""Process-local, jax-free metrics primitives for the live telemetry plane.
+
+The trace layer (``obs.trace``) is opt-in and post-hoc: an unbounded JSONL
+you read after the run ends.  A long-lived serving fleet needs the
+opposite — always-on, bounded-memory counters/gauges/quantiles you can
+poll *while it serves*.  This module provides the primitives:
+
+- ``Counter`` / ``Gauge``: one float, O(1).
+- ``Histogram``: fixed-log-bucket streaming quantile sketch.  ~360 integer
+  buckets spanning [1e-6, 1e6) with 8% geometric growth, so every series
+  is O(1) memory regardless of event volume and quantiles carry a bounded
+  ~4% relative error (quantile = geometric mean of the bucket edges).
+  Exact ``count``/``sum``/``min``/``max`` ride along.
+- ``MetricsRegistry``: labeled series (``tenant=…, program=…``) behind one
+  lock; ``snapshot()``/``from_snapshot()`` round-trip through JSON for the
+  ``obs.live`` CLI; ``render_prom()`` is Prometheus text exposition.
+- ``Ledger``: per-(session, tenant) resource accounting — queries,
+  device-wall ms, EM iterations, estimated flops (``cost.em_iter_work``),
+  pad-waste share, retries, degraded/quarantined counts.
+- ``record_event(registry, ledger, ev)``: THE mapping from a trace-event
+  dict to metric/ledger updates.  Both the live plane (``obs.live``) and
+  the post-hoc ``metrics`` section of ``report.summarize`` go through this
+  one function, so the two surfaces cannot drift.
+
+Everything here is host-side python on timestamps the trace layer already
+takes: no jax import, no device work, no clock reads beyond what callers
+pass in.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+from .cost import em_iter_work
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Ledger",
+           "record_event", "LEDGER_FIELDS"]
+
+
+# -- streaming histogram -------------------------------------------------
+
+_LO = 1e-6          # smallest resolvable value (ms-scale walls: 1 ns)
+_HI = 1e6           # largest bucket edge
+_GROWTH = 1.08      # geometric bucket growth: <= 4% quantile error
+_LOG_G = math.log(_GROWTH)
+_NBUCKETS = int(math.ceil(math.log(_HI / _LO) / _LOG_G))  # ~358
+
+
+class Histogram:
+    """Fixed-log-bucket streaming quantile histogram (O(1) memory).
+
+    ``observe`` is an int increment in a dict keyed by bucket index;
+    ``quantile`` walks the cumulative counts and returns the geometric
+    mean of the matched bucket's edges, clamped to the exact observed
+    [min, max].  Values outside [1e-6, 1e6) clamp to the end buckets.
+    """
+
+    __slots__ = ("count", "sum", "min", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.buckets: Dict[int, int] = {}
+
+    def observe(self, x: float) -> None:
+        x = float(x)
+        if not math.isfinite(x):
+            return
+        self.count += 1
+        self.sum += x
+        if x < self.min:
+            self.min = x
+        if x > self.max:
+            self.max = x
+        if x <= _LO:
+            i = 0
+        else:
+            i = min(int(math.log(x / _LO) / _LOG_G), _NBUCKETS - 1)
+        self.buckets[i] = self.buckets.get(i, 0) + 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile estimate; None for an empty series."""
+        if self.count == 0:
+            return None
+        rank = max(1, int(math.ceil(q * self.count - 1e-9)))
+        cum = 0
+        for i in sorted(self.buckets):
+            cum += self.buckets[i]
+            if cum >= rank:
+                lo = _LO * _GROWTH ** i
+                est = lo * math.sqrt(_GROWTH)   # geometric mid of the bucket
+                return min(max(est, self.min), self.max)
+        return self.max
+
+    def to_dict(self) -> dict:
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+                "buckets": {str(i): n for i, n in sorted(self.buckets.items())}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Histogram":
+        h = cls()
+        h.count = int(d.get("count", 0))
+        h.sum = float(d.get("sum", 0.0))
+        h.min = math.inf if d.get("min") is None else float(d["min"])
+        h.max = -math.inf if d.get("max") is None else float(d["max"])
+        h.buckets = {int(i): int(n)
+                     for i, n in dict(d.get("buckets", {})).items()}
+        return h
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+# -- labeled registry ----------------------------------------------------
+
+LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _key(name: str, labels: dict) -> LabelKey:
+    return name, tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return "dfm_" + out
+
+
+def _prom_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    esc = [(k, v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n"))
+           for k, v in labels]
+    return "{" + ",".join(f'{k}="{v}"' for k, v in esc) + "}"
+
+
+class MetricsRegistry:
+    """Thread-safe set of labeled Counter/Gauge/Histogram series."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[LabelKey, Counter] = {}
+        self._gauges: Dict[LabelKey, Gauge] = {}
+        self._hists: Dict[LabelKey, Histogram] = {}
+
+    def counter(self, name: str, **labels) -> Counter:
+        k = _key(name, labels)
+        with self._lock:
+            c = self._counters.get(k)
+            if c is None:
+                c = self._counters[k] = Counter()
+        return c
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        k = _key(name, labels)
+        with self._lock:
+            g = self._gauges.get(k)
+            if g is None:
+                g = self._gauges[k] = Gauge()
+        return g
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        k = _key(name, labels)
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = Histogram()
+        return h
+
+    @property
+    def n_series(self) -> int:
+        with self._lock:
+            return (len(self._counters) + len(self._gauges)
+                    + len(self._hists))
+
+    # -- serialization ---------------------------------------------------
+
+    @staticmethod
+    def _flat(k: LabelKey) -> str:
+        name, labels = k
+        if not labels:
+            return name
+        return name + "{" + ",".join(f"{a}={b}" for a, b in labels) + "}"
+
+    def snapshot(self) -> dict:
+        """JSON-able snapshot of every series (stable key order)."""
+        with self._lock:
+            return {
+                "v": 1,
+                "counters": {self._flat(k): c.value
+                             for k, c in sorted(self._counters.items())},
+                "gauges": {self._flat(k): g.value
+                           for k, g in sorted(self._gauges.items())},
+                "histograms": {self._flat(k): h.to_dict()
+                               for k, h in sorted(self._hists.items())},
+            }
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "MetricsRegistry":
+        reg = cls()
+        for flat, v in dict(snap.get("counters", {})).items():
+            reg._counters[_unflat(flat)] = Counter(float(v))
+        for flat, v in dict(snap.get("gauges", {})).items():
+            reg._gauges[_unflat(flat)] = Gauge(float(v))
+        for flat, d in dict(snap.get("histograms", {})).items():
+            reg._hists[_unflat(flat)] = Histogram.from_dict(d)
+        return reg
+
+    def render_prom(self) -> str:
+        """Prometheus text exposition (counters, gauges, summaries)."""
+        lines = []
+        with self._lock:
+            by_name: Dict[str, list] = {}
+            for (name, labels), c in sorted(self._counters.items()):
+                by_name.setdefault(name, []).append(("counter", labels, c))
+            for (name, labels), g in sorted(self._gauges.items()):
+                by_name.setdefault(name, []).append(("gauge", labels, g))
+            for name in sorted(by_name):
+                typ = by_name[name][0][0]
+                pname = _prom_name(name)
+                lines.append(f"# TYPE {pname} {typ}")
+                for _, labels, m in by_name[name]:
+                    lines.append(f"{pname}{_prom_labels(labels)} {m.value:g}")
+            for (name, labels) in sorted(self._hists):
+                h = self._hists[(name, labels)]
+                pname = _prom_name(name)
+                lines.append(f"# TYPE {pname} summary")
+                for q in (0.5, 0.9, 0.99):
+                    val = h.quantile(q)
+                    if val is None:
+                        continue
+                    lab = labels + (("quantile", f"{q:g}"),)
+                    lines.append(f"{pname}{_prom_labels(lab)} {val:g}")
+                lines.append(
+                    f"{pname}_count{_prom_labels(labels)} {h.count}")
+                lines.append(
+                    f"{pname}_sum{_prom_labels(labels)} {h.sum:g}")
+        return "\n".join(lines) + "\n"
+
+
+def _unflat(flat: str) -> LabelKey:
+    if "{" not in flat:
+        return flat, ()
+    name, rest = flat.split("{", 1)
+    body = rest.rsplit("}", 1)[0]
+    labels = tuple(tuple(p.split("=", 1)) for p in body.split(",") if p)
+    return name, labels
+
+
+# -- per-tenant accounting ledger ----------------------------------------
+
+LEDGER_FIELDS = ("queries", "jobs", "device_ms", "em_iters", "est_flops",
+                 "retries", "degraded", "quarantined",
+                 "pad_waste_sum", "pad_waste_n")
+
+
+class Ledger:
+    """Per-(session, tenant) resource accounting.
+
+    ``device_ms`` is the tenant's attributed share of dispatch wall time:
+    a lone session charges the full query wall; a fleet tick splits its
+    wall equally across the tick's active lanes (``wall_share`` on the
+    query event), so fleet tenants sum back to the tick walls.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows: Dict[Tuple[str, str], Dict[str, float]] = {}
+
+    def row(self, session: str, tenant: str) -> Dict[str, float]:
+        k = (str(session), str(tenant))
+        with self._lock:
+            r = self._rows.get(k)
+            if r is None:
+                r = self._rows[k] = {f: 0.0 for f in LEDGER_FIELDS}
+        return r
+
+    def accounting(self, session: Optional[str] = None) -> dict:
+        """Per-tenant totals, optionally restricted to one session/fleet id.
+
+        Returns ``{tenant: {queries, jobs, device_ms, em_iters, est_flops,
+        retries, degraded, quarantined, pad_waste_frac}}`` (tenants merged
+        across sessions when ``session`` is None).
+        """
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            items = list(self._rows.items())
+        for (sid, ten), r in items:
+            if session is not None and sid != str(session):
+                continue
+            d = out.setdefault(ten, {f: 0.0 for f in LEDGER_FIELDS})
+            for f in LEDGER_FIELDS:
+                d[f] += r[f]
+        for ten, d in out.items():
+            n = d.pop("pad_waste_n")
+            s = d.pop("pad_waste_sum")
+            d["pad_waste_frac"] = (s / n) if n else 0.0
+            for f in ("queries", "jobs", "em_iters", "retries",
+                      "degraded", "quarantined"):
+                d[f] = int(d[f])
+        return dict(sorted(out.items()))
+
+    def totals(self) -> dict:
+        """Whole-process totals (same shape as one accounting row)."""
+        tot = {f: 0.0 for f in LEDGER_FIELDS}
+        with self._lock:
+            for r in self._rows.values():
+                for f in LEDGER_FIELDS:
+                    tot[f] += r[f]
+        n = tot.pop("pad_waste_n")
+        s = tot.pop("pad_waste_sum")
+        tot["pad_waste_frac"] = (s / n) if n else 0.0
+        return tot
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return [{"session": k[0], "tenant": k[1], **r}
+                    for k, r in sorted(self._rows.items())]
+
+    @classmethod
+    def from_snapshot(cls, rows: Iterable[dict]) -> "Ledger":
+        led = cls()
+        for d in rows:
+            r = led.row(d.get("session", "-"), d.get("tenant", "-"))
+            for f in LEDGER_FIELDS:
+                r[f] += float(d.get(f, 0.0))
+        return led
+
+
+# -- the event -> metrics mapping ----------------------------------------
+
+def _num(x) -> Optional[float]:
+    return float(x) if isinstance(x, (int, float)) and not isinstance(
+        x, bool) else None
+
+
+def record_event(registry: MetricsRegistry, ledger: Optional[Ledger],
+                 ev: dict) -> None:
+    """Apply one trace-event dict to the registry (+ ledger when given).
+
+    This is the single source of truth for how trace events become
+    metrics: the live plane calls it per event as they happen, and
+    ``report.summarize`` replays a trace through it for the post-hoc
+    ``metrics`` section — identical mapping by construction.
+    """
+    kind = ev.get("kind")
+    if kind == "dispatch":
+        prog = str(ev.get("program", "?"))
+        registry.counter("dispatches_total", program=prog).inc()
+        if ev.get("first_call"):
+            registry.counter("first_calls_total", program=prog).inc()
+        if ev.get("recompile"):
+            registry.counter("recompiles_total", program=prog).inc()
+        if ev.get("error"):
+            registry.counter("dispatch_errors_total", program=prog).inc()
+        dur = _num(ev.get("dur"))
+        if dur is not None and ev.get("barrier"):
+            registry.histogram("dispatch_wall_ms", program=prog).observe(
+                dur * 1e3)
+    elif kind == "transfer":
+        mode = "blocking" if ev.get("blocking", True) else "nonblocking"
+        registry.counter("transfers_total", mode=mode).inc()
+    elif kind == "query":
+        sid = str(ev.get("session", "-"))
+        ten = str(ev.get("tenant", sid))
+        registry.counter("queries_total", tenant=ten).inc()
+        wall = _num(ev.get("wall"))
+        if wall is not None:
+            registry.histogram("query_wall_ms", tenant=ten).observe(
+                wall * 1e3)
+        qw = _num(ev.get("queue_wait"))
+        if qw is not None:
+            registry.histogram("queue_wait_ms", tenant=ten).observe(qw * 1e3)
+        if ev.get("degraded"):
+            registry.counter("degraded_queries_total", tenant=ten).inc()
+        if ev.get("diverged"):
+            registry.counter("diverged_queries_total", tenant=ten).inc()
+        if ledger is not None:
+            row = ledger.row(sid, ten)
+            row["queries"] += 1
+            share = _num(ev.get("wall_share"))
+            if share is None:
+                share = wall
+            if share is not None:
+                row["device_ms"] += share * 1e3
+            it = _num(ev.get("n_iters"))
+            if it is not None:
+                row["em_iters"] += it
+                N = _num(ev.get("N"))
+                k = _num(ev.get("k"))
+                t_rows = _num(ev.get("t_rows"))
+                if N and k and t_rows:
+                    row["est_flops"] += em_iter_work(
+                        int(N), int(t_rows), int(k))[0] * it
+            if ev.get("degraded"):
+                row["degraded"] += 1
+    elif kind == "tick":
+        fid = str(ev.get("session", "-"))
+        registry.counter("ticks_total", fleet=fid).inc()
+        wall = _num(ev.get("wall"))
+        if wall is not None:
+            registry.histogram("tick_wall_ms", fleet=fid).observe(wall * 1e3)
+        b = _num(ev.get("batch"))
+        a = _num(ev.get("n_active"))
+        if b and a is not None:
+            registry.gauge("fleet_occupancy", fleet=fid,
+                           bucket=str(ev.get("bucket", "?"))).set(a / b)
+    elif kind == "tenant":
+        ten = str(ev.get("tenant", "-"))
+        registry.counter("jobs_total", tenant=ten).inc()
+        cs = _num(ev.get("compute_s"))
+        if cs is not None:
+            registry.histogram("job_compute_ms", tenant=ten).observe(cs * 1e3)
+        if ledger is not None:
+            row = ledger.row(str(ev.get("session", "sched")), ten)
+            row["jobs"] += 1
+            if cs is not None:
+                row["device_ms"] += cs * 1e3
+            it = _num(ev.get("n_iters"))
+            if it is not None:
+                row["em_iters"] += it
+                N = _num(ev.get("N"))
+                k = _num(ev.get("k"))
+                T = _num(ev.get("T"))
+                if N and k and T:
+                    row["est_flops"] += em_iter_work(
+                        int(N), int(T), int(k))[0] * it
+            pw = _num(ev.get("pad_waste_frac"))
+            if pw is not None:
+                row["pad_waste_sum"] += pw
+                row["pad_waste_n"] += 1
+            if ev.get("quarantined"):
+                row["quarantined"] += 1
+    elif kind == "health":
+        event = str(ev.get("event", "?"))
+        registry.counter("health_events_total", event=event).inc()
+        bo = _num(ev.get("backoff_s"))
+        if bo:
+            registry.counter("backoff_s_total").inc(bo)
+        ten = ev.get("tenant")
+        sid = ev.get("session")
+        if ledger is not None and (ten or sid):
+            row = ledger.row(str(sid or "-"), str(ten or sid))
+            if event == "dispatch_error" and ev.get("action") == "retried":
+                row["retries"] += 1
+            if event == "quarantine":
+                row["quarantined"] += 1
+        if event == "dispatch_error" and ev.get("action") == "retried":
+            registry.counter("dispatch_retries_total").inc()
+        if event == "quarantine":
+            registry.counter("quarantines_total").inc()
+    elif kind == "fit":
+        registry.counter("fits_total").inc()
+        wall = _num(ev.get("wall"))
+        if wall is not None:
+            registry.histogram("fit_wall_ms").observe(wall * 1e3)
+        it = _num(ev.get("n_iters"))
+        if it is not None:
+            registry.counter("em_iters_total").inc(it)
+    elif kind == "chunk":
+        registry.counter("chunks_total").inc()
+
+
+def metrics_summary(registry: MetricsRegistry) -> dict:
+    """Compact JSON-able digest of a registry for ``report.summarize``."""
+    snap = registry.snapshot()
+    hists = {}
+    for flat, d in snap["histograms"].items():
+        h = Histogram.from_dict(d)
+        hists[flat] = {"count": h.count, "sum": round(h.sum, 6),
+                       "p50": round(h.quantile(0.5), 6),
+                       "p99": round(h.quantile(0.99), 6)}
+    return {"n_series": (len(snap["counters"]) + len(snap["gauges"])
+                         + len(snap["histograms"])),
+            "counters": {k: v for k, v in snap["counters"].items()},
+            "gauges": {k: round(v, 6) for k, v in snap["gauges"].items()},
+            "histograms": hists}

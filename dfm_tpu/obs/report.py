@@ -17,6 +17,20 @@ What it computes from the event stream (schema: ``obs/trace.py``):
 - static flops/bytes per program when cost capture was on
 - p50/p90/p99 dispatch walls (all spans + per-program end-to-end), and
   the advisor's predicted-vs-realized wall when ``fit(auto=True)`` ran
+- a ``metrics`` digest: the trace replayed through the live plane's
+  ``metrics.record_event`` mapping (identical to what ``obs.live``
+  accumulates in-process)
+
+``summarize`` is SINGLE-PASS and iterator-friendly: it accepts a JSONL
+path, a list of paths (rotated traces, oldest first), or any iterable of
+event dicts, and never materializes the event stream — flight-recorder
+dumps and week-long soak traces report in O(1) memory (only the numeric
+duration lists needed for exact nearest-rank percentiles are kept).
+
+The JSON summary schema is versioned (top-level ``schema_version``) and
+the ``tenants`` / ``tenant_fairness`` / ``queries`` / ``fleet`` /
+``robustness`` / ``metrics`` sections are always present with stable keys,
+empty or not.
 
 ``--chrome out.json`` additionally exports the raw event stream to
 Chrome/Perfetto trace-event format for visual pipeline inspection.
@@ -27,17 +41,19 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import List, Union
+from typing import Iterable, Iterator, List, Union
 
-__all__ = ["load", "summarize", "to_chrome", "main"]
+__all__ = ["load", "iter_events", "summarize", "to_chrome", "main",
+           "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = 1
 
 
-def load(path: str) -> List[dict]:
-    """Parse a JSONL trace, tolerating damage: empty files, and
+def iter_events(path: str) -> Iterator[dict]:
+    """Stream a JSONL trace, tolerating damage: empty files, and
     truncated/corrupt lines (a process killed mid-write leaves a partial
     last line) are warned about on stderr and skipped — a damaged trace
     must still summarize."""
-    events = []
     with open(path, "r", encoding="utf-8") as fh:
         for i, line in enumerate(fh):
             line = line.strip()
@@ -50,11 +66,29 @@ def load(path: str) -> List[dict]:
                       f"({e})", file=sys.stderr)
                 continue
             if isinstance(ev, dict):
-                events.append(ev)
+                yield ev
             else:
                 print(f"warning: {path}:{i + 1}: skipping non-object line",
                       file=sys.stderr)
-    return events
+
+
+def load(path: str) -> List[dict]:
+    """Parse a JSONL trace into a list (see ``iter_events``)."""
+    return list(iter_events(path))
+
+
+def _event_stream(events_or_path) -> Iterator[dict]:
+    """Normalize summarize's input: a path, a list of paths (rotated
+    traces, oldest first), or an iterable of event dicts."""
+    if isinstance(events_or_path, str):
+        yield from iter_events(events_or_path)
+        return
+    if (isinstance(events_or_path, (list, tuple)) and events_or_path
+            and all(isinstance(p, str) for p in events_or_path)):
+        for p in events_or_path:
+            yield from iter_events(p)
+        return
+    yield from events_or_path
 
 
 def _pct(xs: List[float], q: float) -> float:
@@ -76,36 +110,207 @@ def _stats(xs: List[float]) -> dict:
             "p90": _pct(xs, 0.90), "p99": _pct(xs, 0.99)}
 
 
-def summarize(events_or_path: Union[str, List[dict]]) -> dict:
-    """Aggregate an event stream (list of dicts, or a JSONL path)."""
-    events = (load(events_or_path) if isinstance(events_or_path, str)
-              else list(events_or_path))
+def _numf(x):
+    return float(x) if isinstance(x, (int, float)) else None
 
-    disp = [e for e in events if e.get("kind") == "dispatch"]
+
+def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
+    """Aggregate an event stream in ONE pass (path(s) or dict iterable)."""
+    from .metrics import MetricsRegistry, metrics_summary, record_event
+    reg = MetricsRegistry()
+
+    n_events = 0
+    # dispatch accumulators (global + per-program)
+    n_disp = n_first = n_recomp = n_disp_err = 0
     by_prog: dict = {}
-    for e in disp:
-        p = by_prog.setdefault(e.get("program", "?"), {
-            "dispatches": 0, "first_calls": 0, "recompiles": 0, "errors": 0,
-            "keys": set(), "first_durs": [], "steady_durs": [],
-            "barrier_durs": [], "fused_iters": 0, "bucketed": 0,
-            "queue_depths": [], "fused_programs": 0})
-        p["dispatches"] += 1
-        p["keys"].add(e.get("key", ""))
-        if e.get("error"):
-            p["errors"] += 1
-        first = bool(e.get("first_call"))
-        p["first_calls"] += first
-        p["recompiles"] += bool(e.get("recompile"))
-        p["bucketed"] += e.get("bucket") is not None
-        p["fused_programs"] += bool(e.get("fused"))
-        if e.get("queue_depth") is not None:
-            p["queue_depths"].append(int(e["queue_depth"]))
-        dur = e.get("dur")
-        if dur is not None:
-            (p["first_durs"] if first else p["steady_durs"]).append(dur)
+    n_barrier_disp = 0
+    fused_iters = 0        # n_iters inside fused-flag barrier'd spans
+    barrier_iters = 0      # n_iters (or 1) of every barrier'd span
+    barrier_walls: List[float] = []
+    all_durs: List[float] = []
+    serve_recompiles = 0
+    # transfers
+    n_blocking_tr = n_nonblocking_tr = 0
+    transfer_s = 0.0
+    saw_transfer = False
+    # compile cache
+    cache_last = None
+    cache_new = 0
+    # wall clock envelope
+    t_min = t_end = None
+    # chunks / convergence
+    n_chunks = 0
+    lls: List[float] = []
+    noise_floor = None
+    below_floor = 0
+    dparams: List[float] = []
+    # pass-through sections
+    freezes: List[dict] = []
+    costs: dict = {}
+    fits: List[dict] = []
+    tenants: List[dict] = []
+    advice_last = None
+    n_advice = 0
+    # health / robustness
+    n_health = 0
+    health_kinds = set()
+    backoff_s_total = 0.0
+    n_retried = n_quar = n_recovered = 0
+    rb_tenant: dict = {}
+    rb_sess: dict = {}
+    # queries / sessions
+    n_queries = q_conv = q_div = 0
+    q_walls: List[float] = []
+    q_sessions: dict = {}
+    n_degraded = 0
+    degraded_sess: List[str] = []
+    n_fleet_q = 0
+    fleet_tenant: dict = {}
+    # fleet ticks
+    n_ticks = 0
+    occ: List[float] = []
+    tick_walls: List[float] = []
+    per_bucket: dict = {}
+
+    for e in _event_stream(events_or_path):
+        n_events += 1
+        record_event(reg, None, e)
+        t = e.get("t")
+        if isinstance(t, (int, float)):
+            tf = float(t)
+            end = tf + float(e.get("dur") or 0.0)
+            t_min = tf if t_min is None else min(t_min, tf)
+            t_end = end if t_end is None else max(t_end, end)
+        kind = e.get("kind")
+        if kind == "dispatch":
+            n_disp += 1
+            first = bool(e.get("first_call"))
+            n_first += first
+            n_recomp += bool(e.get("recompile"))
+            n_disp_err += bool(e.get("error"))
+            if (e.get("program") == "serve_update" and e.get("recompile")):
+                serve_recompiles += 1
+            p = by_prog.setdefault(e.get("program", "?"), {
+                "dispatches": 0, "first_calls": 0, "recompiles": 0,
+                "errors": 0, "keys": set(), "first_durs": [],
+                "steady_durs": [], "barrier_durs": [], "fused_iters": 0,
+                "bucketed": 0, "queue_depths": [], "fused_programs": 0})
+            p["dispatches"] += 1
+            p["keys"].add(e.get("key", ""))
+            if e.get("error"):
+                p["errors"] += 1
+            p["first_calls"] += first
+            p["recompiles"] += bool(e.get("recompile"))
+            p["bucketed"] += e.get("bucket") is not None
+            p["fused_programs"] += bool(e.get("fused"))
+            if e.get("queue_depth") is not None:
+                p["queue_depths"].append(int(e["queue_depth"]))
+            dur = e.get("dur")
+            if dur is not None:
+                (p["first_durs"] if first else p["steady_durs"]).append(dur)
+                all_durs.append(float(dur))
+                if e.get("barrier"):
+                    p["barrier_durs"].append(dur)
+                    p["fused_iters"] += int(e.get("n_iters") or 1)
+                    barrier_walls.append(float(dur))
             if e.get("barrier"):
-                p["barrier_durs"].append(dur)
-                p["fused_iters"] += int(e.get("n_iters") or 1)
+                n_barrier_disp += 1
+                barrier_iters += int(e.get("n_iters") or 1)
+                if e.get("fused"):
+                    fused_iters += int(e.get("n_iters") or 0)
+        elif kind == "transfer":
+            saw_transfer = True
+            if e.get("blocking"):
+                n_blocking_tr += 1
+            else:
+                n_nonblocking_tr += 1
+            transfer_s += float(e.get("dur") or 0.0)
+        elif kind == "chunk":
+            n_chunks += 1
+            lls.extend(float(x) for x in e.get("lls", []))
+            if noise_floor is None and e.get("noise_floor") is not None:
+                noise_floor = e.get("noise_floor")
+            below_floor += bool(e.get("below_floor"))
+            dparams.extend(float(x) for x in e.get("dparams", []))
+        elif kind == "compile_cache":
+            cache_last = e
+            cache_new += int(e.get("new_entries") or 0)
+        elif kind == "advice":
+            advice_last = e
+            n_advice += 1
+        elif kind == "freeze":
+            freezes.append({k: v for k, v in e.items() if k != "kind"})
+        elif kind == "cost":
+            costs[e.get("program", "?")] = {
+                k: v for k, v in e.items()
+                if k not in ("t", "kind", "program")}
+        elif kind == "fit":
+            fits.append({k: v for k, v in e.items() if k != "kind"})
+        elif kind == "tenant":
+            tenants.append({k: v for k, v in e.items() if k != "kind"})
+        elif kind == "query":
+            n_queries += 1
+            q_conv += bool(e.get("converged"))
+            q_div += bool(e.get("diverged"))
+            sid = str(e.get("session", "?"))
+            ps = q_sessions.setdefault(
+                sid, {"queries": 0, "walls": [], "t_rows": None})
+            ps["queries"] += 1
+            if isinstance(e.get("wall"), (int, float)):
+                ps["walls"].append(float(e["wall"]))
+                q_walls.append(float(e["wall"]))
+            if e.get("t_rows") is not None:
+                ps["t_rows"] = int(e["t_rows"])
+            if e.get("degraded"):
+                n_degraded += 1
+                degraded_sess.append(sid)
+            if e.get("queue_wait") is not None:
+                n_fleet_q += 1
+                pt = fleet_tenant.setdefault(str(e.get("tenant", "?")),
+                                             {"queries": 0, "waits": []})
+                pt["queries"] += 1
+                if isinstance(e.get("queue_wait"), (int, float)):
+                    pt["waits"].append(float(e["queue_wait"]))
+        elif kind == "tick":
+            n_ticks += 1
+            if (isinstance(e.get("n_active"), (int, float))
+                    and e.get("batch")):
+                occ.append(float(e["n_active"]) / float(e["batch"]))
+            if isinstance(e.get("wall"), (int, float)):
+                tick_walls.append(float(e["wall"]))
+            bid = str(e.get("bucket", "?"))
+            pb = per_bucket.setdefault(bid, {"ticks": 0, "occ": []})
+            pb["ticks"] += 1
+            if (isinstance(e.get("n_active"), (int, float))
+                    and e.get("batch")):
+                pb["occ"].append(float(e["n_active"]) / float(e["batch"]))
+        elif kind == "health":
+            n_health += 1
+            health_kinds.add(e.get("event", e.get("name", "?")))
+            backoff_s_total += float(e.get("backoff_s") or 0.0)
+            retried = (e.get("event") == "dispatch_error"
+                       and e.get("action") == "retried")
+            n_retried += retried
+            n_quar += e.get("event") == "quarantine"
+            n_recovered += (e.get("event") == "divergence"
+                            and e.get("action") in ("restored", "repaired"))
+            ten = e.get("tenant")
+            if ten:
+                pt = rb_tenant.setdefault(str(ten), {
+                    "events": 0, "retries": 0, "quarantined": False})
+                pt["events"] += 1
+                pt["retries"] += int(retried)
+                pt["quarantined"] |= e.get("event") == "quarantine"
+            sid = e.get("session")
+            if sid:
+                ps = rb_sess.setdefault(str(sid), {
+                    "events": 0, "retries": 0, "recovered_divergences": 0,
+                    "degraded_queries": 0})
+                ps["events"] += 1
+                ps["retries"] += int(retried)
+                ps["recovered_divergences"] += int(
+                    e.get("event") == "divergence"
+                    and e.get("action") in ("restored", "repaired"))
 
     programs = {}
     for name, p in sorted(by_prog.items()):
@@ -143,89 +348,54 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
                 1e3 * sum(p["barrier_durs"]) / p["fused_iters"])
         programs[name] = entry
 
-    chunks = [e for e in events if e.get("kind") == "chunk"]
     convergence = None
-    if chunks:
-        lls: List[float] = []
-        for c in chunks:
-            lls.extend(float(x) for x in c.get("lls", []))
+    if n_chunks:
         deltas = [lls[i + 1] - lls[i] for i in range(len(lls) - 1)]
-        nf = next((c.get("noise_floor") for c in chunks
-                   if c.get("noise_floor") is not None), None)
-        convergence = {"n_chunks": len(chunks), "n_iters": len(lls),
+        convergence = {"n_chunks": n_chunks, "n_iters": len(lls),
                        "loglik_first": lls[0] if lls else None,
                        "loglik_last": lls[-1] if lls else None,
-                       "deltas": deltas, "noise_floor": nf,
-                       "below_floor": sum(1 for c in chunks
-                                          if c.get("below_floor"))}
-        if nf is not None and deltas:
+                       "deltas": deltas, "noise_floor": noise_floor,
+                       "below_floor": below_floor}
+        if noise_floor is not None and deltas:
             convergence["deltas_below_floor"] = sum(
-                1 for d in deltas if abs(d) < nf)
+                1 for d in deltas if abs(d) < noise_floor)
         # Device-side per-iteration metrics (fit(progress=...) /
         # metrics-enabled chunks): max param-update norm per iteration.
-        dparams = [float(x) for c in chunks for x in c.get("dparams", [])]
         if dparams:
             convergence["dparams"] = dparams
             convergence["dparam_last"] = dparams[-1]
 
-    freezes = [e for e in events if e.get("kind") == "freeze"]
-    health = [e for e in events if e.get("kind") == "health"]
-    costs = {e.get("program", "?"): {k: v for k, v in e.items()
-                                     if k not in ("t", "kind", "program")}
-             for e in events if e.get("kind") == "cost"}
-    fits = [{k: v for k, v in e.items() if k != "kind"}
-            for e in events if e.get("kind") == "fit"]
-    # Multi-tenant scheduler (sched.submit / fit_jobs): one event per job
-    # with its bucket assignment and queue/compute/pad-waste accounting.
-    tenants = [{k: v for k, v in e.items() if k != "kind"}
-               for e in events if e.get("kind") == "tenant"]
-    # Streaming nowcast sessions (serve.NowcastSession): one event per
-    # query with its end-to-end wall, row counts and convergence flags.
-    queries = [{k: v for k, v in e.items() if k != "kind"}
-               for e in events if e.get("kind") == "query"]
-
     out = {
-        "n_events": len(events),
-        "dispatches": len(disp),
-        "first_calls": sum(1 for e in disp if e.get("first_call")),
-        "recompiles": sum(1 for e in disp if e.get("recompile")),
-        "dispatch_errors": sum(1 for e in disp if e.get("error")),
+        "schema_version": SCHEMA_VERSION,
+        "n_events": n_events,
+        "dispatches": n_disp,
+        "first_calls": n_first,
+        "recompiles": n_recomp,
+        "dispatch_errors": n_disp_err,
         "programs": programs,
     }
     # Execution barriers the host actually waited on: barrier'd dispatch
     # spans (transfer inside the span) + explicit blocking transfer events
     # (the pipelined drivers' one-pull-per-round).  The pipelining win is
     # this number dropping from n_chunks to ~n_chunks/depth.
-    transfers = [e for e in events if e.get("kind") == "transfer"]
-    out["blocking_transfers"] = (
-        sum(1 for e in disp if e.get("barrier"))
-        + sum(1 for e in transfers if e.get("blocking")))
+    out["blocking_transfers"] = n_barrier_disp + n_blocking_tr
     # While-loop (fused) fits: EM iterations that ran inside a single
     # dispatch span — the dispatch-free serving path's headline count.
-    fused_iters = sum(int(e.get("n_iters") or 0) for e in disp
-                      if e.get("fused"))
     if fused_iters:
         out["fused_iterations"] = fused_iters
-    if transfers:
-        out["nonblocking_transfers"] = sum(
-            1 for e in transfers if not e.get("blocking"))
-    cache_evs = [e for e in events if e.get("kind") == "compile_cache"]
-    if cache_evs:
-        last = cache_evs[-1]
+    if saw_transfer:
+        out["nonblocking_transfers"] = n_nonblocking_tr
+    if cache_last is not None:
         out["compile_cache"] = {
-            "dir": last.get("dir"), "entries": last.get("entries"),
-            "new_entries": sum(int(e.get("new_entries") or 0)
-                               for e in cache_evs)}
-    walls = [e["dur"] for e in disp
-             if e.get("dur") is not None and e.get("barrier")]
-    if walls:
-        out["barrier_dispatch_s"] = _stats(walls)
-        fused = sum(int(e.get("n_iters") or 1) for e in disp
-                    if e.get("barrier"))
-        out["amortized_ms_per_iter"] = 1e3 * sum(walls) / max(fused, 1)
+            "dir": cache_last.get("dir"),
+            "entries": cache_last.get("entries"),
+            "new_entries": cache_new}
+    if barrier_walls:
+        out["barrier_dispatch_s"] = _stats(barrier_walls)
+        out["amortized_ms_per_iter"] = (
+            1e3 * sum(barrier_walls) / max(barrier_iters, 1))
     # Latency percentiles over ALL timed dispatch spans (barrier'd or
     # enqueue-only) — the p50/p90/p99 the serving path will be scored on.
-    all_durs = [float(e["dur"]) for e in disp if e.get("dur") is not None]
     if all_durs:
         st = _stats(all_durs)
         out["dispatch_percentiles_ms"] = {
@@ -234,25 +404,17 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
     # Auto-tuning advisor: the last advice event wins (one per fit(auto=
     # True)); predicted-vs-realized wall is the model-drift metric that
     # obs.regress gates as ``advice_rel_err``.
-    advice_evs = [e for e in events if e.get("kind") == "advice"]
-    if advice_evs:
-        out["advice"] = {k: v for k, v in advice_evs[-1].items()
+    if advice_last is not None:
+        out["advice"] = {k: v for k, v in advice_last.items()
                          if k not in ("kind", "t")}
-        if len(advice_evs) > 1:
-            out["advice"]["n_events"] = len(advice_evs)
+        if n_advice > 1:
+            out["advice"]["n_events"] = n_advice
     # Total wall + per-phase breakdown: dispatch (device walls measured
     # behind a barrier or async enqueue), transfer (h2d/d2h walls), host
     # (everything else — python driver, numpy, event emission).
-    ts = [e["t"] for e in events
-          if isinstance(e.get("t"), (int, float))]
-    if ts:
-        end = max(e["t"] + float(e.get("dur") or 0.0) for e in events
-                  if isinstance(e.get("t"), (int, float)))
-        wall = max(end - min(ts), 0.0)
-        dispatch_s = sum(float(e["dur"]) for e in disp
-                         if e.get("dur") is not None)
-        transfer_s = sum(float(e.get("dur") or 0.0) for e in events
-                         if e.get("kind") == "transfer")
+    if t_min is not None:
+        wall = max(t_end - t_min, 0.0)
+        dispatch_s = sum(all_durs)
         out["wall_s"] = wall
         out["phases"] = {
             "dispatch_s": dispatch_s, "transfer_s": transfer_s,
@@ -260,164 +422,92 @@ def summarize(events_or_path: Union[str, List[dict]]) -> dict:
     if convergence is not None:
         out["convergence"] = convergence
     if freezes:
-        out["freezes"] = [{k: v for k, v in e.items() if k != "kind"}
-                          for e in freezes]
-    if health:
-        out["health_events"] = len(health)
-        out["health_kinds"] = sorted({e.get("event", e.get("name", "?"))
-                                      for e in health})
+        out["freezes"] = freezes
+    if n_health:
+        out["health_events"] = n_health
+        out["health_kinds"] = sorted(health_kinds)
     if costs:
         out["costs"] = costs
     if fits:
         out["fits"] = fits
-    if tenants:
-        waits = [float(t["queue_wait_s"]) for t in tenants
-                 if isinstance(t.get("queue_wait_s"), (int, float))]
-        wastes = [float(t["pad_waste_frac"]) for t in tenants
-                  if isinstance(t.get("pad_waste_frac"), (int, float))]
-        out["tenants"] = tenants
-        out["tenant_fairness"] = {
-            "n_tenants": len(tenants),
-            "n_buckets": len({t.get("bucket") for t in tenants}),
-            "converged": sum(1 for t in tenants if t.get("converged")),
-            "queue_wait_s": _stats(waits),
-            "pad_waste_frac_mean": (sum(wastes) / len(wastes)
-                                    if wastes else None)}
-    if queries:
-        per_session: dict = {}
-        for q in queries:
-            sid = str(q.get("session", "?"))
-            ps = per_session.setdefault(
-                sid, {"queries": 0, "walls": [], "t_rows": None})
-            ps["queries"] += 1
-            if isinstance(q.get("wall"), (int, float)):
-                ps["walls"].append(float(q["wall"]))
-            if q.get("t_rows") is not None:
-                ps["t_rows"] = int(q["t_rows"])
-        for ps in per_session.values():
-            st = _stats(ps.pop("walls"))
-            if st:
-                ps["query_wall_s"] = st
-        walls = [float(q["wall"]) for q in queries
-                 if isinstance(q.get("wall"), (int, float))]
-        # Warm-path health: any serve_update recompile past each
-        # executable's first call means the session's one-program promise
-        # broke (shape drift / cache eviction) — should be 0.
-        out["queries"] = {
-            "n_queries": len(queries),
-            "n_sessions": len(per_session),
-            "converged": sum(1 for q in queries if q.get("converged")),
-            "diverged": sum(1 for q in queries if q.get("diverged")),
-            "query_wall_s": _stats(walls),
-            "recompiles_after_warmup": sum(
-                1 for e in disp if e.get("program") == "serve_update"
-                and e.get("recompile")),
-            "per_session": per_session,
-        }
+    # -- stable sections (always present, empty or not) ------------------
+    # Multi-tenant scheduler (sched.submit / fit_jobs): one event per job
+    # with its bucket assignment and queue/compute/pad-waste accounting.
+    waits = [float(t["queue_wait_s"]) for t in tenants
+             if isinstance(t.get("queue_wait_s"), (int, float))]
+    wastes = [float(t["pad_waste_frac"]) for t in tenants
+              if isinstance(t.get("pad_waste_frac"), (int, float))]
+    out["tenants"] = tenants
+    out["tenant_fairness"] = {
+        "n_tenants": len(tenants),
+        "n_buckets": len({t.get("bucket") for t in tenants}),
+        "converged": sum(1 for t in tenants if t.get("converged")),
+        "queue_wait_s": _stats(waits),
+        "pad_waste_frac_mean": (sum(wastes) / len(wastes)
+                                if wastes else None)}
+    # Streaming nowcast sessions (serve.NowcastSession): one event per
+    # query with its end-to-end wall, row counts and convergence flags.
+    # Warm-path health: any serve_update recompile past each executable's
+    # first call means the session's one-program promise broke (shape
+    # drift / cache eviction) — should be 0.
+    for ps in q_sessions.values():
+        st = _stats(ps.pop("walls"))
+        if st:
+            ps["query_wall_s"] = st
+    out["queries"] = {
+        "n_queries": n_queries,
+        "n_sessions": len(q_sessions),
+        "converged": q_conv,
+        "diverged": q_div,
+        "query_wall_s": _stats(q_walls),
+        "recompiles_after_warmup": serve_recompiles,
+        "per_session": q_sessions,
+    }
     # Fleet serving (fleet.SessionFleet): one event per drained tick with
     # the bucket's occupancy (active lanes / batch width), plus queue-wait
     # accounting on the per-tenant query events.  Queries-per-dispatch is
     # the multiplexing win itself: how many tenant answers each fused
     # batched serve_update dispatch produced.
-    ticks = [{k: v for k, v in e.items() if k != "kind"}
-             for e in events if e.get("kind") == "tick"]
-    if ticks:
-        occ = [float(t["n_active"]) / float(t["batch"]) for t in ticks
-               if isinstance(t.get("n_active"), (int, float))
-               and t.get("batch")]
-        tick_walls = [float(t["wall"]) for t in ticks
-                      if isinstance(t.get("wall"), (int, float))]
-        fleet_q = [q for q in queries if q.get("queue_wait") is not None]
-        per_tenant_q: dict = {}
-        for q in fleet_q:
-            pt = per_tenant_q.setdefault(str(q.get("tenant", "?")),
-                                         {"queries": 0, "waits": []})
-            pt["queries"] += 1
-            if isinstance(q.get("queue_wait"), (int, float)):
-                pt["waits"].append(float(q["queue_wait"]))
-        for pt in per_tenant_q.values():
-            st = _stats(pt.pop("waits"))
-            if st:
-                pt["queue_wait_s"] = st
-        per_bucket: dict = {}
-        for t in ticks:
-            bid = str(t.get("bucket", "?"))
-            pb = per_bucket.setdefault(bid, {"ticks": 0, "occ": []})
-            pb["ticks"] += 1
-            if (isinstance(t.get("n_active"), (int, float))
-                    and t.get("batch")):
-                pb["occ"].append(float(t["n_active"]) / float(t["batch"]))
-        for pb in per_bucket.values():
-            os_ = pb.pop("occ")
-            if os_:
-                pb["occupancy_mean"] = sum(os_) / len(os_)
-        out["fleet"] = {
-            "n_ticks": len(ticks),
-            "n_buckets": len(per_bucket),
-            "n_queries": len(fleet_q),
-            "queries_per_dispatch": len(fleet_q) / len(ticks),
-            "occupancy_mean": (sum(occ) / len(occ)) if occ else None,
-            "tick_wall_s": _stats(tick_walls),
-            "per_bucket": per_bucket,
-            "per_tenant": per_tenant_q,
-        }
+    for pt in fleet_tenant.values():
+        st = _stats(pt.pop("waits"))
+        if st:
+            pt["queue_wait_s"] = st
+    for pb in per_bucket.values():
+        os_ = pb.pop("occ")
+        if os_:
+            pb["occupancy_mean"] = sum(os_) / len(os_)
+    out["fleet"] = {
+        "n_ticks": n_ticks,
+        "n_buckets": len(per_bucket),
+        "n_queries": n_fleet_q,
+        "queries_per_dispatch": (n_fleet_q / n_ticks) if n_ticks else None,
+        "occupancy_mean": (sum(occ) / len(occ)) if occ else None,
+        "tick_wall_s": _stats(tick_walls),
+        "per_bucket": per_bucket,
+        "per_tenant": fleet_tenant,
+    }
     # Serving-grade fault tolerance (robust.dispatch / sched quarantine /
     # self-healing sessions): the guard's forensic trail aggregated next
     # to the fairness/queries tables — retries + backoff paid, tenants
     # quarantined out of their buckets, divergences the repair ladder
-    # recovered, and queries answered in degraded mode.  Absent entirely
-    # on a clean trace.
-    degraded = [q for q in queries if q.get("degraded")]
-    if health or degraded:
-        retried = [e for e in health if e.get("event") == "dispatch_error"
-                   and e.get("action") == "retried"]
-        rb = {
-            "dispatch_retries": len(retried),
-            "backoff_s_total": sum(float(e.get("backoff_s") or 0.0)
-                                   for e in health),
-            "quarantines": sum(1 for e in health
-                               if e.get("event") == "quarantine"),
-            "recovered_divergences": sum(
-                1 for e in health if e.get("event") == "divergence"
-                and e.get("action") in ("restored", "repaired")),
-            "degraded_queries": len(degraded),
-        }
-        per_tenant: dict = {}
-        for e in health:
-            t = e.get("tenant")
-            if not t:
-                continue
-            pt = per_tenant.setdefault(str(t), {
-                "events": 0, "retries": 0, "quarantined": False})
-            pt["events"] += 1
-            pt["retries"] += int(e.get("event") == "dispatch_error"
-                                 and e.get("action") == "retried")
-            pt["quarantined"] |= e.get("event") == "quarantine"
-        per_sess: dict = {}
-
-        def _sess(sid):
-            return per_sess.setdefault(str(sid), {
-                "events": 0, "retries": 0, "recovered_divergences": 0,
-                "degraded_queries": 0})
-
-        for e in health:
-            sid = e.get("session")
-            if not sid:
-                continue
-            ps = _sess(sid)
-            ps["events"] += 1
-            ps["retries"] += int(e.get("event") == "dispatch_error"
-                                 and e.get("action") == "retried")
-            ps["recovered_divergences"] += int(
-                e.get("event") == "divergence"
-                and e.get("action") in ("restored", "repaired"))
-        for q in degraded:
-            _sess(q.get("session", "?"))["degraded_queries"] += 1
-        if per_tenant:
-            rb["per_tenant"] = per_tenant
-        if per_sess:
-            rb["per_session"] = per_sess
-        out["robustness"] = rb
+    # recovered, and queries answered in degraded mode.
+    for sid in degraded_sess:
+        ps = rb_sess.setdefault(str(sid), {
+            "events": 0, "retries": 0, "recovered_divergences": 0,
+            "degraded_queries": 0})
+        ps["degraded_queries"] += 1
+    out["robustness"] = {
+        "dispatch_retries": n_retried,
+        "backoff_s_total": backoff_s_total,
+        "quarantines": n_quar,
+        "recovered_divergences": n_recovered,
+        "degraded_queries": n_degraded,
+        "per_tenant": rb_tenant,
+        "per_session": rb_sess,
+    }
+    # The live-plane digest: the same record_event mapping obs.live runs
+    # in-process, replayed over this trace.
+    out["metrics"] = metrics_summary(reg)
     return out
 
 
@@ -455,6 +545,11 @@ def _print_text(s: dict) -> None:
         print(f"compile cache: {cc.get('entries')} entries at "
               f"{cc.get('dir')} ({cc.get('new_entries')} new this trace"
               f"{'' if cc.get('new_entries') else ' — warm'})")
+    m = s.get("metrics")
+    if m and m.get("n_series"):
+        print(f"metrics: {m['n_series']} live series "
+              f"({len(m.get('counters', {}))} counters, "
+              f"{len(m.get('histograms', {}))} quantile series)")
     for name, p in s.get("programs", {}).items():
         line = (f"  {name}: {p['dispatches']} dispatch"
                 f"{'es' if p['dispatches'] != 1 else ''}, "
@@ -506,7 +601,10 @@ def _print_text(s: dict) -> None:
         print(f"health: {s['health_events']} events "
               f"({', '.join(s['health_kinds'])})")
     rb = s.get("robustness")
-    if rb:
+    if rb and (rb["dispatch_retries"] or rb["quarantines"]
+               or rb["recovered_divergences"] or rb["degraded_queries"]
+               or rb["backoff_s_total"] or rb["per_tenant"]
+               or rb["per_session"]):
         n = rb["dispatch_retries"]
         line = (f"robustness: {n} dispatch retr{'y' if n == 1 else 'ies'} "
                 f"({_fmt_s(rb['backoff_s_total'])} backoff), "
@@ -544,7 +642,7 @@ def _print_text(s: dict) -> None:
                 for k, v in f.items() if k != "t"]
         print(f"  fit: {' '.join(bits)}")
     tf = s.get("tenant_fairness")
-    if tf:
+    if tf and tf["n_tenants"]:
         qw = tf.get("queue_wait_s") or {}
         line = (f"tenants: {tf['n_tenants']} across {tf['n_buckets']} "
                 f"bucket{'s' if tf['n_buckets'] != 1 else ''}, "
@@ -572,7 +670,7 @@ def _print_text(s: dict) -> None:
             bits.append("converged" if t.get("converged") else "NOT converged")
             print(", ".join(bits))
     qs = s.get("queries")
-    if qs:
+    if qs and qs["n_queries"]:
         qw = qs.get("query_wall_s") or {}
         line = (f"queries: {qs['n_queries']} across {qs['n_sessions']} "
                 f"session{'s' if qs['n_sessions'] != 1 else ''}, "
@@ -597,7 +695,7 @@ def _print_text(s: dict) -> None:
                             f"p99 {_fmt_s(pw['p99'])}")
             print(", ".join(bits))
     fl = s.get("fleet")
-    if fl:
+    if fl and fl["n_ticks"]:
         tw = fl.get("tick_wall_s") or {}
         line = (f"fleet: {fl['n_queries']} queries over {fl['n_ticks']} "
                 f"tick{'s' if fl['n_ticks'] != 1 else ''} in "
@@ -701,8 +799,10 @@ def to_chrome(events: List[dict]) -> dict:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dfm_tpu.obs.report",
-        description="Summarize a DFM_TRACE JSONL trace.")
-    ap.add_argument("trace", help="path to a trace.jsonl file")
+        description="Summarize a DFM_TRACE JSONL trace (or several rotated "
+                    "files, oldest first — pass them in order).")
+    ap.add_argument("trace", nargs="+",
+                    help="path(s) to trace.jsonl files, oldest first")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object")
     ap.add_argument("--chrome", default=None, metavar="OUT.json",
@@ -715,15 +815,19 @@ def main(argv=None) -> int:
                          "registry run_id) via obs.regress; exits nonzero "
                          "on a perf/convergence regression")
     args = ap.parse_args(argv)
+    paths = list(args.trace)
     if args.chrome is not None:
-        trace = to_chrome(load(args.trace))
+        events: List[dict] = []
+        for p in paths:
+            events.extend(iter_events(p))
+        trace = to_chrome(events)
         with open(args.chrome, "w", encoding="utf-8") as fh:
             json.dump(trace, fh, default=str)
         n = sum(1 for e in trace["traceEvents"] if e.get("ph") != "M")
         print(f"chrome trace: {n} events -> {args.chrome}", file=sys.stderr)
-    s = summarize(args.trace)
+    s = summarize(paths[0] if len(paths) == 1 else paths)
     if args.diff is not None:
-        return _diff(s, args.trace, args.diff, as_json=args.json)
+        return _diff(s, paths[0], args.diff, as_json=args.json)
     if args.json:
         json.dump(s, sys.stdout, indent=2, default=str)
         print()

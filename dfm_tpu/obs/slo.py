@@ -1,0 +1,197 @@
+"""SLO objectives and rolling error-budget burn-rate evaluation (jax-free).
+
+``SLOConfig`` declares the objective — "p99 latency below ``p99_ms`` with
+at most ``latency_budget`` of queries over it, and an error rate below
+``error_rate``, evaluated over a rolling ``window`` seconds".  The
+``SLOMonitor`` consumes (t, wall_ms, error) observations — timestamps the
+trace layer already takes, so the serving path gains no clock reads — and
+maintains the burn rate:
+
+    burn = max(frac_over_latency / latency_budget,
+               frac_errors / error_rate)
+
+burn == 1.0 means the budget is being spent exactly as fast as the SLO
+allows; > 1.0 means the budget is burning down.  The monitor is a
+hysteresis state machine: it FIRES when burn > ``fire_at`` with at least
+``min_events`` observations in the window, and CLEARS when burn drops
+below ``clear_at``.  Both transitions are returned to the caller (the
+live plane emits ``HealthEvent(kind="slo_burn")`` / flight-recorder dumps
+on them).
+
+``AnomalyDetector`` is the objective-free companion: it tracks a slow
+EMA baseline of the windowed p99 and flags a spike when the current p99
+exceeds ``spike_ratio`` times the baseline — catching latency regressions
+long before a generous SLO notices.
+
+Deterministic by construction: both are pure functions of the observation
+sequence (no internal clock reads, no randomness).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from collections import deque
+from typing import Optional
+
+__all__ = ["SLOConfig", "SLOMonitor", "AnomalyDetector", "slo_from_env"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Serving SLO: latency objective + error budget over a rolling window."""
+
+    p99_ms: float = 1000.0        # latency objective per query
+    error_rate: float = 0.01      # allowed fraction of failed queries
+    window: float = 60.0          # rolling window, seconds (monotonic time)
+    latency_budget: float = 0.01  # allowed fraction of queries over p99_ms
+    min_events: int = 10          # don't evaluate on fewer observations
+    fire_at: float = 1.0          # burn rate that trips the SLO
+    clear_at: float = 0.5         # hysteresis: burn rate that clears it
+
+
+def slo_from_env() -> Optional[SLOConfig]:
+    """SLOConfig from DFM_SLO_P99_MS / DFM_SLO_ERROR_RATE / DFM_SLO_WINDOW,
+    or None when no DFM_SLO_* variable is set (monitor disarmed)."""
+    p99 = os.environ.get("DFM_SLO_P99_MS")
+    err = os.environ.get("DFM_SLO_ERROR_RATE")
+    win = os.environ.get("DFM_SLO_WINDOW")
+    if p99 is None and err is None and win is None:
+        return None
+    base = SLOConfig()
+    return SLOConfig(
+        p99_ms=float(p99) if p99 else base.p99_ms,
+        error_rate=float(err) if err else base.error_rate,
+        window=float(win) if win else base.window)
+
+
+class SLOMonitor:
+    """Rolling-window burn-rate evaluation with fire/clear hysteresis.
+
+    ``observe`` returns ``"fire"`` on the breach transition, ``"clear"``
+    on recovery, else None.  An unarmed monitor (``config is None``)
+    observes nothing and reports burn 0.0 — the always-on plane stays
+    zero-cost until someone declares an objective.
+    """
+
+    def __init__(self, config: Optional[SLOConfig] = None):
+        self.config = config
+        self.breached = False
+        self.burn_rate = 0.0
+        self.burn_rate_max = 0.0
+        self.n_fired = 0
+        self._win: deque = deque()   # (t, bad_latency, bad_error)
+
+    @property
+    def armed(self) -> bool:
+        return self.config is not None
+
+    def set_config(self, config: Optional[SLOConfig]) -> None:
+        self.config = config
+        self._win.clear()
+        self.breached = False
+        self.burn_rate = 0.0
+
+    def observe(self, t: float, wall_ms: float,
+                error: bool = False) -> Optional[str]:
+        cfg = self.config
+        if cfg is None:
+            return None
+        self._win.append((float(t), wall_ms > cfg.p99_ms, bool(error)))
+        horizon = float(t) - cfg.window
+        while self._win and self._win[0][0] < horizon:
+            self._win.popleft()
+        n = len(self._win)
+        if n < cfg.min_events:
+            self.burn_rate = 0.0
+            return None
+        n_lat = sum(1 for _, bl, _e in self._win if bl)
+        n_err = sum(1 for _, _bl, e in self._win if e)
+        burn = max(
+            (n_lat / n) / cfg.latency_budget if cfg.latency_budget > 0
+            else (math.inf if n_lat else 0.0),
+            (n_err / n) / cfg.error_rate if cfg.error_rate > 0
+            else (math.inf if n_err else 0.0))
+        self.burn_rate = burn
+        if burn > self.burn_rate_max:
+            self.burn_rate_max = burn
+        if not self.breached and burn > cfg.fire_at:
+            self.breached = True
+            self.n_fired += 1
+            return "fire"
+        if self.breached and burn < cfg.clear_at:
+            self.breached = False
+            return "clear"
+        return None
+
+    def status(self) -> dict:
+        cfg = self.config
+        return {
+            "armed": self.armed,
+            "breached": self.breached,
+            "burn_rate": round(self.burn_rate, 6),
+            "burn_rate_max": round(self.burn_rate_max, 6),
+            "n_fired": self.n_fired,
+            "n_window": len(self._win),
+            "p99_ms": cfg.p99_ms if cfg else None,
+            "error_rate": cfg.error_rate if cfg else None,
+            "window_s": cfg.window if cfg else None,
+        }
+
+
+class AnomalyDetector:
+    """Latency-spike detector: windowed p99 vs a slow EMA baseline.
+
+    Keeps the last ``window_n`` walls (bounded deque); after ``warmup``
+    observations, flags a spike when the current window p99 exceeds
+    ``spike_ratio`` x the EMA baseline (and the baseline only absorbs
+    non-spiking windows, so a sustained regression keeps firing the
+    detector rather than normalizing it away).  Returns True from
+    ``observe`` on the spike *transition*.
+    """
+
+    def __init__(self, window_n: int = 64, warmup: int = 20,
+                 spike_ratio: float = 3.0, alpha: float = 0.05,
+                 floor_ms: float = 1.0):
+        self.window_n = int(window_n)
+        self.warmup = int(warmup)
+        self.spike_ratio = float(spike_ratio)
+        self.alpha = float(alpha)
+        self.floor_ms = float(floor_ms)
+        self.baseline_ms: Optional[float] = None
+        self.spiking = False
+        self.n_spikes = 0
+        self.n = 0
+        self._walls: deque = deque(maxlen=self.window_n)
+
+    def _p99(self) -> float:
+        xs = sorted(self._walls)
+        rank = max(1, int(math.ceil(0.99 * len(xs) - 1e-9)))
+        return xs[rank - 1]
+
+    def observe(self, wall_ms: float) -> bool:
+        self.n += 1
+        self._walls.append(float(wall_ms))
+        if self.n < self.warmup:
+            return False
+        p99 = self._p99()
+        if self.baseline_ms is None:
+            self.baseline_ms = p99
+            return False
+        threshold = max(self.floor_ms, self.spike_ratio * self.baseline_ms)
+        spike = p99 > threshold
+        if not spike:
+            self.baseline_ms += self.alpha * (p99 - self.baseline_ms)
+        fired = spike and not self.spiking
+        self.spiking = spike
+        if fired:
+            self.n_spikes += 1
+        return fired
+
+    def status(self) -> dict:
+        return {"baseline_ms": (round(self.baseline_ms, 6)
+                                if self.baseline_ms is not None else None),
+                "spiking": self.spiking,
+                "n_spikes": self.n_spikes,
+                "n_observed": self.n}

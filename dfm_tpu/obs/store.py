@@ -44,7 +44,8 @@ RUNS_FILE = "runs.jsonl"
 _LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
                          "compile_s", "dispatch_s", "transfer_s", "host_s",
                          "rel_err", "blocking_transfers",
-                         "dispatches_per_fit", "pad_waste", "degraded")
+                         "dispatches_per_fit", "pad_waste", "degraded",
+                         "slo_burn_rate", "flight_dumps")
 
 
 def lower_is_better(metric: str) -> bool:
@@ -66,6 +67,10 @@ _NOISE_FLOORS = (
     # fraction, not seconds): the planner's DP is deterministic, but the
     # job mix itself varies with bench env knobs — a 2-point move is noise.
     ("pad_waste", 0.02),
+    # SLO burn is a ratio of p99 latency to budget: scheduler jitter on
+    # the shared CI box moves it by tenths without any code-level signal.
+    ("slo_burn_rate", 0.25),
+    ("flight_dumps", 0.5),   # integer count; any single dump is signal
     ("ms", 2.0),           # milliseconds: ms_per, _ms, dispatch_ms_...
     ("_s", 0.05),          # seconds: wall_s, dispatch_s, compile_s, time_s
     ("secs", 0.05),
@@ -269,6 +274,10 @@ _BENCH_NUMERIC_KEYS = (
     # (higher-is-better, no floor); the p99 latency and the admission
     # plan's pad waste ride the "_ms" / "pad_waste" marker rows above.
     "fleet_qps", "fleet_p99_ms", "fleet_pad_waste_frac",
+    # Live telemetry plane (obs.live): SLO error-budget burn observed
+    # during the bench, and flight-recorder dumps triggered by it —
+    # both ~0 on a healthy run (lower-is-better, floors above).
+    "fleet_slo_burn_rate", "flight_dumps",
 )
 
 

@@ -98,11 +98,22 @@ class Tracer:
         Recompile detector; defaults to the process-local singleton so
         "first_call" / "recompile" reflect the process's real compile
         cache, not this tracer's lifetime.  Tests inject a fresh one.
+    max_bytes:
+        Size-capped rotation for the JSONL file: when the file exceeds
+        this many bytes after a write, it is rotated shift-style
+        (``path`` -> ``path.1`` -> ... -> ``path.<keep>``, oldest
+        dropped) and a fresh file is opened.  Off (None) by default — a
+        soak sets ``DFM_TRACE_MAX_MB`` and ``obs.report`` accepts the
+        rotated files in order.  Rotation caps the FILE only; the
+        in-memory ``events`` list semantics are unchanged.
+    keep:
+        How many rotated-out files to retain (default 3).
     """
 
     def __init__(self, path: Optional[str] = None,
                  capture_costs: Optional[bool] = None,
-                 detector: Optional[RecompileDetector] = None):
+                 detector: Optional[RecompileDetector] = None,
+                 max_bytes: Optional[int] = None, keep: int = 3):
         self.path = path
         self.events: List[dict] = []
         self.capture_costs = (os.environ.get("DFM_TRACE_COST") == "1"
@@ -112,6 +123,9 @@ class Tracer:
         self._lock = threading.Lock()
         self._depth = 0          # dispatch-span reentrancy (see dispatch())
         self._costed = set()     # (program, key) pairs already cost-captured
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        self.keep = max(1, int(keep))
+        self.rotations = 0
         if path:
             self._fh = open(path, "a", encoding="utf-8")
 
@@ -125,7 +139,31 @@ class Tracer:
             if self._fh is not None:
                 self._fh.write(json.dumps(ev, default=_json_default) + "\n")
                 self._fh.flush()
+                if (self.max_bytes is not None
+                        and self._fh.tell() > self.max_bytes):
+                    self._rotate_locked()
+        # Feed the always-on live plane AFTER releasing the (non-reentrant)
+        # lock: the plane may mirror slo_burn events back through this
+        # tracer, and its own reentrancy guard drops those echoes.  Lazy
+        # import (sys.modules hit after the first call) so ``python -m
+        # dfm_tpu.obs.live`` doesn't double-import its own module.
+        from . import live as _live
+        _live.observe(ev)
         return ev
+
+    def _rotate_locked(self) -> None:
+        """Shift-rotate the JSONL file (caller holds ``self._lock``)."""
+        self._fh.close()
+        last = f"{self.path}.{self.keep}"
+        if os.path.exists(last):
+            os.remove(last)
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self.rotations += 1
 
     @contextmanager
     def dispatch(self, program: str, key: str, *, barrier: bool = False,
@@ -237,7 +275,12 @@ def _ambient() -> Optional[Tracer]:
     global _env_tracer
     if _env_tracer is _ENV_SENTINEL:
         path = os.environ.get("DFM_TRACE")
-        _env_tracer = Tracer(path) if path else None
+        if path:
+            mb = os.environ.get("DFM_TRACE_MAX_MB")
+            max_bytes = int(float(mb) * 1e6) if mb else None
+            _env_tracer = Tracer(path, max_bytes=max_bytes)
+        else:
+            _env_tracer = None
     return _env_tracer
 
 

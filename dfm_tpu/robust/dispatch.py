@@ -22,7 +22,11 @@ AND the blocking d2h read, and this wrapper supplies
   gives one-shot programs the same chaos-testing surface
   ``policy.wrap_scan`` gives the chunked loop;
 - ``HealthEvent`` records carrying tenant/session attribution and the
-  backoff charged before each retry.
+  backoff charged before each retry.  Every record flows through
+  ``FitHealth.record``, which mirrors it to the active tracer OR (when
+  untraced) straight to the always-on live metrics plane
+  (``obs.live``) — retries/backoff/quarantines are metered even with
+  telemetry off.
 
 ``policy=None`` short-circuits to ``call(0)`` — the off path adds no
 wrapper, no thread, no payload keys, keeping default trajectories and

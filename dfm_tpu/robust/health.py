@@ -96,20 +96,30 @@ class FitHealth:
         if emit:
             from ..obs.trace import current_tracer
             tr = current_tracer()
+            extra = {}
+            # Attribution/backoff keys ride along only when set, so
+            # pre-existing trace payloads stay byte-identical.
+            if event.tenant:
+                extra["tenant"] = event.tenant
+            if event.session:
+                extra["session"] = event.session
+            if event.backoff_s:
+                extra["backoff_s"] = event.backoff_s
             if tr is not None:
-                extra = {}
-                # Attribution/backoff keys ride along only when set, so
-                # pre-existing trace payloads stay byte-identical.
-                if event.tenant:
-                    extra["tenant"] = event.tenant
-                if event.session:
-                    extra["session"] = event.session
-                if event.backoff_s:
-                    extra["backoff_s"] = event.backoff_s
                 tr.emit("health", t=event.t, event=event.kind,
                         chunk=event.chunk, iteration=event.iteration,
                         action=event.action, detail=event.detail,
                         engine=event.engine, **extra)
+            else:
+                # Untraced: the always-on live plane still accounts for
+                # retries/quarantines (same payload the tracer mirrors).
+                from ..obs.live import observe as live_observe
+                live_observe({"t": event.t, "kind": "health",
+                              "event": event.kind, "chunk": event.chunk,
+                              "iteration": event.iteration,
+                              "action": event.action,
+                              "detail": event.detail,
+                              "engine": event.engine, **extra})
         return event
 
     def escalate(self, action: str) -> None:

@@ -44,6 +44,12 @@ from .jobs import Job, JobResult
 __all__ = ["submit", "registry_cost_model"]
 
 
+def live_observe(ev: dict) -> None:
+    """Feed the always-on live plane (lazy import, see serve.session)."""
+    from ..obs.live import observe
+    observe(ev)
+
+
 def registry_cost_model(runs: Optional[str] = None,
                         device: Optional[str] = None) -> CostModel:
     """The scheduler's default planner input: a ``CostModel`` calibrated
@@ -144,12 +150,15 @@ def _requeue_quarantined(job: Job, tenant: str, bucket: int, reason: str,
     h.events.insert(0, ev)
     wall = time.perf_counter() - t0
     T_j, N_j, k_j = shape
+    tev = dict(tenant=tenant, bucket=bucket, T=T_j, N=N_j, k=k_j,
+               bucket_T=T_j, bucket_N=N_j, bucket_k=k_j,
+               queue_wait_s=float(queue_wait), compute_s=float(wall),
+               pad_waste_frac=0.0, n_iters=int(len(f.logliks)),
+               converged=bool(f.converged), quarantined=True)
     if tr is not None:
-        tr.emit("tenant", tenant=tenant, bucket=bucket, T=T_j, N=N_j, k=k_j,
-                bucket_T=T_j, bucket_N=N_j, bucket_k=k_j,
-                queue_wait_s=float(queue_wait), compute_s=float(wall),
-                pad_waste_frac=0.0, n_iters=int(len(f.logliks)),
-                converged=bool(f.converged), quarantined=True)
+        tr.emit("tenant", **tev)
+    else:
+        live_observe({"t": t0 + wall, "kind": "tenant", **tev})
     return JobResult(tenant=tenant, fit=f, bucket=bucket,
                      shape=(T_j, N_j, k_j), queue_wait_s=float(queue_wait),
                      compute_s=float(wall), pad_waste_frac=0.0)
@@ -341,15 +350,19 @@ def submit(jobs: Sequence[Job], *, backend: str = "tpu",
                     for hev in fit.health.events:
                         if not hev.tenant:
                             hev.tenant = tenant
+                tev = dict(tenant=tenant, bucket=bi,
+                           T=T_j, N=N_j, k=k_j,
+                           bucket_T=T_b, bucket_N=N_b, bucket_k=k_b,
+                           queue_wait_s=float(queue_wait),
+                           compute_s=float(compute_s),
+                           pad_waste_frac=float(waste),
+                           n_iters=int(len(lls)),
+                           converged=bool(conv[slot]))
                 if tr is not None:
-                    tr.emit("tenant", tenant=tenant, bucket=bi,
-                            T=T_j, N=N_j, k=k_j,
-                            bucket_T=T_b, bucket_N=N_b, bucket_k=k_b,
-                            queue_wait_s=float(queue_wait),
-                            compute_s=float(compute_s),
-                            pad_waste_frac=float(waste),
-                            n_iters=int(len(lls)),
-                            converged=bool(conv[slot]))
+                    tr.emit("tenant", **tev)
+                else:
+                    live_observe({"t": t_launch + compute_s,
+                                  "kind": "tenant", **tev})
                 results[i] = JobResult(
                     tenant=tenant, fit=fit, bucket=bi,
                     shape=(T_j, N_j, k_j),

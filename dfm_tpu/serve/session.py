@@ -77,6 +77,18 @@ __all__ = ["NowcastSession", "SessionUpdate", "open_session"]
 _SESSION_IDS = itertools.count(1)
 
 
+def live_observe(ev: dict) -> None:
+    """Feed the always-on live plane (lazy import: keeps ``python -m
+    dfm_tpu.obs.live`` from pre-importing its own module via this one)."""
+    from ..obs.live import observe
+    observe(ev)
+
+
+def _live_accounting(session: str) -> dict:
+    from ..obs.live import accounting
+    return accounting(session)
+
+
 def _session_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol, floor,
                   cfg, max_iters, chunk, opts):
     """One query: append rows, m warm EM iters, smooth, nowcast/forecast.
@@ -434,14 +446,21 @@ class NowcastSession:
         else:
             self._p = out["p"]
             self._div_run = 0
+        degraded = bool(diverged or repaired)
+        qev = dict(session=self._sid, t_rows=int(t_new),
+                   n_new=int(n_new), wall=wall,
+                   n_iters=int(host["n_iters"]),
+                   N=int(self._N), k=int(self._model.n_factors),
+                   converged=bool(host["status"] == _CONVERGED),
+                   diverged=bool(diverged),
+                   **({"degraded": True} if degraded else {}))
         if tr is not None:
-            degraded = bool(diverged or repaired)
-            tr.emit("query", session=self._sid, t_rows=int(t_new),
-                    n_new=int(n_new), wall=wall,
-                    n_iters=int(host["n_iters"]),
-                    converged=bool(host["status"] == _CONVERGED),
-                    diverged=bool(diverged),
-                    **({"degraded": True} if degraded else {}))
+            tr.emit("query", **qev)
+        else:
+            # Untraced serving still feeds the always-on live plane from
+            # the timestamps this method already took — same event dict,
+            # zero extra dispatches/transfers/clock reads.
+            live_observe({"t": t0 + wall, "kind": "query", **qev})
         inv = (self._std.inverse if self._std is not None
                else (lambda a: a))
         di = host["di"]
@@ -515,6 +534,15 @@ class NowcastSession:
             detail=(f"{self._div_run} consecutive diverged updates; "
                     "repaired resident params and re-uploaded")))
         self._div_run = 0
+
+    # -- accounting ----------------------------------------------------
+    def accounting(self) -> dict:
+        """This session's live-plane resource ledger: queries answered,
+        attributed device-wall ms, EM iterations, estimated flops
+        (``obs.cost.em_iter_work``), retries and degraded counts — always
+        on, accumulated host-side with zero extra dispatches.  Keyed by
+        tenant (a lone session accounts under its own session id)."""
+        return _live_accounting(self._sid)
 
     # -- durability ----------------------------------------------------
     def snapshot(self, path: str) -> str:

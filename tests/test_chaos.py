@@ -520,7 +520,9 @@ def test_summarize_aggregates_session_robustness(panel):
     _print_text(s)   # the text report renders the robustness section
 
 
-def test_clean_trace_has_no_robustness_section(panel):
+def test_clean_trace_has_zeroed_robustness_section(panel):
+    # Schema v1 (ISSUE 12): the robustness section is always present
+    # with stable keys; a clean trace reports all-zero counters.
     Y0 = panel[:40]
     res0 = fit(MODEL, Y0, fused=True, max_iters=6, tol=1e-6)
     tr = Tracer()
@@ -528,7 +530,10 @@ def test_clean_trace_has_no_robustness_section(panel):
         sess = open_session(res0, Y0, capacity=60, max_update_rows=2,
                             max_iters=4, tol=0.0)
         sess.update(panel[40:42])
-    assert "robustness" not in summarize(tr.events)
+    rb = summarize(tr.events)["robustness"]
+    assert rb["dispatch_retries"] == 0 and rb["quarantines"] == 0
+    assert rb["degraded_queries"] == 0 and rb["backoff_s_total"] == 0.0
+    assert rb["per_tenant"] == {} and rb["per_session"] == {}
 
 
 def test_degraded_queries_metric_registered():

@@ -352,10 +352,15 @@ def test_fleet_trace_budget_and_report_section(trio):
     _print_text(s)    # the text report renders the fleet stanza
 
 
-def test_summarize_without_ticks_has_no_fleet_section():
+def test_summarize_without_ticks_emits_empty_stable_fleet_section():
+    # Schema v1 (ISSUE 12): the fleet section is always present with
+    # stable keys; a tickless trace reports zeros/None, not absence.
     s = summarize([{"kind": "dispatch", "program": "x", "key": "k",
                     "t": 0.0, "dur": 0.01, "barrier": True}])
-    assert "fleet" not in s
+    fs = s["fleet"]
+    assert fs["n_ticks"] == 0 and fs["n_queries"] == 0
+    assert fs["queries_per_dispatch"] is None
+    assert fs["per_bucket"] == {} and fs["per_tenant"] == {}
 
 
 # ------------------------------------------------------- quarantine --

@@ -1,0 +1,440 @@
+"""Live serving telemetry plane (ISSUE 12: obs.metrics / obs.slo / obs.live).
+
+The operative contracts, on the fake 8-device CPU mesh (conftest):
+
+- OFF-PATH INERTNESS: the always-on plane reuses timestamps the trace
+  layer already takes — the same workload (fit, fit_jobs, session,
+  fleet) run with DFM_METRICS=0 and =1 produces BIT-IDENTICAL numbers
+  and the SAME dispatch count.
+- STREAMING QUANTILES: the fixed-log-bucket histogram's p50/p90/p99
+  track the exact nearest-rank quantiles within the geometric-bucket
+  error bound, at O(1) memory; snapshots round-trip through JSON.
+- LEDGER RECONCILIATION: per-tenant accounting (queries, device-wall
+  ms, EM iters, est. flops) reconciles exactly with the trace events
+  that fed it — traced and untraced seams meter identically.
+- SLO BURN: the rolling error-budget burn-rate monitor fires and clears
+  deterministically from the observation sequence alone, and a breach
+  dumps the flight ring to an ``obs.report``-readable JSONL.
+- SCHEMA v1: ``summarize`` emits a versioned, stable-keyed JSON (the
+  serving sections present even when empty), byte-preserved through a
+  json round-trip, with a ``metrics`` section fed through the SAME
+  ``record_event`` mapping the live plane uses.
+- ROTATION: ``Tracer(max_bytes=)`` shift-rotates the JSONL; the report
+  CLI accepts the rotated files in order and reproduces the in-memory
+  summary.
+"""
+
+import hashlib
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from dfm_tpu import DynamicFactorModel, Job, fit, fit_jobs, open_fleet, \
+    open_session
+from dfm_tpu.api import TPUBackend
+from dfm_tpu.obs import live as live_mod
+from dfm_tpu.obs.cost import RecompileDetector, em_iter_work
+from dfm_tpu.obs.live import LivePlane
+from dfm_tpu.obs.metrics import (Histogram, Ledger, MetricsRegistry,
+                                 metrics_summary, record_event)
+from dfm_tpu.obs.report import summarize
+from dfm_tpu.obs.slo import AnomalyDetector, SLOConfig, SLOMonitor
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.utils import dgp
+
+BE = TPUBackend(filter="info")   # fleet core is info-filter-only
+MODEL = DynamicFactorModel(n_factors=2)
+
+
+@pytest.fixture
+def fresh_plane(monkeypatch):
+    """A clean enabled plane for this test; restore the lazy singleton."""
+    for var in ("DFM_METRICS", "DFM_SLO_P99_MS", "DFM_SLO_ERROR_RATE",
+                "DFM_SLO_WINDOW", "DFM_FLIGHT_DIR", "DFM_METRICS_SNAPSHOT"):
+        monkeypatch.delenv(var, raising=False)
+    live_mod.reset_plane()
+    yield live_mod.plane()
+    live_mod.reset_plane()
+
+
+def _panel(T, N, k, seed):
+    rng = np.random.default_rng(seed)
+    Y, _ = dgp.simulate(dgp.dfm_params(N, k, rng), T, rng)
+    return Y
+
+
+# ------------------------------------------------ off-path inertness --
+
+def _full_workload():
+    """fit + fit_jobs + session + fleet, hashed, under a fresh tracer."""
+    h = hashlib.sha256()
+    tr = Tracer(detector=RecompileDetector())
+    with activate(tr):
+        res = fit(MODEL, _panel(40, 12, 2, 5), max_iters=6, tol=1e-6,
+                  fused=True)
+        h.update(np.asarray(res.params.Lam, np.float64).tobytes())
+        h.update(np.asarray(res.nowcast, np.float64).tobytes())
+
+        jrs = fit_jobs([Job(Y=_panel(36, 10, 2, 6), model=MODEL,
+                            tenant="a", max_iters=4, tol=0.0),
+                        Job(Y=_panel(40, 12, 2, 7), model=MODEL,
+                            tenant="b", max_iters=4, tol=0.0)])
+        for jr in jrs:
+            h.update(np.asarray(jr.fit.params.Lam, np.float64).tobytes())
+
+        Yb = _panel(44, 12, 2, 8)
+        resb = fit(MODEL, Yb[:40], max_iters=6, backend=BE,
+                   telemetry=False)
+        sess = open_session(resb, Yb[:40], capacity=60, max_update_rows=2,
+                            max_iters=3, tol=0.0)
+        u = sess.update(Yb[40:42])
+        h.update(np.asarray(u.nowcast, np.float64).tobytes())
+
+        fl = open_fleet([resb], [Yb[:40]], capacity=60, max_update_rows=2,
+                        max_iters=3, tol=0.0, backend=BE)
+        t0 = fl.tenants[0]
+        fl.submit(t0, Yb[42:44])
+        out = fl.drain()
+        h.update(np.asarray(out[t0][0].nowcast, np.float64).tobytes())
+        fl.close()
+    return h.hexdigest(), tr.summary()["dispatches"]
+
+
+def test_metrics_plane_off_path_bit_identity(monkeypatch):
+    """Plane disabled vs enabled: identical numbers, identical dispatch
+    count, across every serving layer (fit / fit_jobs / session / fleet)."""
+    monkeypatch.setenv("DFM_METRICS", "0")
+    live_mod.reset_plane()
+    try:
+        sha_off, disp_off = _full_workload()
+        assert not live_mod.plane().enabled
+        monkeypatch.setenv("DFM_METRICS", "1")
+        live_mod.reset_plane()
+        sha_on, disp_on = _full_workload()
+        assert live_mod.plane().enabled
+        assert live_mod.plane().registry.n_series > 0
+    finally:
+        live_mod.reset_plane()
+    assert sha_on == sha_off
+    assert disp_on == disp_off
+
+
+# ------------------------------------------------ streaming quantiles --
+
+def test_histogram_tracks_exact_nearest_rank_quantiles():
+    rng = np.random.default_rng(0)
+    xs = np.exp(rng.uniform(np.log(1e-2), np.log(1e3), size=5000))
+    h = Histogram()
+    for x in xs:
+        h.observe(float(x))
+    assert h.count == 5000
+    assert h.sum == pytest.approx(float(xs.sum()))
+    assert h.min == float(xs.min()) and h.max == float(xs.max())
+    srt = np.sort(xs)
+    for q in (0.5, 0.9, 0.99):
+        exact = float(srt[max(1, math.ceil(q * len(srt))) - 1])
+        est = h.quantile(q)
+        assert abs(est - exact) / exact < 0.1, (q, est, exact)
+    # O(1) memory: bucket count is bounded by the fixed grid, not n.
+    assert len(h.buckets) < 400
+
+
+def test_histogram_edge_cases():
+    h = Histogram()
+    assert h.quantile(0.5) is None
+    h.observe(float("nan"))          # ignored
+    assert h.count == 0
+    h.observe(0.0)                   # clamps to the bottom bucket
+    h.observe(1e9)                   # clamps to the top bucket
+    assert h.count == 2
+    assert h.min == 0.0 and h.max == 1e9
+    assert h.quantile(0.0) <= h.quantile(1.0)
+
+
+def test_registry_snapshot_roundtrip_and_prom():
+    reg = MetricsRegistry()
+    reg.counter("queries_total", tenant="t0").inc(3)
+    reg.gauge("fleet_occupancy", fleet="f1", bucket="0").set(0.75)
+    for w in (1.0, 2.0, 10.0):
+        reg.histogram("query_wall_ms", tenant="t0").observe(w)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    reg2 = MetricsRegistry.from_snapshot(snap)
+    assert reg2.snapshot() == snap
+    prom = reg2.render_prom()
+    assert 'dfm_queries_total{tenant="t0"} 3' in prom
+    assert "# TYPE dfm_query_wall_ms summary" in prom
+    assert 'quantile="0.99"' in prom
+    assert 'dfm_query_wall_ms_count{tenant="t0"} 3' in prom
+
+
+# ------------------------------------------------ ledger reconciliation --
+
+def test_session_ledger_reconciles_with_trace(fresh_plane):
+    Y = _panel(46, 12, 2, 11)
+    res = fit(MODEL, Y[:40], max_iters=6, backend=BE, telemetry=False)
+    tr = Tracer(detector=RecompileDetector())
+    with activate(tr):
+        sess = open_session(res, Y[:40], capacity=60, max_update_rows=2,
+                            max_iters=3, tol=0.0)
+        for i in range(3):
+            sess.update(Y[40 + 2 * i:42 + 2 * i])
+    q_evs = [e for e in tr.events if e.get("kind") == "query"]
+    assert len(q_evs) == 3
+    acct = sess.accounting()
+    assert set(acct) == {sess.session_id}
+    row = acct[sess.session_id]
+    assert row["queries"] == 3
+    assert row["em_iters"] == sum(e["n_iters"] for e in q_evs)
+    assert row["device_ms"] == pytest.approx(
+        sum(e["wall"] for e in q_evs) * 1e3)
+    want_flops = sum(
+        em_iter_work(e["N"], e["t_rows"], e["k"])[0] * e["n_iters"]
+        for e in q_evs)
+    assert row["est_flops"] == pytest.approx(want_flops)
+
+
+def test_untraced_seams_meter_identically_to_traced(fresh_plane):
+    """The explicit live_observe fallbacks build the SAME event payload
+    the tracer would: ledger rows from an untraced session match a
+    traced twin field-for-field."""
+    Y = _panel(44, 10, 2, 12)
+    res = fit(MODEL, Y[:40], max_iters=6, backend=BE, telemetry=False)
+
+    def serve():
+        sess = open_session(res, Y[:40], capacity=60, max_update_rows=2,
+                            max_iters=3, tol=0.0)
+        sess.update(Y[40:42])
+        sess.update(Y[42:44])
+        return sess.accounting()[sess.session_id]
+
+    untraced = serve()
+    with activate(Tracer(detector=RecompileDetector())):
+        traced = serve()
+    assert set(untraced) == set(traced)
+    assert untraced["queries"] == traced["queries"] == 2
+    assert untraced["em_iters"] == traced["em_iters"]
+    assert untraced["est_flops"] == pytest.approx(traced["est_flops"])
+
+
+def test_fit_jobs_feeds_tenant_ledger_untraced(fresh_plane):
+    fit_jobs([Job(Y=_panel(36, 10, 2, 13), model=MODEL, tenant="t_a",
+                  max_iters=4, tol=0.0),
+              Job(Y=_panel(40, 12, 2, 14), model=MODEL, tenant="t_b",
+                  max_iters=4, tol=0.0)])
+    acct = live_mod.accounting()
+    assert {"t_a", "t_b"} <= set(acct)
+    for t in ("t_a", "t_b"):
+        assert acct[t]["jobs"] == 1
+        assert acct[t]["em_iters"] > 0
+        assert acct[t]["est_flops"] > 0
+        assert acct[t]["device_ms"] > 0
+
+
+def test_fleet_accounting_per_tenant(fresh_plane):
+    Ya, Yb = _panel(46, 12, 2, 15), _panel(46, 12, 2, 16)
+    ra = fit(MODEL, Ya[:40], max_iters=6, backend=BE, telemetry=False)
+    rb = fit(MODEL, Yb[:40], max_iters=6, backend=BE, telemetry=False)
+    fl = open_fleet([ra, rb], [Ya[:40], Yb[:40]], capacity=60,
+                    max_update_rows=2, max_iters=3, tol=0.0, backend=BE)
+    ta, tb = fl.tenants
+    fl.submit(ta, Ya[40:42])
+    fl.submit(tb, Yb[40:42])
+    fl.drain()
+    fl.submit(ta, Ya[42:44])
+    fl.drain()
+    acct = fl.accounting()
+    assert acct[ta]["queries"] == 2 and acct[tb]["queries"] == 1
+    # wall_share attribution: tenant device_ms sums to the tick walls.
+    assert acct[ta]["device_ms"] > 0 and acct[tb]["device_ms"] > 0
+    fl.close()
+
+
+# ------------------------------------------------ SLO burn / anomaly --
+
+def test_slo_burn_fires_and_clears_deterministically():
+    mon = SLOMonitor(SLOConfig(p99_ms=1.0, error_rate=0.5, window=10.0,
+                               min_events=5))
+    trans = [mon.observe(float(i), 50.0) for i in range(5)]
+    assert trans[:4] == [None] * 4 and trans[4] == "fire"
+    assert mon.breached and mon.burn_rate > 1.0
+    # Fast queries march the window past the slow ones -> clear, once.
+    trans = [mon.observe(float(5 + i), 0.01) for i in range(20)]
+    assert trans.count("clear") == 1
+    assert not mon.breached and mon.burn_rate == 0.0
+    assert mon.n_fired == 1
+    assert mon.status()["burn_rate_max"] > 1.0
+
+
+def test_slo_error_rate_arm_and_unarmed_monitor():
+    mon = SLOMonitor(None)
+    assert mon.observe(0.0, 1e9) is None        # unarmed: observes nothing
+    mon = SLOMonitor(SLOConfig(p99_ms=1e9, error_rate=0.1, window=100.0,
+                               min_events=4))
+    for i in range(3):
+        assert mon.observe(float(i), 0.1, error=True) is None
+    assert mon.observe(3.0, 0.1, error=True) == "fire"
+
+
+def test_anomaly_detector_flags_spike_transition():
+    det = AnomalyDetector(window_n=32, warmup=10, spike_ratio=3.0,
+                          floor_ms=0.001)
+    fired = [det.observe(1.0) for _ in range(20)]
+    assert not any(fired)
+    fired = [det.observe(50.0) for _ in range(5)]
+    assert fired[0] and not any(fired[1:])      # transition fires once
+    assert det.spiking and det.n_spikes == 1
+
+
+def test_slo_burn_emits_health_event_and_flight_dump(tmp_path):
+    plane = LivePlane(enabled=True,
+                      slo=SLOConfig(p99_ms=1.0, window=100.0, min_events=5),
+                      flight_dir=str(tmp_path), flight_min_interval_s=0.0)
+    for i in range(6):
+        plane.observe({"t": float(i), "kind": "query", "session": "s0",
+                       "t_rows": 40, "n_new": 2, "wall": 0.5, "n_iters": 3,
+                       "N": 12, "k": 2, "converged": True,
+                       "diverged": False})
+    assert plane.slo.breached
+    assert [he.kind for he in plane.health_events] == ["slo_burn"]
+    assert plane.health_events[0].action == "fired"
+    assert plane.flight_dumps == 1
+    dumps = sorted(os.listdir(tmp_path))
+    assert len(dumps) == 1 and dumps[0].endswith(".jsonl")
+    # The dump is a valid obs.report input carrying the whole story.
+    s = summarize(str(tmp_path / dumps[0]))
+    assert s["queries"]["n_queries"] == 5     # ring at dump time
+    assert "slo_burn" in s["health_kinds"]
+    assert s["metrics"]["counters"]["health_events_total{event=slo_burn}"] \
+        == 1.0
+    assert plane.errors == 0
+
+
+def test_injected_fault_trips_slo_via_dispatch_seam(fresh_plane):
+    """An availability fault injected at the ``wrap_dispatch`` seam (a
+    failed dispatch, retried by the guard) reaches the armed SLO monitor
+    as an error observation: the burn rate fires deterministically from
+    the error budget, with zero real latency involved."""
+    from dfm_tpu.robust import FaultInjector, RobustPolicy
+    live_mod.set_slo(SLOConfig(p99_ms=1e9, error_rate=0.1, window=1e9,
+                               min_events=3))
+    Y = _panel(46, 10, 2, 17)
+    res = fit(MODEL, Y[:40], max_iters=6, backend=BE, telemetry=False)
+    inj = FaultInjector().dispatch_failure(at=0)
+    pol = RobustPolicy(backoff_base=1e-6, wrap_dispatch=inj.wrap_call)
+    sess = open_session(res, Y[:40], capacity=60, max_update_rows=2,
+                        max_iters=3, tol=0.0, robust=pol)
+    for i in range(3):
+        sess.update(Y[40 + 2 * i:42 + 2 * i])
+    pl = live_mod.plane()
+    assert pl.registry.counter("dispatch_retries_total").value >= 1
+    assert pl.slo.n_fired >= 1
+    assert any(he.kind == "slo_burn" for he in pl.health_events)
+
+
+def test_flight_dump_disabled_without_dir():
+    plane = LivePlane(enabled=True,
+                      slo=SLOConfig(p99_ms=1.0, window=100.0, min_events=2))
+    for i in range(3):
+        plane.observe({"t": float(i), "kind": "query", "session": "s0",
+                       "wall": 0.5})
+    assert plane.slo.breached
+    assert plane.flight_dumps == 0            # library never writes files
+    assert plane.dump_flight() is None
+
+
+# ------------------------------------------------ schema / summarize --
+
+def test_summary_schema_v1_stable_and_json_roundtrip():
+    s = summarize([{"kind": "dispatch", "program": "x", "key": "k",
+                    "t": 0.0, "dur": 0.01, "barrier": True,
+                    "first_call": True}])
+    assert s["schema_version"] == 1
+    for section in ("tenants", "tenant_fairness", "queries", "fleet",
+                    "robustness", "metrics"):
+        assert section in s, section
+    assert s["robustness"]["per_tenant"] == {}
+    assert s["robustness"]["per_session"] == {}
+    assert json.loads(json.dumps(s)) == s
+    # metrics section goes through the same record_event mapping the
+    # live plane runs — rebuild it independently and compare.
+    reg = MetricsRegistry()
+    record_event(reg, None, {"kind": "dispatch", "program": "x", "key": "k",
+                             "t": 0.0, "dur": 0.01, "barrier": True,
+                             "first_call": True})
+    assert s["metrics"] == json.loads(json.dumps(metrics_summary(reg)))
+
+
+def test_summarize_accepts_event_list_file_and_multi_file(tmp_path):
+    evs = [{"kind": "dispatch", "program": "p", "key": "a", "t": float(i),
+            "dur": 0.01, "barrier": True, "first_call": i == 0}
+           for i in range(6)]
+    one = tmp_path / "t.jsonl"
+    with open(one, "w") as fh:
+        for e in evs:
+            fh.write(json.dumps(e) + "\n")
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    with open(a, "w") as fh:
+        for e in evs[:3]:
+            fh.write(json.dumps(e) + "\n")
+    with open(b, "w") as fh:
+        for e in evs[3:]:
+            fh.write(json.dumps(e) + "\n")
+    want = summarize(evs)
+    assert summarize(str(one)) == want
+    assert summarize([str(a), str(b)]) == want
+
+
+def test_tracer_rotation_and_report_reads_rotated_files(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tr = Tracer(path, max_bytes=512, keep=32,
+                detector=RecompileDetector())
+    for i in range(40):
+        tr.emit("dispatch", program="p", key="k", dur=0.001,
+                barrier=True, first_call=i == 0, recompile=False)
+    tr.close()
+    assert tr.rotations >= 1
+    rotated = sorted((p for p in os.listdir(tmp_path)
+                      if p.startswith("trace.jsonl.")),
+                     key=lambda p: int(p.rsplit(".", 1)[1]), reverse=True)
+    assert rotated
+    files = [str(tmp_path / p) for p in rotated] + [path]
+    s = summarize(files)
+    assert s["dispatches"] == 40          # keep high enough: none dropped
+    assert s == summarize(tr.events)
+
+
+def test_tracer_rotation_caps_file_count(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tr = Tracer(path, max_bytes=256, keep=2, detector=RecompileDetector())
+    for _ in range(60):
+        tr.emit("span", name="x", dur=0.001)
+    tr.close()
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["t.jsonl", "t.jsonl.1", "t.jsonl.2"]
+
+
+def test_live_metrics_registered_in_store():
+    from dfm_tpu.obs import store
+    for k in ("fleet_slo_burn_rate", "flight_dumps"):
+        assert k in store._BENCH_NUMERIC_KEYS
+        assert store.lower_is_better(k)
+        assert store.noise_floor(k) > 0
+
+
+def test_ledger_snapshot_roundtrip():
+    led = Ledger()
+    r = led.row("s0", "t0")
+    r["queries"] += 2
+    r["device_ms"] += 12.5
+    r["pad_waste_sum"] += 0.2
+    r["pad_waste_n"] += 1
+    led2 = Ledger.from_snapshot(json.loads(json.dumps(led.snapshot())))
+    assert led2.accounting() == led.accounting()
+    acct = led2.accounting("s0")
+    assert acct["t0"]["queries"] == 2
+    assert acct["t0"]["pad_waste_frac"] == pytest.approx(0.2)
+    assert led2.accounting("nope") == {}

@@ -377,10 +377,15 @@ def test_panel_fingerprint_and_mismatch():
 
 # ------------------------------------------------------- obs plumbing --
 
-def test_summarize_without_queries_has_no_section():
+def test_summarize_without_queries_emits_empty_stable_section():
+    # Schema v1 (ISSUE 12): the queries section is always present with
+    # stable keys so downstream consumers never branch on key existence.
     s = summarize([{"kind": "dispatch", "program": "x", "key": "k",
                     "t": 0.0, "dur": 0.01, "barrier": True}])
-    assert "queries" not in s
+    q = s["queries"]
+    assert q["n_queries"] == 0
+    assert q["per_session"] == {}
+    assert s["schema_version"] == 1
 
 
 def test_serve_metrics_registered_in_store():

@@ -102,7 +102,8 @@ def test_audit_scope_saw_the_timing_modules():
     rels = {_rel(p) for p in _scoped_files()}
     expected = {"dfm_tpu/obs/trace.py", "dfm_tpu/obs/report.py",
                 "dfm_tpu/obs/profile.py", "dfm_tpu/obs/cost.py",
-                "dfm_tpu/obs/advise.py",
+                "dfm_tpu/obs/advise.py", "dfm_tpu/obs/metrics.py",
+                "dfm_tpu/obs/slo.py", "dfm_tpu/obs/live.py",
                 "dfm_tpu/estim/em.py", "dfm_tpu/estim/fused.py",
                 "dfm_tpu/robust/guard.py",
                 "bench.py", "bench/all.py", "bench/batched.py"}

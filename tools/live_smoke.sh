@@ -1,0 +1,165 @@
+#!/usr/bin/env bash
+# One-command live-telemetry-plane check (ISSUE 12), no real chip needed:
+#
+#   leg 1  metrics-plane bit-identity: the SAME session workload run with
+#          DFM_METRICS=0 and =1 must produce byte-identical nowcasts AND
+#          the same dispatch count (the plane reuses timestamps the trace
+#          layer already takes — zero extra dispatches, off-path inert);
+#   leg 2  untraced seams + surfaces: with NO tracer active the session
+#          still feeds the plane — the per-tenant ledger reconciles with
+#          the queries served, the snapshot file renders through
+#          `python -m dfm_tpu.obs.live` in both text and prom modes;
+#   leg 3  SLO burn -> flight recorder: an impossible latency objective
+#          (p99 < 1 ns) must fire the burn-rate gate deterministically,
+#          dump the flight ring to JSONL, and that dump must read back
+#          through `python -m dfm_tpu.obs.report`.
+#
+# Usage (from the repo root): tools/live_smoke.sh
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP=$(mktemp -d /tmp/dfm_live.XXXXXX)
+trap 'rm -rf "$TMP"' EXIT
+export JAX_PLATFORMS="${JAX_PLATFORMS-cpu}"
+export DFM_RUNS=    # never append smoke runs to the observatory
+
+# --- leg 1: bit-identity + equal dispatch count, plane off vs on --------
+run_workload() {
+  DFM_METRICS="$1" python - <<'PY'
+import hashlib
+import json
+
+import numpy as np
+
+from dfm_tpu import DynamicFactorModel, fit, open_session
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(7)
+p_true = dgp.dfm_params(24, 2, rng)
+Y, _ = dgp.simulate(p_true, 66, rng)
+Y0, stream = Y[:60], Y[60:]
+
+res = fit(DynamicFactorModel(n_factors=2), Y0, max_iters=16, tol=1e-6,
+          fused=True)
+h = hashlib.sha256()
+tr = Tracer(detector=RecompileDetector())
+with activate(tr):
+    sess = open_session(res, Y0, capacity=90, max_update_rows=2,
+                        max_iters=4, tol=0.0)
+    for rows in (stream[:2], stream[2:4], stream[4:6]):
+        u = sess.update(rows)
+        h.update(np.asarray(u.nowcast, np.float64).tobytes())
+        h.update(np.asarray(u.forecasts["y"], np.float64).tobytes())
+print(json.dumps({"sha": h.hexdigest(),
+                  "dispatches": tr.summary()["dispatches"]}))
+PY
+}
+OFF=$(run_workload 0 | tail -n 1)
+ON=$(run_workload 1 | tail -n 1)
+echo "plane off: $OFF"
+echo "plane on:  $ON"
+[ "$OFF" = "$ON" ] || {
+  echo "live smoke FAILED: metrics plane changed results or dispatches" >&2
+  exit 1
+}
+echo "leg 1 OK: plane on/off bit-identical, equal dispatch count"
+
+# --- leg 2: untraced seams feed the ledger + snapshot/prom surfaces -----
+SNAP="$TMP/live_snapshot.json"
+DFM_METRICS_SNAPSHOT="$SNAP" DFM_METRICS_INTERVAL_S=0 python - <<'PY'
+import numpy as np
+
+from dfm_tpu import DynamicFactorModel, fit, open_session
+from dfm_tpu.obs.live import plane
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(7)
+p_true = dgp.dfm_params(24, 2, rng)
+Y, _ = dgp.simulate(p_true, 66, rng)
+Y0, stream = Y[:60], Y[60:]
+
+res = fit(DynamicFactorModel(n_factors=2), Y0, max_iters=16, tol=1e-6,
+          fused=True)
+# NO tracer: the untraced seam fallbacks must still meter every query.
+sess = open_session(res, Y0, capacity=90, max_update_rows=2,
+                    max_iters=4, tol=0.0)
+for rows in (stream[:2], stream[2:4], stream[4:6]):
+    sess.update(rows)
+acct = sess.accounting()
+assert len(acct) == 1, f"expected one ledger tenant, got {acct}"
+row = next(iter(acct.values()))
+assert row["queries"] == 3, f"ledger missed queries: {row}"
+assert row["em_iters"] == 3 * 4, f"ledger missed EM iters: {row}"
+assert row["device_ms"] > 0 and row["est_flops"] > 0, row
+st = plane().status()
+assert st["enabled"] and st["n_series"] > 0, st
+assert plane().write_snapshot() is not None
+print(f"untraced session metered: {row['queries']} queries, "
+      f"{row['em_iters']} EM iters, {row['device_ms']:.2f} device-ms, "
+      f"{st['n_series']} live series")
+PY
+python -m dfm_tpu.obs.live snapshot --file "$SNAP" > "$TMP/snap.txt"
+head -n 6 "$TMP/snap.txt"
+python -m dfm_tpu.obs.live prom --file "$SNAP" > "$TMP/prom.txt"
+grep -q "dfm_queries_total" "$TMP/prom.txt" || {
+  echo "live smoke FAILED: prom rendering lost dfm_queries_total" >&2
+  exit 1
+}
+echo "leg 2 OK: ledger reconciles, snapshot + prom surfaces render"
+
+# --- leg 3: SLO burn fires -> flight recorder dumps -> report reads it --
+FLIGHT="$TMP/flight"
+DFM_FLIGHT_DIR="$FLIGHT" DFM_FLIGHT_MIN_INTERVAL_S=0 python - <<'PY'
+import numpy as np
+
+from dfm_tpu import DynamicFactorModel, fit, open_session
+from dfm_tpu.obs.live import plane, set_slo
+from dfm_tpu.obs.slo import SLOConfig
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(7)
+p_true = dgp.dfm_params(24, 2, rng)
+Y, _ = dgp.simulate(p_true, 84, rng)
+Y0, stream = Y[:60], Y[60:]
+
+res = fit(DynamicFactorModel(n_factors=2), Y0, max_iters=16, tol=1e-6,
+          fused=True)
+# Impossible objective: every query is over budget, so the burn rate
+# must cross fire_at deterministically once min_events accumulate.
+set_slo(SLOConfig(p99_ms=1e-6, window=1e9, min_events=10))
+sess = open_session(res, Y0, capacity=120, max_update_rows=2,
+                    max_iters=3, tol=0.0)
+for i in range(12):
+    sess.update(stream[2 * i:2 * i + 2])
+st = plane().status()
+assert st["slo"]["n_fired"] >= 1, f"SLO never fired: {st['slo']}"
+assert st["slo"]["burn_rate_max"] > 1.0, st["slo"]
+assert st["flight_dumps"] >= 1, f"no flight dump: {st}"
+assert plane().health_events, "no slo_burn HealthEvent recorded"
+assert plane().health_events[0].kind == "slo_burn"
+print(f"SLO fired {st['slo']['n_fired']}x "
+      f"(burn max {st['slo']['burn_rate_max']:.1f}), "
+      f"{st['flight_dumps']} flight dump(s)")
+PY
+DUMP=$(ls "$FLIGHT"/flight-*.jsonl | head -n 1)
+python -m dfm_tpu.obs.report "$DUMP" --json > "$TMP/flight.json"
+python - "$TMP/flight.json" <<'PY'
+import json
+import sys
+
+s = json.load(open(sys.argv[1]))
+assert s["schema_version"] == 1, s.get("schema_version")
+n = s["n_events"]
+assert n >= 10, f"flight dump too small: {n}"
+q = s["queries"]
+assert q["n_queries"] >= 10, q
+assert "slo_burn" in (s.get("health_kinds") or []), s.get("health_kinds")
+print(f"flight dump readable: {n} events, {q['n_queries']} queries, "
+      f"slo_burn recorded")
+PY
+echo "leg 3 OK: SLO burn -> flight dump -> obs.report round-trip"
+
+echo "live smoke OK"

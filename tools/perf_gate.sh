@@ -113,5 +113,21 @@ assert store.noise_floor("fleet_p99_ms") > 0, \
 assert store.noise_floor("fleet_pad_waste_frac") > 0, \
     "perf_gate: fleet_pad_waste_frac lost its pad_waste noise floor"'
 
+# The live telemetry plane metrics (bench.fleet / tools/live_smoke.sh)
+# must stay registered: SLO error-budget burn and flight-recorder dumps
+# both gate lower-is-better (~0 healthy) with their own noise floors.
+python -c '
+from dfm_tpu.obs import store
+need = ("fleet_slo_burn_rate", "flight_dumps")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+for k in need:
+    assert store.lower_is_better(k), \
+        f"perf_gate: {k} lost its lower-is-better marker"
+assert store.noise_floor("fleet_slo_burn_rate") > 0, \
+    "perf_gate: fleet_slo_burn_rate lost its noise floor"
+assert store.noise_floor("flight_dumps") > 0, \
+    "perf_gate: flight_dumps lost its noise floor"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"
