@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Long-T time-scan sweep: sequential vs parallel-in-time filtering.
+
+Sweeps the EM fit over panel lengths (default T in {300, 1000, 4000})
+under three in-loop time-scan engines at the same shape, budget, and
+f32 dtype — ``filter="info"`` (the sequential scan), ``filter="pit"``
+(the legacy covariance-form parallel scan), and ``filter="pit_qr"``
+(the square-root QR-factor parallel scan) — and prints exactly ONE JSON
+line to stdout:
+
+    {"metric": ..., "value": N, "unit": "x",
+     "pit_qr_speedup_t300": N, "pit_qr_speedup_t1000": N,
+     "pit_qr_speedup_t4000": N, "pit_qr_noise_ratio": N, ...}
+
+``value`` is the pit_qr speedup over the sequential scan at the largest
+sweep point (wall of the same warm chunked fit, best-of-N with the d2h
+read as the barrier).  ``pit_qr_noise_ratio`` compares the f32 final
+loglik error of pit_qr against the sequential scan's, both measured
+against the f64 sequential fit at the same budget (ratio <= ~1 means
+the square-root combine holds the sequential noise level — the
+"matched numerics" half of the long-T contract).
+
+Run on the real chip: ``python -m bench.longt``.  Smoke-size via
+DFM_BENCH_N/K, DFM_BENCH_TSWEEP (comma list, default "300,1000,4000"),
+DFM_BENCH_ITERS (EM budget per fit, default 16), DFM_BENCH_REPS
+(best-of-N, default 3).  Diagnostics on stderr.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from bench._common import log, record_run, timed
+
+
+def main():
+    N = int(os.environ.get("DFM_BENCH_N", 24))
+    k = int(os.environ.get("DFM_BENCH_K", 2))
+    sweep = [int(t) for t in os.environ.get(
+        "DFM_BENCH_TSWEEP", "300,1000,4000").split(",") if t]
+    iters = int(os.environ.get("DFM_BENCH_ITERS", 16))
+    reps = int(os.environ.get("DFM_BENCH_REPS", 3))
+
+    import jax
+    jax.config.update("jax_enable_x64", True)  # f64 reference fits
+    import jax.numpy as jnp
+
+    from dfm_tpu import DynamicFactorModel, TPUBackend, fit
+    from dfm_tpu.backends import cpu_ref
+    from dfm_tpu.utils import dgp
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}); N={N} k={k} "
+        f"T sweep {sweep}, {iters} EM iters/fit, best of {reps}")
+
+    model = DynamicFactorModel(n_factors=k, standardize=False)
+    engines = ("info", "pit", "pit_qr")
+    payload = {}
+    results = []
+    with jax.default_matmul_precision("highest"):
+        for T in sweep:
+            rng = np.random.default_rng(1000 + T)
+            p_true = dgp.dfm_params(N, k, rng)
+            Y, _ = dgp.simulate(p_true, T, rng)
+            Y = (Y - Y.mean(0)) / Y.std(0)
+            p0 = cpu_ref.pca_init(Y, k)
+
+            # f64 sequential reference loglik at the same budget: the
+            # yardstick both f32 engines' final-loglik errors divide
+            # against.
+            ref = fit(model, Y, max_iters=iters, tol=0.0, init=p0,
+                      backend=TPUBackend(dtype=jnp.float64, filter="info"))
+            ll_ref = float(ref.logliks[-1])
+
+            walls, errs = {}, {}
+            for eng in engines:
+                b = TPUBackend(dtype=jnp.float32, filter=eng)
+                r = fit(model, Y, max_iters=iters, tol=0.0, init=p0,
+                        backend=b)
+                errs[eng] = abs(float(r.logliks[-1]) - ll_ref) / abs(ll_ref)
+                walls[eng] = timed(
+                    lambda b=b: fit(model, Y, max_iters=iters, tol=0.0,
+                                    init=p0, backend=b), reps)
+            spd = {e: walls["info"] / walls[e] for e in engines}
+            log(f"T={T}: seq {1e3 * walls['info']:.1f} ms"
+                + "".join(f", {e} {1e3 * walls[e]:.1f} ms "
+                          f"({spd[e]:.2f}x, f32 err {errs[e]:.2e})"
+                          for e in ("pit", "pit_qr")))
+            payload[f"pit_qr_speedup_t{T}"] = round(spd["pit_qr"], 3)
+            payload[f"pit_speedup_t{T}"] = round(spd["pit"], 3)
+            payload[f"seq_iters_per_sec_t{T}"] = round(
+                iters / walls["info"], 2)
+            results.append((T, spd["pit_qr"], errs))
+
+    # Noise ratio at the largest sweep point: eps*N*T noise is worst
+    # there, so it is the binding comparison.
+    T_max, spd_max, errs_max = results[-1]
+    noise_ratio = errs_max["pit_qr"] / max(errs_max["info"], 1e-7)
+    payload.update({
+        "metric": f"longt_pit_qr_speedup_T{T_max}",
+        "value": round(spd_max, 3),
+        "unit": "x",
+        "value_definition": ("warm chunked-fit wall of the sequential "
+                            "info scan divided by the pit_qr scan at the "
+                            "largest sweep T (same shape, budget, f32)"),
+        "pit_qr_noise_ratio": round(noise_ratio, 3),
+        "f32_loglik_rel_err_seq": errs_max["info"],
+        "f32_loglik_rel_err_pit_qr": errs_max["pit_qr"],
+        "sweep_T": sweep,
+        "shape_N_k": [N, k],
+        "em_iters": iters,
+    })
+    from dfm_tpu.obs.store import new_run_id
+    payload["run_id"] = new_run_id()
+    print(json.dumps(payload))
+    record_run(payload, dev, "bench_longt")
+
+
+if __name__ == "__main__":
+    main()

@@ -101,6 +101,12 @@ class FitResult:
     #                                  # serve.NowcastSession holding this
     #                                  # fit's params + panel device-
     #                                  # resident for streaming updates
+    filter: Optional[str] = None       # resolved in-loop filter engine
+    #                                  # ("dense"/"info"/"ss"/"pit"/
+    #                                  # "pit_qr"); None on backends
+    #                                  # without the filter knob (CPU
+    #                                  # oracle) — also stamped on the
+    #                                  # fit trace event
 
     @property
     def loglik(self) -> float:
@@ -197,11 +203,15 @@ class TPUBackend(Backend):
 
     filter: "dense" (N x N innovation covariance), "info" (information form —
     k x k scan, N enters only through matmul reductions; the scalable path),
-    "ss" (steady-state accelerated), "pit" (parallel-in-time), or "auto":
-    dense below N=32, info from there, ss for unmasked panels at N >= 512
-    (benchmark scale — ~5-30x faster in-loop, trajectory contract-checked;
-    masked panels stay on the exact info scan).  All agree to fp tolerance
-    (tested).
+    "ss" (steady-state accelerated), "pit" (parallel-in-time,
+    covariance-form), "pit_qr" (parallel-in-time on square-root factors —
+    thin-QR combines in unrolled VPU form; the long-T engine, ~2*sqrt(T)
+    sequential depth, f32-stable), or "auto": dense below N=32, info from
+    there, ss for unmasked panels at N >= 512 (benchmark scale — ~5-30x
+    faster in-loop, trajectory contract-checked; masked panels stay on the
+    exact info scan).  ``fit(auto=True)`` additionally consults the
+    calibrated advisor, which picks pit_qr per shape at long T.  All agree
+    to fp tolerance (tested).
 
     matmul_precision: XLA matmul precision.  TPU MXUs round f32 matmul inputs
     to bf16 at the default setting, which costs ~1e-4 relative log-likelihood
@@ -225,7 +235,7 @@ class TPUBackend(Backend):
                  matmul_precision: str = "highest", fused_chunk: int = 8,
                  debug: bool = False, device_init="auto", robust=True):
         self.dtype = dtype
-        if filter not in ("auto", "dense", "info", "ss", "pit"):
+        if filter not in ("auto", "dense", "info", "ss", "pit", "pit_qr"):
             raise ValueError(f"unknown filter {filter!r}")
         self.filter = filter
         self.matmul_precision = matmul_precision
@@ -383,6 +393,7 @@ class TPUBackend(Backend):
         mj = jnp.asarray(mask, dt) if mask is not None else None
         pj = JaxParams.from_numpy(p0, dtype=dt)
         flt = self._filter_for(Y.shape[1], mask is not None)
+        self._last_filter = flt
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
                        estimate_init=model.estimate_init,
@@ -488,6 +499,7 @@ class TPUBackend(Backend):
             self._fused_panel = (Y, mask, Yj, mj)
         pj = JaxParams.from_numpy(p0, dtype=dt)
         flt = self._filter_for(Y.shape[1], mask is not None)
+        self._last_filter = flt
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
                        estimate_init=model.estimate_init,
@@ -685,7 +697,8 @@ class TPUBackend(Backend):
         # A single smooth is not the hot path: ss/pit fall back to the
         # sequential info form here.
         ff = {"dense": kalman_filter, "info": info_filter,
-              "ss": info_filter, "pit": info_filter}[
+              "ss": info_filter, "pit": info_filter,
+              "pit_qr": info_filter}[
                   self._filter_for(Y.shape[1])]
         pj = JaxParams.from_numpy(params, dtype=dt)
         tr = current_tracer()
@@ -799,6 +812,7 @@ class ShardedBackend(TPUBackend):
         # raises a LOCATED error through the psum, same contract as the
         # single-device TPUBackend(debug=True).
         flt = self._filter_for(Y.shape[1], mask is not None)
+        self._last_filter = flt
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
                        estimate_init=model.estimate_init, filter=flt,
@@ -1194,6 +1208,7 @@ def fit(model,                     # DynamicFactorModel | family spec
                     tracer.emit("compile_cache", dir=cache_dir, entries=n1,
                                 new_entries=n1 - cache_n0)
                 tracer.emit("fit", t=t0, engine=res.backend,
+                            filter=res.filter,
                             shape=shape_key(Y), n_iters=res.n_iters,
                             converged=bool(res.converged),
                             wall=time.perf_counter() - t0)
@@ -1204,6 +1219,7 @@ def fit(model,                     # DynamicFactorModel | family spec
                 # (same payload the tracer would carry).
                 from .obs.live import observe as live_observe
                 live_observe({"t": t0, "kind": "fit", "engine": res.backend,
+                              "filter": res.filter,
                               "shape": shape_key(Y),
                               "n_iters": res.n_iters,
                               "converged": bool(res.converged),
@@ -1426,11 +1442,13 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
         init = _resolve_warm_start(warm_start, init, model, N, fp_now)
 
     b = get_backend(backend)
+    b._last_filter = None   # set by run_em on backends with the filter knob
     # Auto-tuned plan (obs.advise): resolves to the SAME pipeline=/fused=/
     # fused_chunk knobs an explicit call would pass, so everything below
     # (and the result, bit for bit) is identical to the explicit-knob fit.
     auto_plan = None
     restore_chunk = None
+    restore_filter = None
     if auto:
         if pipeline is not None or fused:
             raise ValueError(
@@ -1442,6 +1460,16 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             if chunk and getattr(b, "fused_chunk", chunk) != chunk:
                 restore_chunk = (b.fused_chunk,)
                 b.fused_chunk = chunk
+            # Time-scan engine choice (seq vs pit_qr): applied transiently
+            # and only when the backend's own knob is "auto" — an explicit
+            # filter= on the backend always wins.  The override resolves to
+            # the SAME EMConfig an explicit TPUBackend(filter="pit_qr")
+            # would build, so the result is bit-identical to that knob.
+            plan_flt = auto_plan.get("filter")
+            if (plan_flt and plan_flt != "seq"
+                    and getattr(b, "filter", None) == "auto"):
+                restore_filter = (b.filter,)
+                b.filter = plan_flt
             if auto_plan["engine"] == "fused":
                 fused = True
             elif (int(auto_plan.get("depth") or 1) > 1
@@ -1665,6 +1693,8 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             b._fused = restore_fused[0]
         if restore_chunk is not None:
             b.fused_chunk = restore_chunk[0]
+        if restore_filter is not None:
+            b.filter = restore_filter[0]
         if restore_gck is not None:
             b._guard_checkpoint = restore_gck[0]
     nowcast = forecasts = None
@@ -1682,7 +1712,8 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
                      backend=smooth_b.name if smooth_b is not b else b.name,
                      history=history, health=health,
                      fingerprint=fp_now, nowcast=nowcast,
-                     forecasts=forecasts, advice=auto_plan)
+                     forecasts=forecasts, advice=auto_plan,
+                     filter=getattr(b, "_last_filter", None))
 
 
 def forecast(result, horizon: int):

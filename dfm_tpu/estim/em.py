@@ -21,7 +21,8 @@ from ..ops.linalg import sym, solve_psd
 from ..pipeline import resolve_pipeline
 from ..ssm.kalman import kalman_filter, rts_smoother
 from ..ssm.info_filter import info_filter
-from ..ssm.parallel_filter import pit_filter, pit_smoother
+from ..ssm.parallel_filter import (pit_filter, pit_smoother, pit_qr_filter,
+                                   pit_qr_smoother)
 from ..ssm.params import SSMParams, SmootherResult
 
 __all__ = ["EMConfig", "em_step", "em_fit", "em_fit_scan", "run_em_loop",
@@ -38,9 +39,13 @@ class EMConfig:
             "info" (information form, k x k sequential scan — the N-scalable
             TPU path, see ``ssm.info_filter``), "pit" (parallel-in-time
             associative scan for both filter and smoother, see
-            ``ssm.parallel_filter``), or "ss" (steady-state accelerated —
-            ~3*tau sequential covariance steps + blocked affine mean scans,
-            see ``ssm.steady``; falls back to exact when masked/short).
+            ``ssm.parallel_filter``), "pit_qr" (parallel-in-time on
+            SQUARE-ROOT factors — combines are thin-QR + triangular solves
+            in unrolled VPU form, the long-T engine: ~2*sqrt(T) sequential
+            depth at f32 noise at-or-below the sequential scan's), or "ss"
+            (steady-state accelerated — ~3*tau sequential covariance steps
+            + blocked affine mean scans, see ``ssm.steady``; falls back to
+            exact when masked/short).
 
     debug: instrument the jitted EM step with ``jax.experimental.checkify``
            float checks (NaN/inf/div-by-zero on every primitive, threaded
@@ -62,10 +67,11 @@ class EMConfig:
 
     def filter_fn(self):
         return {"dense": kalman_filter, "info": info_filter,
-                "pit": pit_filter}[self.filter]
+                "pit": pit_filter, "pit_qr": pit_qr_filter}[self.filter]
 
     def smoother_fn(self):
-        return pit_smoother if self.filter == "pit" else rts_smoother
+        return {"pit": pit_smoother,
+                "pit_qr": pit_qr_smoother}.get(self.filter, rts_smoother)
 
     def e_step(self, Y, mask, p, sumsq=None):
         """Filter + smoother under the configured implementation.

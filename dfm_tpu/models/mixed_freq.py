@@ -55,16 +55,20 @@ class MixedFreqSpec:
     r_floor: float = 1e-6
     estimate_init: bool = False
     # E-step time recursion: "seq" (lax.scan filter + RTS — the oracle
-    # path) or "pit" (parallel-in-time blocked prefix scans, ~2 sqrt(T)
+    # path), "pit" (parallel-in-time blocked prefix scans, ~2 sqrt(T)
     # sequential depth instead of 2T — the m = L*k augmented scans are the
     # S3 iteration's dominant cost and the mask rules out the steady-state
-    # shortcut).  Exact same algebra; equivalence tested.
+    # shortcut), or "pit_qr" (same prefix-scan depth on square-root / QR
+    # elements — f32-stable combines; above QR_UNROLL_K_MAX the augmented
+    # state falls back to the generic triangular lowerings).  Exact same
+    # algebra; equivalence tested.
     time_scan: str = "seq"
 
     def __post_init__(self):
-        if self.time_scan not in ("seq", "pit"):
+        if self.time_scan not in ("seq", "pit", "pit_qr"):
             raise ValueError(
-                f"time_scan must be 'seq' or 'pit'; got {self.time_scan!r}")
+                f"time_scan must be 'seq', 'pit' or 'pit_qr'; "
+                f"got {self.time_scan!r}")
 
     @property
     def state_dim(self) -> int:
@@ -160,6 +164,9 @@ def mf_em_core(Y, mask, p: MFParams, spec: MixedFreqSpec,
     if spec.time_scan == "pit":
         from ..ssm.parallel_filter import pit_from_stats, pit_smoother
         xp, Pp, xf, Pf, logdetG = pit_from_stats(stats_acc, aug_acc)
+    elif spec.time_scan == "pit_qr":
+        from ..ssm.parallel_filter import pit_qr_from_stats, pit_qr_smoother
+        xp, Pp, xf, Pf, logdetG = pit_qr_from_stats(stats_acc, aug_acc)
     else:
         xp, Pp, xf, Pf, logdetG = info_scan(stats_acc, aug_acc.A, aug_acc.Q,
                                             aug_acc.mu0, aug_acc.P0)
@@ -168,8 +175,12 @@ def mf_em_core(Y, mask, p: MFParams, spec: MixedFreqSpec,
     kf = FilterResult(xp, Pp, xf, Pf,
                       loglik_from_terms(stats_acc, logdetG, Pf,
                                         quad_R, U.astype(acc)))
-    sm = (pit_smoother(kf, aug_acc) if spec.time_scan == "pit"
-          else rts_smoother(kf, aug_acc))
+    if spec.time_scan == "pit":
+        sm = pit_smoother(kf, aug_acc)
+    elif spec.time_scan == "pit_qr":
+        sm = pit_qr_smoother(kf, aug_acc)
+    else:
+        sm = rts_smoother(kf, aug_acc)
 
     x, P = sm.x_sm.astype(dtype), sm.P_sm.astype(dtype)  # (T, m), (T, m, m)
     EffT = P + jnp.einsum("ti,tj->tij", x, x)

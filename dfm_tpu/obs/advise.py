@@ -29,20 +29,26 @@ __all__ = ["advise", "advise_fleet", "advise_jobs", "candidate_plans",
 def candidate_plans(chunk: int = 8) -> List[dict]:
     """The plan grid: every knob combination the advisor considers.
     Kept small and structured — each row maps 1:1 onto fit() knobs
-    (``fused=``/``pipeline=``/backend ``fused_chunk``)."""
+    (``fused=``/``pipeline=``/backend ``fused_chunk``/``filter=``);
+    ``filter`` is the time-scan engine (``seq`` = sequential scan,
+    ``pit_qr`` = parallel-in-time QR — the long-T log-depth play)."""
     return [
         {"engine": "fused", "fused_chunk": chunk, "depth": 1,
-         "bucket": False},
+         "bucket": False, "filter": "seq"},
         {"engine": "fused", "fused_chunk": 2 * chunk, "depth": 1,
-         "bucket": False},
+         "bucket": False, "filter": "seq"},
         {"engine": "chunked", "fused_chunk": chunk, "depth": 1,
-         "bucket": False},
+         "bucket": False, "filter": "seq"},
         {"engine": "chunked", "fused_chunk": chunk, "depth": 2,
-         "bucket": False},
+         "bucket": False, "filter": "seq"},
         {"engine": "chunked", "fused_chunk": chunk, "depth": 2,
-         "bucket": True},
+         "bucket": True, "filter": "seq"},
         {"engine": "chunked", "fused_chunk": chunk, "depth": 4,
-         "bucket": True},
+         "bucket": True, "filter": "seq"},
+        {"engine": "chunked", "fused_chunk": chunk, "depth": 1,
+         "bucket": False, "filter": "pit_qr"},
+        {"engine": "fused", "fused_chunk": chunk, "depth": 1,
+         "bucket": False, "filter": "pit_qr"},
     ]
 
 
@@ -66,11 +72,16 @@ def advise(N: int, T: int, k: int, *, max_iters: int = 50, chunk: int = 8,
     for cand in candidate_plans(chunk):
         pred = model.predict(N, T, k, max_iters, engine=cand["engine"],
                              chunk=cand["fused_chunk"],
-                             depth=cand["depth"], bucket=cand["bucket"])
+                             depth=cand["depth"], bucket=cand["bucket"],
+                             filter=cand.get("filter", "seq"))
         plans.append({**cand, **pred})
-    # Deterministic rank: predicted wall, then the stable knob tuple.
+    # Deterministic rank: predicted wall, then the stable knob tuple
+    # (ties prefer the sequential scan — "seq" < "pit_qr" alphabetically
+    # is a happy accident we pin here on purpose: equal predictions keep
+    # the default engine).
     plans.sort(key=lambda p: (p["predicted_wall_s"], p["engine"],
-                              p["depth"], p["fused_chunk"], p["bucket"]))
+                              p.get("filter", "seq"), p["depth"],
+                              p["fused_chunk"], p["bucket"]))
     for i, p in enumerate(plans):
         p["rank"] = i + 1
     return {"shape": {"N": int(N), "T": int(T), "k": int(k)},
@@ -203,9 +214,12 @@ def _parse_jobs(spec: str):
 
 
 def _plan_str(p: dict) -> str:
+    eng = p["engine"]
+    if p.get("filter", "seq") != "seq":
+        eng += f"+{p['filter']}"
     if p["engine"] == "fused":
-        return f"fused (chunk={p['fused_chunk']})"
-    s = f"chunked (chunk={p['fused_chunk']}, depth={p['depth']}"
+        return f"{eng} (chunk={p['fused_chunk']})"
+    s = f"{eng} (chunk={p['fused_chunk']}, depth={p['depth']}"
     return s + (", bucket)" if p["bucket"] else ")")
 
 

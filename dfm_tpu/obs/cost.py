@@ -162,21 +162,54 @@ DEFAULT_COEFFS: Dict[str, Dict[str, float]] = {
 }
 
 
-def _norm_plan(engine: str, chunk, depth, bucket) -> Tuple:
-    return (str(engine), int(chunk or 8), int(depth or 1), bool(bucket))
+# The parallel-in-time QR engine trades the O(T) sequential scan depth
+# for ~2*sqrt(T) blocked-prefix-scan steps at a constant-factor flop
+# overhead (square-root element build + thin-QR combines).  The factor is
+# a structural prior — profiles anchor the real number per shape.
+PIT_QR_FLOP_MULT = 4.0
+
+
+def _norm_plan(engine: str, chunk, depth, bucket, filt=None) -> Tuple:
+    return (str(engine), int(chunk or 8), int(depth or 1), bool(bucket),
+            str(filt or "seq"))
+
+
+def _pad_plan(plan) -> List:
+    """Legacy 4-element plan lists (pre-filter registries) mean the
+    sequential time scan."""
+    plan = list(plan)
+    return plan + ["seq"] if len(plan) == 4 else plan
 
 
 def _profile_plan(config: dict) -> Optional[Tuple]:
     """Map a ProfileRecord config to a normalized plan tuple (the
-    ``pipelined`` variant is the chunked engine at depth>1)."""
+    ``pipelined`` variant is the chunked engine at depth>1; the
+    ``pit_qr`` variant is the chunked engine under the parallel-in-time
+    QR filter)."""
     variant = config.get("profile")
+    flt = config.get("filter")
     if variant == "fused":
-        return _norm_plan("fused", config.get("chunk"), 1, False)
-    if variant in ("chunked", "pipelined"):
+        return _norm_plan("fused", config.get("chunk"), 1, False, flt)
+    if variant in ("chunked", "pipelined", "pit_qr"):
         depth = config.get("depth") or (2 if variant == "pipelined" else 1)
         return _norm_plan("chunked", config.get("chunk"), depth,
-                          config.get("bucket"))
+                          config.get("bucket"),
+                          "pit_qr" if variant == "pit_qr" else flt)
     return None
+
+
+def _iter_features(T: float, flops: float, bytes_: float,
+                   filt: str = "seq") -> Tuple[float, float, float]:
+    """Per-iteration cost features under a time-scan engine: sequential
+    depth, flops, bytes.  pit_qr replaces the T-step depth with the
+    blocked prefix scan's ~2*sqrt(T) and pays the element/combine flop
+    multiplier — the SAME feature map calibration and prediction use, so
+    pit_qr profiles sharpen the shared coefficients instead of skewing
+    them."""
+    if filt == "pit_qr":
+        return (2.0 * math.sqrt(max(T, 1.0)), PIT_QR_FLOP_MULT * flops,
+                PIT_QR_FLOP_MULT * bytes_)
+    return (float(T), float(flops), float(bytes_))
 
 
 @dataclasses.dataclass
@@ -184,11 +217,13 @@ class CostModel:
     """Wall-time predictor for a fit plan at shape (N, T, k).
 
     ``predicted = overhead + n_program_dispatches * dispatch_floor +
-    iters * iter_s(N, T, k)`` where ``iter_s = T*step + flops*per_flop +
-    bytes*per_byte`` — and when the registry holds a profile at the EXACT
-    plan+shape, the prediction is anchored to that measured warm median
-    instead (extrapolated across iteration counts by the model's own
-    marginal rate)."""
+    iters * iter_s(N, T, k)`` where ``iter_s = steps*step_s +
+    flops*per_flop + bytes*per_byte`` with ``steps = T`` for the
+    sequential scan and ``~2*sqrt(T)`` (at a flop multiplier) for the
+    ``pit_qr`` time-parallel engine — and when the registry holds a
+    profile at the EXACT plan+shape, the prediction is anchored to that
+    measured warm median instead (extrapolated across iteration counts
+    by the model's own marginal rate)."""
 
     device: str = "cpu"
     dispatch_floor_s: float = 1e-3
@@ -198,12 +233,19 @@ class CostModel:
     overhead_s: float = 0.05
     calibrated: bool = False
     n_profiles: int = 0
+    # Residual multiplier for the pit_qr feature family: the structural
+    # prior (2*sqrt(T) depth, 4x flops) is corrected by the measured
+    # pit_qr profiles so an UNmeasured pit_qr plan never undercuts the
+    # family's own measurements at other knobs.
+    pit_qr_scale: float = 1.0
     anchors: List[dict] = dataclasses.field(default_factory=list)
 
-    def iter_s(self, N: int, T: int, k: int) -> float:
+    def iter_s(self, N: int, T: int, k: int, filt: str = "seq") -> float:
         flops, bytes_ = em_iter_work(N, T, k)
-        return (self.step_s * T + self.per_flop_s * flops
-                + self.per_byte_s * bytes_)
+        steps, flops, bytes_ = _iter_features(T, flops, bytes_, filt)
+        it = (self.step_s * steps + self.per_flop_s * flops
+              + self.per_byte_s * bytes_)
+        return it * self.pit_qr_scale if filt == "pit_qr" else it
 
     def dispatches(self, iters: int, *, engine: str, chunk: int = 8,
                    depth: int = 1) -> int:
@@ -215,15 +257,15 @@ class CostModel:
 
     def _anchor(self, plan: Tuple, N: int, T: int, k: int):
         cands = [a for a in self.anchors
-                 if (a["plan"] == list(plan) or tuple(a["plan"]) == plan)
+                 if _pad_plan(a["plan"]) == list(plan)
                  and (a["N"], a["T"], a["k"]) == (N, T, k)]
         return max(cands, key=lambda a: a["iters"]) if cands else None
 
     def predict(self, N: int, T: int, k: int, iters: int, *,
                 engine: str, chunk: int = 8, depth: int = 1,
-                bucket: bool = False) -> dict:
-        plan = _norm_plan(engine, chunk, depth, bucket)
-        it = self.iter_s(N, T, k)
+                bucket: bool = False, filter: str = "seq") -> dict:
+        plan = _norm_plan(engine, chunk, depth, bucket, filter)
+        it = self.iter_s(N, T, k, filter)
         anchor = self._anchor(plan, N, T, k)
         if anchor is not None:
             # Measured wall at this exact config; the model only fills in
@@ -310,18 +352,24 @@ def fit_cost_model(profiles: Iterable[dict],
             flops = float(m["flops_per_iter"])
         if isinstance(m.get("bytes_per_iter"), (int, float)):
             bytes_ = float(m["bytes_per_iter"])
-        obs.append(((float(T), flops, bytes_), float(it_ms) / 1e3,
-                    (N, T, k)))
+        flt = ("pit_qr" if c.get("profile") == "pit_qr"
+               else c.get("filter") or "seq")
+        obs.append((_iter_features(T, flops, bytes_, flt),
+                    float(it_ms) / 1e3, (N, T, k, flt)))
 
     if obs:
         model.calibrated = True
+        # Shared coefficients come from the sequential-scan profiles; the
+        # pit_qr family carries its own residual scale below (a registry
+        # with ONLY pit_qr profiles still calibrates, off those).
+        seq_obs = [o for o in obs if o[2][3] == "seq"] or obs
         coeffs = None
-        if len({shape for _, _, shape in obs}) >= 3:
+        if len({shape for _, _, shape in seq_obs}) >= 3:
             # Enough shape diversity for a genuine 3-param fit (tiny ridge
             # keeps the normal equations sane when features correlate).
             A = [[0.0] * 3 for _ in range(3)]
             rhs = [0.0] * 3
-            for f, y, _ in obs:
+            for f, y, _ in seq_obs:
                 for i in range(3):
                     rhs[i] += f[i] * y
                     for j in range(3):
@@ -337,10 +385,17 @@ def fit_cost_model(profiles: Iterable[dict],
             def prior_it(f):
                 return (prior["step_s"] * f[0] + prior["per_flop_s"] * f[1]
                         + prior["per_byte_s"] * f[2])
-            scale = median([y / prior_it(f) for f, y, _ in obs])
+            scale = median([y / prior_it(f) for f, y, _ in seq_obs])
             coeffs = [prior["step_s"] * scale, prior["per_flop_s"] * scale,
                       prior["per_byte_s"] * scale]
         model.step_s, model.per_flop_s, model.per_byte_s = coeffs
+        pit_obs = [(f, y) for f, y, s in obs if s[3] == "pit_qr"]
+        if pit_obs:
+            def model_it(f):
+                return (model.step_s * f[0] + model.per_flop_s * f[1]
+                        + model.per_byte_s * f[2])
+            model.pit_qr_scale = median(
+                [y / max(model_it(f), 1e-30) for f, y in pit_obs])
 
     # Anchors + fixed overhead residual.
     overheads = []
@@ -358,10 +413,10 @@ def fit_cost_model(profiles: Iterable[dict],
         model.anchors.append({"plan": list(plan), "N": N, "T": T, "k": k,
                               "iters": iters,
                               "warm_wall_s": float(warm)})
-        engine, chunk, depth, _ = plan
+        engine, chunk, depth, _, flt = plan
         nd = model.dispatches(iters, engine=engine, chunk=chunk, depth=depth)
         ov = (float(warm) - nd * model.dispatch_floor_s
-              - iters * model.iter_s(N, T, k))
+              - iters * model.iter_s(N, T, k, flt))
         overheads.append(max(ov, 0.0))
     if overheads:
         model.overhead_s = median(overheads)

@@ -724,7 +724,10 @@ def _print_text(s: dict) -> None:
     a = s.get("advice")
     if a:
         pred, real = a.get("predicted_wall_s"), a.get("realized_wall_s")
-        line = f"advice: {a.get('engine', '?')} plan"
+        eng = a.get("engine", "?")
+        if a.get("filter") not in (None, "seq"):
+            eng += f"+{a['filter']}"   # time-scan engine (e.g. pit_qr)
+        line = f"advice: {eng} plan"
         if a.get("engine") == "fused" and a.get("fused_chunk") is not None:
             line += f" (fused_chunk={a['fused_chunk']})"
         elif a.get("depth") is not None:

@@ -45,7 +45,7 @@ _LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
                          "compile_s", "dispatch_s", "transfer_s", "host_s",
                          "rel_err", "blocking_transfers",
                          "dispatches_per_fit", "pad_waste", "degraded",
-                         "slo_burn_rate", "flight_dumps")
+                         "slo_burn_rate", "flight_dumps", "noise_ratio")
 
 
 def lower_is_better(metric: str) -> bool:
@@ -71,6 +71,10 @@ _NOISE_FLOORS = (
     # the shared CI box moves it by tenths without any code-level signal.
     ("slo_burn_rate", 0.25),
     ("flight_dumps", 0.5),   # integer count; any single dump is signal
+    # pit_qr vs sequential f32 loglik-noise ratio (bench.longt): both
+    # errors sit near eps*N*T, so run-to-run DGP draws move the ratio by
+    # halves without any numerics-level signal.
+    ("noise_ratio", 0.5),
     ("ms", 2.0),           # milliseconds: ms_per, _ms, dispatch_ms_...
     ("_s", 0.05),          # seconds: wall_s, dispatch_s, compile_s, time_s
     ("secs", 0.05),
@@ -278,6 +282,12 @@ _BENCH_NUMERIC_KEYS = (
     # during the bench, and flight-recorder dumps triggered by it —
     # both ~0 on a healthy run (lower-is-better, floors above).
     "fleet_slo_burn_rate", "flight_dumps",
+    # Long-T time-parallel sweep (bench.longt): pit_qr speedup vs the
+    # sequential scan at each sweep point (higher-is-better; the T=1000
+    # crossover is the headline contract) and the f32 loglik-noise ratio
+    # vs sequential (lower-is-better, "noise_ratio" marker rows above).
+    "pit_qr_speedup_t300", "pit_qr_speedup_t1000", "pit_qr_speedup_t4000",
+    "pit_qr_noise_ratio",
 )
 
 

@@ -14,12 +14,21 @@ from jax.scipy.linalg import solve_triangular
 __all__ = ["sym", "psd_cholesky", "chol_solve", "chol_logdet",
            "solve_psd", "default_jitter", "chol_unrolled",
            "chol_solve_unrolled", "matmul_vpu", "matvec_vpu",
-           "UNROLL_K_MAX"]
+           "UNROLL_K_MAX", "QR_UNROLL_K_MAX", "tria_unrolled", "tria",
+           "tri_solve_unrolled", "tri_solve", "psd_factor_unrolled",
+           "psd_factor"]
 
 # Unrolling is ~k^2/2 fused elementwise ops for the factorization and
 # ~k^2 per solve column; past this bound compile time and op count beat
 # the batched-linalg savings.
 UNROLL_K_MAX = 8
+
+# The QR-factor parallel-in-time engine unrolls over the state dim too;
+# its ops are row-vector MGS steps (cheaper per entry than a chol pivot
+# chain), so the bound sits a little higher — k ~ 2-10 factor blocks stay
+# unrolled, the m ~ 15-25 mixed-frequency augmented states fall back to
+# the generic batched-linalg lowerings (correct, just not VPU-formed).
+QR_UNROLL_K_MAX = 10
 
 
 def sym(M: jax.Array) -> jax.Array:
@@ -141,3 +150,143 @@ def chol_solve_unrolled(L: jax.Array, B: jax.Array) -> jax.Array:
         cols.append(jnp.stack(x, axis=-1))
     X = jnp.stack(cols, axis=-1)
     return X[..., 0] if vec else X
+
+
+def tria_unrolled(X: jax.Array) -> jax.Array:
+    """Unrolled thin-QR "Tria" operator: lower-triangular L with L L' = X X'.
+
+    ``X`` is (..., k, m) with SMALL static k (m is typically 2k: two stacked
+    square-root factors side by side).  L is the transposed R factor of a
+    thin QR of X' — computed here as modified Gram-Schmidt on the ROWS of X
+    (k rows of length m), which is ~k^2/2 fused dot/axpy VPU ops over the
+    batch dims and never touches a linalg primitive (``jnp.linalg.qr`` on
+    (B, 2k, k) hits the same ~100x batched-linalg lowering penalty as the
+    batched Cholesky this module already unrolls).  Unlike the Gram-matrix
+    route chol(X X'), MGS never squares the condition number — this is the
+    orthogonal-transformation stability the QR-factor filter rides on
+    (PAPERS.md, arXiv 2502.11686).
+
+    Exactly-zero rows (structural: t=0 elements carry Z = 0, fully-masked
+    steps carry U = 0) produce a zero row in L; near-dependent rows resolve
+    to a ~eps diagonal like any rank-revealing factorization would.  The
+    diagonal of L is >= 0 by construction.
+    """
+    k = X.shape[-2]
+    q: list = [None] * k
+    L: list = [[None] * k for _ in range(k)]
+    zero = jnp.zeros_like(X[..., 0, 0])
+    for i in range(k):
+        v = X[..., i, :]
+        for j in range(i):
+            c = (v * q[j]).sum(-1)
+            L[i][j] = c
+            v = v - c[..., None] * q[j]
+        nrm = jnp.sqrt((v * v).sum(-1))
+        L[i][i] = nrm
+        nz = nrm[..., None] > 0
+        q[i] = jnp.where(nz, v / jnp.where(nz, nrm[..., None], 1.0), 0.0)
+    rows = [jnp.stack([L[i][j] if j <= i else zero for j in range(k)],
+                      axis=-1) for i in range(k)]
+    return jnp.stack(rows, axis=-2)
+
+
+def tria(X: jax.Array) -> jax.Array:
+    """``tria_unrolled`` for k <= QR_UNROLL_K_MAX, generic fallback above.
+
+    The fallback forms the Gram matrix and takes its (jittered) Cholesky —
+    mathematically the same L, acceptable for the large augmented states
+    that only run in the f64 accumulation dtype anyway.
+    """
+    k = X.shape[-2]
+    if k <= QR_UNROLL_K_MAX:
+        return tria_unrolled(X)
+    return psd_cholesky(X @ jnp.swapaxes(X, -1, -2))
+
+
+def tri_solve_unrolled(L: jax.Array, B: jax.Array,
+                       trans: bool = False) -> jax.Array:
+    """Solve L X = B (or L' X = B with ``trans``) by unrolled substitution.
+
+    ``L`` lower-triangular with small static k; ``B`` (..., k) or
+    (..., k, r).  Every op is an elementwise multiply-add over the batch
+    dims (the single-triangle half of ``chol_solve_unrolled``).  Division
+    is guarded on exactly-zero pivots (structural zero rows from ``tria``/
+    ``psd_factor`` factors): a zero pivot with a consistent RHS yields 0,
+    matching the pseudo-inverse the semidefinite algebra expects.
+    """
+    vec = B.ndim == L.ndim - 1
+    if vec:
+        B = B[..., None]
+    k = L.shape[-1]
+    r = B.shape[-1]
+    diag = [L[..., i, i] for i in range(k)]
+    safe = [jnp.where(d > 0, d, 1.0) for d in diag]
+    cols = []
+    for c in range(r):
+        x: list = [None] * k
+        order = reversed(range(k)) if trans else range(k)
+        for i in order:
+            s = B[..., i, c]
+            if trans:
+                for j in range(i + 1, k):
+                    s = s - L[..., j, i] * x[j]
+            else:
+                for j in range(i):
+                    s = s - L[..., i, j] * x[j]
+            x[i] = jnp.where(diag[i] > 0, s / safe[i], 0.0)
+        cols.append(jnp.stack(x, axis=-1))
+    X = jnp.stack(cols, axis=-1)
+    return X[..., 0] if vec else X
+
+
+def tri_solve(L: jax.Array, B: jax.Array, trans: bool = False) -> jax.Array:
+    """``tri_solve_unrolled`` for small k, ``solve_triangular`` above."""
+    if L.shape[-1] <= QR_UNROLL_K_MAX:
+        return tri_solve_unrolled(L, B, trans=trans)
+    vec = B.ndim == L.ndim - 1
+    if vec:
+        B = B[..., None]
+    X = solve_triangular(L, B, lower=True, trans=1 if trans else 0)
+    return X[..., 0] if vec else X
+
+
+def psd_factor_unrolled(P: jax.Array) -> jax.Array:
+    """Guarded Cholesky-type factor of a possibly-SINGULAR PSD matrix.
+
+    Same unrolled elementwise structure as ``chol_unrolled``, but pivots
+    at or below ~eps * diag are treated as exact zeros (zero row/column in
+    the factor) instead of producing NaN.  This is a FACTOR-CONSTRUCTION
+    helper for the square-root filter elements — observation precisions
+    C_t = Lam' W R^{-1} Lam are rank-deficient whenever a step observes
+    fewer than k series (and exactly zero on fully-masked steps), and the
+    mixed-frequency augmented Q has rank k out of m.  ``chol_unrolled``
+    keeps its fail-visibly contract for genuinely indefinite inputs; use
+    THAT for matrices that must be positive definite.
+    """
+    k = P.shape[-1]
+    eps = float(jnp.finfo(P.dtype).eps)
+    L: list = [[None] * k for _ in range(k)]
+    for i in range(k):
+        s = P[..., i, i]
+        for j in range(i):
+            s = s - L[i][j] * L[i][j]
+        tol = eps * k * jnp.abs(P[..., i, i])
+        live = s > tol
+        d = jnp.sqrt(jnp.where(live, s, 1.0))
+        L[i][i] = jnp.where(live, d, 0.0)
+        for r in range(i + 1, k):
+            s2 = P[..., r, i]
+            for j in range(i):
+                s2 = s2 - L[r][j] * L[i][j]
+            L[r][i] = jnp.where(live, s2 / d, 0.0)
+    zeros = jnp.zeros_like(P[..., 0, 0])
+    rows = [jnp.stack([L[i][j] if j <= i else zeros for j in range(k)],
+                      axis=-1) for i in range(k)]
+    return jnp.stack(rows, axis=-2)
+
+
+def psd_factor(P: jax.Array) -> jax.Array:
+    """``psd_factor_unrolled`` for small k; jittered Cholesky above it."""
+    if P.shape[-1] <= QR_UNROLL_K_MAX:
+        return psd_factor_unrolled(P)
+    return psd_cholesky(P)

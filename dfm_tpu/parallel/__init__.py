@@ -5,6 +5,8 @@ reference's (nonexistent) multi-process story, per BASELINE.json:5."""
 from .mesh import SERIES_AXIS, make_mesh, pad_panel, unpad_rows
 from .sharded import (ShardedEM, sharded_em_step, sharded_em_scan,
                       sharded_em_fit, sharded_filter_smoother)
+from .time_sharded import (TIME_AXIS, make_time_mesh, pit_qr_time_sharded,
+                           pit_qr_filter_time_sharded)
 from .batched import (BATCH_AXIS, make_batch_mesh, run_batched_em_sharded,
                       batched_smooth_sharded)
 from .sharded_mf import sharded_mf_fit
@@ -13,6 +15,8 @@ from .sharded_tvl import sharded_tvl_fit
 
 __all__ = [
     "SERIES_AXIS", "make_mesh", "pad_panel", "unpad_rows",
+    "TIME_AXIS", "make_time_mesh", "pit_qr_time_sharded",
+    "pit_qr_filter_time_sharded",
     "ShardedEM", "sharded_em_step", "sharded_em_scan", "sharded_em_fit",
     "sharded_filter_smoother", "sharded_mf_fit", "sharded_sv_filter",
     "sharded_tvl_fit",

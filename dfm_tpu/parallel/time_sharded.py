@@ -1,0 +1,306 @@
+"""Time-sharded square-root parallel-in-time filtering/smoothing.
+
+The series-sharded EM (``parallel.sharded``) splits the CROSS-SECTION and
+replicates the time recursion on every device.  At long T the recursion
+itself is the cost, so this module splits the TIME axis instead: each of
+the D devices builds the square-root (QR-factor) associative elements for
+its own T/D-slab (``ssm.parallel_filter.qr_generic_elements``), runs the
+local blocked prefix scan, and the shards are stitched with ONE log-depth
+cross-device combine of the D boundary elements:
+
+  1. local inclusive prefix products per shard (``ops.scan.blocked_scan``,
+     ~2 sqrt(T/D) sequential depth);
+  2. ``all_gather`` of the D per-shard TOTAL products (a few (k, k)
+     factors each — the only cross-device payload);
+  3. a replicated Hillis-Steele doubling over the gathered totals
+     (log2(D) batched combines) gives every shard the exclusive prefix of
+     everything before it, and one more batched combine folds that offset
+     into the local prefixes.
+
+The offset element's (b, U) IS the previous shard's last filtered
+posterior, so each shard recovers its own predicted moments (first slot
+from the offset, the rest locally) and its local log-likelihood pieces;
+the total loglik is one psum.  The smoother runs the same machinery in
+reverse (suffix products; the boundary (x_pred, Lp) of the NEXT shard
+arrives by ppermute — the last shard receives zeros, which degenerate
+exactly into the anchor element).
+
+Padding: T is padded up to a multiple of D with zero-mask rows.  A fully
+unobserved step contributes C_t = 0, n_t = 0 stats, so its loglik pieces
+vanish and smoothing through it is the identity correction — trailing pad
+rows are exactly inert (they sit AFTER every real row in the prefix
+order) and are dropped on exit.  Equivalence with the single-device
+``pit_qr_filter_smoother`` is pinned by ``tests/test_time_sharded.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import shard_map
+from ..ops.linalg import (matmul_vpu, matvec_vpu, tria, psd_factor,
+                          chol_unrolled, chol_solve_unrolled, psd_cholesky,
+                          chol_solve, chol_logdet, QR_UNROLL_K_MAX)
+from ..ssm.info_filter import (obs_stats, loglik_terms_local,
+                               loglik_from_terms)
+from ..ssm.parallel_filter import (qr_generic_elements, qr_init_posterior,
+                                   qr_combine_filter, qr_combine_smoother,
+                                   _gram)
+from ..ssm.params import SSMParams, FilterResult, SmootherResult
+from ..ops.scan import blocked_scan
+
+__all__ = ["TIME_AXIS", "make_time_mesh", "pit_qr_time_sharded",
+           "pit_qr_filter_time_sharded"]
+
+TIME_AXIS = "time"
+
+
+def make_time_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices, axis "time"."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)} "
+                f"(hint: XLA_FLAGS=--xla_force_host_platform_device_count=K)")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (TIME_AXIS,))
+
+
+def _filter_identity(k, dtype):
+    """Identity of the filtering semigroup: A=I, b=0, U=0, eta=0, Z=0.
+
+    Exact through ``qr_combine_filter`` up to an orthogonal right-factor
+    on U/Z (grams — the only thing downstream consumes — are preserved).
+    """
+    I_k = jnp.eye(k, dtype=dtype)
+    z_kk = jnp.zeros((k, k), dtype)
+    z_k = jnp.zeros((k,), dtype)
+    return (I_k, z_k, z_kk, z_k, z_kk)
+
+
+def _smoother_identity(k, dtype):
+    """Identity of the smoothing semigroup (as the LATER argument):
+    E=I, g=0, D=0."""
+    return (jnp.eye(k, dtype=dtype), jnp.zeros((k,), dtype),
+            jnp.zeros((k, k), dtype))
+
+
+def _exclusive_doubling(combine, totals, identity):
+    """Exclusive prefix of (D, ...) leaves under ``combine`` (arg order:
+    earlier, later) via Hillis-Steele doubling — log2(D) batched combines.
+    Slot s receives totals[0] o ... o totals[s-1]; slot 0 the identity.
+    """
+    D = totals[0].shape[0]
+    idb = tuple(jnp.broadcast_to(i, (1,) + i.shape) for i in identity)
+    # Shift the identity in: x = [id, t_0, ..., t_{D-2}].
+    x = tuple(jnp.concatenate([i, t[:-1]], axis=0)
+              for i, t in zip(idb, totals))
+    d = 1
+    while d < D:
+        pad = tuple(jnp.broadcast_to(i, (d,) + i.shape[1:]) for i in idb)
+        shifted = tuple(jnp.concatenate([p, xi[:-d]], axis=0)
+                        for p, xi in zip(pad, x))
+        x = combine(shifted, x)
+        d *= 2
+    return x
+
+
+def _bcast(e, L):
+    """Broadcast a single element's leaves to a leading (L,) batch axis."""
+    return tuple(jnp.broadcast_to(x, (L,) + x.shape) for x in e)
+
+
+def _take(e, i):
+    return tuple(x[i] for x in e)
+
+
+@partial(jax.jit, static_argnames=("mesh", "has_mask", "scan_impl"))
+def _pit_qr_time_sharded_impl(Y, mask, p, mesh, has_mask,
+                              scan_impl="blocked"):
+    k = p.A.shape[0]
+    dtype = Y.dtype
+    nsh = mesh.devices.size          # static: ppermute perms need ints
+
+    def body(Y_loc, W_loc, p):
+        A, Q, mu0, P0 = p.A, p.Q, p.mu0, p.P0
+        idx = lax.axis_index(TIME_AXIS)
+        is0 = idx == 0
+        L = Y_loc.shape[0]
+        m_loc = W_loc if has_mask else None
+        stats = obs_stats(Y_loc, p.Lam, p.R, mask=m_loc)
+        C_loc = stats.C
+        if C_loc.ndim == 2:
+            C_loc = jnp.broadcast_to(C_loc, (L, k, k))
+
+        # --- local elements; prior correction on shard 0's slot 0 only ---
+        elems = qr_generic_elements(stats, A, Q)
+        b0, U0 = qr_init_posterior(C_loc[0], stats.b[0], mu0, P0)
+        t0 = (jnp.zeros((k, k), dtype), b0, U0, jnp.zeros((k,), dtype),
+              jnp.zeros((k, k), dtype))
+        e0 = tuple(jnp.where(is0, a, b[0]) for a, b in zip(t0, elems))
+        elems = tuple(b.at[0].set(a) for a, b in zip(e0, elems))
+
+        # --- local prefix + one log-depth cross-device boundary combine ---
+        if scan_impl == "blocked":
+            pref = blocked_scan(qr_combine_filter, elems)
+        else:
+            pref = lax.associative_scan(qr_combine_filter, elems)
+        totals = tuple(x[-1] for x in pref)
+        gathered = tuple(lax.all_gather(x, TIME_AXIS) for x in totals)
+        offs = _exclusive_doubling(qr_combine_filter, gathered,
+                                   _filter_identity(k, dtype))
+        off = _take(offs, idx)
+        folded = qr_combine_filter(_bcast(off, L), pref)
+        # Shard 0's offset is the identity — keep its local prefix bit-
+        # exact instead of re-orthogonalizing through the combine.
+        glob = tuple(jnp.where(is0, a, b) for a, b in zip(pref, folded))
+
+        x_f, U_f = glob[1], glob[2]
+        P_f = _gram(U_f)
+
+        # --- predicted moments: slot 0 from the offset's (b, U) (= the
+        # previous shard's last filtered posterior); shard 0 from the
+        # prior.  Never a re-factorization of a rounded covariance. ---
+        Lq = psd_factor(Q)
+        AU = matmul_vpu(jnp.broadcast_to(A, (L - 1, k, k)), U_f[:-1])
+        Lp_tail = tria(jnp.concatenate(
+            [AU, jnp.broadcast_to(Lq, (L - 1, k, k))], axis=-1))
+        Lp0_first = tria(jnp.concatenate([A @ off[2], Lq], axis=-1))
+        Lp_first = jnp.where(is0, psd_factor(P0), Lp0_first)
+        Lp = jnp.concatenate([Lp_first[None], Lp_tail], axis=0)
+        P_pred = _gram(Lp)
+        xp_first = jnp.where(is0, mu0, A @ off[1])
+        x_pred = jnp.concatenate([xp_first[None], x_f[:-1] @ A.T], axis=0)
+
+        # --- local loglik pieces; ONE psum for the total ---
+        LpT_C = matmul_vpu(jnp.swapaxes(Lp, -1, -2), C_loc)
+        G = jnp.eye(k, dtype=dtype)[None] + matmul_vpu(LpT_C, Lp)
+        chol = chol_unrolled if k <= QR_UNROLL_K_MAX else \
+            (lambda M: psd_cholesky(M, jitter=0.0))
+        logdetG = chol_logdet(chol(G))
+        quad_R, U = loglik_terms_local(Y_loc, p.Lam, p.R, x_pred, m_loc)
+        ll = lax.psum(loglik_from_terms(stats, logdetG, P_f, quad_R, U),
+                      TIME_AXIS)
+
+        # --- smoother: boundary (x_pred, Lp) of the NEXT shard arrives by
+        # ppermute; the last shard has no successor — its received factor
+        # is replaced with I and the slot's gain forced to J = 0, which
+        # degenerates the element into the anchor (E = 0, g = x_f,
+        # D ~ U_f). ---
+        is_last = idx == nsh - 1
+        perm = [(s + 1, s) for s in range(nsh - 1)]
+        xp_next = lax.ppermute(x_pred[0], TIME_AXIS, perm)
+        Lp_next_first = lax.ppermute(Lp[0], TIME_AXIS, perm)
+        Lp_next_first = jnp.where(is_last, jnp.eye(k, dtype=dtype),
+                                  Lp_next_first)
+        Lp_next = jnp.concatenate([Lp[1:], Lp_next_first[None]], axis=0)
+        xpn = jnp.concatenate([x_pred[1:], xp_next[None]], axis=0)
+
+        chol_slv = chol_solve_unrolled if k <= QR_UNROLL_K_MAX else chol_solve
+        APf = matmul_vpu(jnp.broadcast_to(A, (L, k, k)), P_f)
+        J = jnp.swapaxes(chol_slv(Lp_next, APf), -1, -2)      # (L, k, k)
+        J = J.at[-1].set(jnp.where(is_last, jnp.zeros((k, k), dtype),
+                                   J[-1]))
+        E = J
+        g = x_f - jnp.einsum("tkl,tl->tk", J, xpn)
+        ImJA = jnp.broadcast_to(jnp.eye(k, dtype=dtype), (L, k, k)) \
+            - matmul_vpu(J, jnp.broadcast_to(A, (L, k, k)))
+        D_el = tria(jnp.concatenate(
+            [matmul_vpu(ImJA, U_f),
+             matmul_vpu(J, jnp.broadcast_to(Lq, (L, k, k)))], axis=-1))
+        selems = (E, g, D_el)
+
+        if scan_impl == "blocked":
+            suf = blocked_scan(qr_combine_smoother, selems, reverse=True)
+        else:
+            suf = lax.associative_scan(qr_combine_smoother, selems,
+                                       reverse=True)
+        stot = tuple(x[0] for x in suf)
+        sgath = tuple(lax.all_gather(x, TIME_AXIS) for x in stot)
+        # Suffix offsets: flip to make it a prefix problem (leftmost =
+        # latest shard; the smoothing combine takes (later, earlier)).
+        sflip = tuple(jnp.flip(x, axis=0) for x in sgath)
+        soffs_f = _exclusive_doubling(
+            lambda a, b: qr_combine_smoother(a, b), sflip,
+            _smoother_identity(k, dtype))
+        soffs = tuple(jnp.flip(x, axis=0) for x in soffs_f)
+        soff = _take(soffs, idx)
+        sfolded = qr_combine_smoother(_bcast(soff, L), suf)
+        sglob = tuple(jnp.where(is_last, a, b) for a, b in zip(suf, sfolded))
+
+        x_sm, D_sm = sglob[1], sglob[2]
+        P_sm = _gram(D_sm)
+        # Lag covariance P_{t,t-1|T} = P_sm[t] J[t-1]': J[t-1] is local for
+        # slots >= 1; slot 0 needs the PREVIOUS shard's last J — ship it
+        # forward (shard 0's slot 0 is zeroed, same as single-device).
+        perm_fwd = [(s, s + 1) for s in range(nsh - 1)]
+        J_prev = lax.ppermute(J[-1], TIME_AXIS, perm_fwd)
+        J_shift = jnp.concatenate([J_prev[None], J[:-1]], axis=0)
+        P_lag = jnp.einsum("tij,tkj->tik", P_sm, J_shift)
+        P_lag = jnp.where(is0, P_lag.at[0].set(jnp.zeros((k, k), dtype)),
+                          P_lag)
+        return x_pred, P_pred, x_f, P_f, ll, x_sm, P_sm, P_lag
+
+    t_spec = P(TIME_AXIS)
+    rep = P()
+    out_specs = (t_spec, t_spec, t_spec, t_spec, rep,
+                 t_spec, t_spec, t_spec)
+    p_specs = jax.tree_util.tree_map(lambda _: rep, p)
+    return shard_map(body, mesh=mesh,
+                     in_specs=(t_spec, t_spec, p_specs),
+                     out_specs=out_specs)(Y, mask, p)
+
+
+def pit_qr_time_sharded(Y, p: SSMParams, mask=None,
+                        n_devices: Optional[int] = None,
+                        mesh: Optional[Mesh] = None,
+                        scan_impl: str = "blocked"):
+    """Time-sharded square-root PIT filter + smoother.
+
+    Returns ``(FilterResult, SmootherResult)`` with the same contract as
+    ``ssm.parallel_filter.pit_qr_filter_smoother`` (exact loglik, moments
+    to fp tolerance).  T is padded to a multiple of the mesh size with
+    zero-mask rows (exactly inert — module docstring) and unpadded on
+    exit.
+    """
+    if mesh is None:
+        mesh = make_time_mesh(n_devices)
+    D = mesh.devices.size
+    Y = jnp.asarray(Y)
+    p = p.astype(Y.dtype)
+    T, N = Y.shape
+    n_pad = (-T) % D
+    W = mask
+    if W is None:
+        W = jnp.ones((T, N), Y.dtype)
+    else:
+        W = jnp.asarray(W, Y.dtype)
+    if n_pad:
+        Y = jnp.concatenate([Y, jnp.zeros((n_pad, N), Y.dtype)], axis=0)
+        W = jnp.concatenate([W, jnp.zeros((n_pad, N), Y.dtype)], axis=0)
+    has_mask = bool(mask is not None or n_pad)
+    xp, Pp, xf, Pf, ll, x_sm, P_sm, P_lag = _pit_qr_time_sharded_impl(
+        Y, W, p, mesh, has_mask, scan_impl)
+    if n_pad:
+        xp, Pp, xf, Pf = (a[:T] for a in (xp, Pp, xf, Pf))
+        x_sm, P_sm, P_lag = (a[:T] for a in (x_sm, P_sm, P_lag))
+    return (FilterResult(xp, Pp, xf, Pf, ll),
+            SmootherResult(x_sm, P_sm, P_lag))
+
+
+def pit_qr_filter_time_sharded(Y, p: SSMParams, mask=None,
+                               n_devices: Optional[int] = None,
+                               mesh: Optional[Mesh] = None,
+                               scan_impl: str = "blocked") -> FilterResult:
+    """Filter-only entry (same stitched program; smoother outputs dropped
+    by XLA dead-code elimination when unused)."""
+    return pit_qr_time_sharded(Y, p, mask=mask, n_devices=n_devices,
+                               mesh=mesh, scan_impl=scan_impl)[0]

@@ -49,14 +49,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.linalg import sym, psd_cholesky, chol_solve, chol_logdet
+from ..ops.linalg import (sym, psd_cholesky, chol_solve, chol_logdet,
+                          default_jitter, chol_unrolled, chol_solve_unrolled,
+                          matmul_vpu, matvec_vpu, tria, tri_solve, psd_factor,
+                          QR_UNROLL_K_MAX)
 from ..ops.scan import blocked_scan
 from .info_filter import (ObsStats, obs_stats, loglik_terms_local,
                           loglik_from_terms)
 from .params import SSMParams, FilterResult, SmootherResult
 
 __all__ = ["pit_filter", "pit_smoother", "pit_filter_smoother",
-           "pit_from_stats"]
+           "pit_from_stats", "pit_qr_filter", "pit_qr_smoother",
+           "pit_qr_filter_smoother", "pit_qr_from_stats",
+           "qr_filter_elements", "qr_combine_filter", "qr_combine_smoother",
+           "qr_generic_elements", "qr_init_posterior"]
 
 _LOG2PI = 1.8378770664093453
 
@@ -101,13 +107,24 @@ def _filter_elements(stats: ObsStats, A, Q, mu0, P0):
 
 
 def _combine_filter(ei, ej):
-    """Associative filtering-element product (ei earlier, ej later)."""
+    """Associative filtering-element product (ei earlier, ej later).
+
+    f32 discipline (same ``sym``/jitter rules as ``ops.linalg``): the C/J
+    blocks are re-symmetrized on ENTRY — after ~sqrt(T) rounds of general
+    (non-Cholesky) solves the asymmetry drift compounds multiplicatively,
+    which is most of the legacy path's 4x-over-sequential f32 noise at S3
+    (docs/PERF.md) — and the D/E systems get the precision-matched
+    diagonal jitter before the solve (inert at 1e-10 in f64; in f32 it
+    conditions the near-singular products of long chains).  Pinned by a
+    tolerance test against the f64 sequential scan.
+    """
     Ai, bi, Ci, etai, Ji = ei
     Aj, bj, Cj, etaj, Jj = ej
     k = Ai.shape[-1]
-    I_k = jnp.eye(k, dtype=Ai.dtype)
-    D = I_k + Ci @ Jj if Ai.ndim == 2 else \
-        I_k[None] + jnp.einsum("...kl,...lm->...km", Ci, Jj)
+    Ci, Jj = sym(Ci), sym(Jj)
+    jit_eye = (1.0 + default_jitter(Ai.dtype)) * jnp.eye(k, dtype=Ai.dtype)
+    D = jit_eye + Ci @ Jj if Ai.ndim == 2 else \
+        jit_eye[None] + jnp.einsum("...kl,...lm->...km", Ci, Jj)
     # batched general solves (D is not symmetric).
     AjD = jnp.linalg.solve(jnp.swapaxes(D, -1, -2),
                            jnp.swapaxes(Aj, -1, -2))
@@ -116,8 +133,8 @@ def _combine_filter(ei, ej):
     b = jnp.einsum("...kl,...l->...k", AjD,
                    bi + jnp.einsum("...kl,...l->...k", Ci, etaj)) + bj
     C = sym(AjD @ Ci @ jnp.swapaxes(Aj, -1, -2) + Cj)
-    E = I_k + jnp.einsum("...kl,...lm->...km", Jj, Ci) if Ai.ndim > 2 \
-        else I_k + Jj @ Ci
+    E = jit_eye + jnp.einsum("...kl,...lm->...km", Jj, Ci) if Ai.ndim > 2 \
+        else jit_eye + Jj @ Ci
     AiT = jnp.swapaxes(Ai, -1, -2)
     EinvRHS = jnp.linalg.solve(
         E, (etaj - jnp.einsum("...kl,...l->...k", Jj, bi))[..., None])
@@ -233,3 +250,310 @@ def pit_smoother(kf: FilterResult, p: SSMParams,
 def pit_filter_smoother(Y, p, mask=None):
     kf = pit_filter(Y, p, mask=mask)
     return kf, pit_smoother(kf, p)
+
+
+# ---------------------------------------------------------------------------
+# QR-factor (square-root / orthogonal-transformation) parallel-in-time engine
+# ---------------------------------------------------------------------------
+#
+# The covariance-form combine above carries batched GENERAL solves and
+# products of covariances — ~100x their flop budget on this toolchain
+# (batched-linalg lowering, docs/PERF.md item 6a) and the dominant f32
+# noise amplifier of the legacy path.  Following "Parallel-in-Time Kalman
+# Smoothing Using Orthogonal Transformations" (PAPERS.md, arXiv 2502.11686)
+# the elements instead carry SQUARE-ROOT factors, C = U U' and J = Z Z',
+# and every combine is a thin QR (``ops.linalg.tria``) of stacked factors
+# plus triangular solves against Cholesky factors of I + (PSD) — uniformly
+# well-conditioned, so no jitter is ever needed, and every op is a
+# statically-unrolled VPU kernel (no linalg primitive in any scan body).
+#
+# Combine (ei earlier, ej later), with Y = U_i' Z_j:
+#
+#   Theta = tria([Y  | I])        Theta Theta' = I + U_i' C^J U_i
+#   Lam   = tria([Y' | I])        Lam Lam'     = I + Z_j' C_i Z_j
+#   (I + C_i J_j)^{-1} M = M - U_i (Theta Theta')^{-1} Y (Z_j' M)
+#   (I + J_j C_i)^{-1} M = M - Z_j (Lam Lam')^{-1} Y' (U_i' M)
+#   A   = A_j (I + C_i J_j)^{-1} A_i
+#   b   = A_j (I + C_i J_j)^{-1} (b_i + U_i U_i' eta_j) + b_j
+#   U   = tria([A_j U_i Theta^{-T} | U_j])
+#   eta = A_i' (I + J_j C_i)^{-1} (eta_j - Z_j Z_j' b_i) + eta_i
+#   Z   = tria([A_i' Z_j Lam^{-T} | Z_i])
+#
+# (the U/Z rows follow from D^{-1} C_i = U_i (ThetaTheta')^{-1} U_i' and
+# E^{-1} J_j = Z_j (LamLam')^{-1} Z_j' — push-through of the Woodbury
+# correction.)  Equivalence with the sequential info scan is tested to fp
+# tolerance in x64 AND f32; ``EMConfig(filter="pit_qr")`` selects it.
+
+
+def _gram(U):
+    """U U' with the VPU-form product (small trailing dims)."""
+    return matmul_vpu(U, jnp.swapaxes(U, -1, -2))
+
+
+def qr_generic_elements(stats: ObsStats, A, Q):
+    """Batched square-root element construction (A, b, U, eta, Z) for
+    INTERIOR steps (no t = 0 prior correction — see ``qr_init_posterior``).
+
+    Same push-through identities as ``_filter_elements`` but factored:
+    with Lq Lq' = Q, W_t W_t' = C_t (guarded semidefinite factors — C_t is
+    rank-deficient whenever a step observes < k series) and
+    H_t = chol(I + W_t' Q W_t):
+
+        U_t   = Lq E_t^{-T},  E_t = chol(I + Lq' C_t Lq)
+        Z_t   = F' W_t H_t^{-T}
+        A_t   = F - Q W_t (H_t H_t')^{-1} W_t' F
+        b_t   = Q n_t,  eta_t = F' n_t,  n_t = (I + C_t Q)^{-1} bobs_t
+
+    Everything is unrolled elementwise ops batched over T; no batched
+    linalg primitive anywhere (k <= QR_UNROLL_K_MAX; generic fallbacks
+    above).  The time-sharded variant builds these locally per shard and
+    corrects slot 0 on the first device only.
+    """
+    dtype = stats.b.dtype
+    T = stats.b.shape[0]
+    k = A.shape[0]
+    C_t = stats.C
+    if C_t.ndim == 2:
+        C_t = jnp.broadcast_to(C_t, (T, k, k))
+    bobs = stats.b
+    unroll = k <= QR_UNROLL_K_MAX
+    chol = chol_unrolled if unroll else (lambda M: psd_cholesky(M, jitter=0.0))
+    chol_slv = chol_solve_unrolled if unroll else chol_solve
+
+    Lq = psd_factor(Q)                                  # (k, k), may be rank-def.
+    F_b = jnp.broadcast_to(A, (T, k, k))
+    I_k = jnp.eye(k, dtype=dtype)
+
+    # U_t = Lq E^{-T}: E = chol(I + Lq' C Lq) — I + PSD, no guard needed.
+    LqT_C = matmul_vpu(jnp.broadcast_to(Lq.T, (T, k, k)), C_t)
+    G = I_k[None] + matmul_vpu(LqT_C, jnp.broadcast_to(Lq, (T, k, k)))
+    E = chol(G)
+    U_el = jnp.swapaxes(tri_solve(E, jnp.broadcast_to(Lq.T, (T, k, k))),
+                        -1, -2)
+
+    # W_t = factor(C_t); H = chol(I + W' Q W).
+    W = psd_factor(C_t)
+    WT = jnp.swapaxes(W, -1, -2)
+    QW = matmul_vpu(jnp.broadcast_to(Q, (T, k, k)), W)
+    H = chol(I_k[None] + matmul_vpu(WT, QW))
+
+    # n_t = (I + C Q)^{-1} bobs = bobs - W (H H')^{-1} W' Q bobs.
+    Qb = matvec_vpu(jnp.broadcast_to(Q, (T, k, k)), bobs)
+    n_t = bobs - matvec_vpu(W, chol_slv(H, matvec_vpu(WT, Qb)))
+    b_el = matvec_vpu(jnp.broadcast_to(Q, (T, k, k)), n_t)
+    eta_el = matvec_vpu(jnp.broadcast_to(A.T, (T, k, k)), n_t)
+
+    # Z_t = F' W H^{-T};  A_t = F - Q W (H H')^{-1} W' F.
+    FTW = matmul_vpu(jnp.broadcast_to(A.T, (T, k, k)), W)
+    Z_el = jnp.swapaxes(tri_solve(H, jnp.swapaxes(FTW, -1, -2)), -1, -2)
+    WTF = matmul_vpu(WT, F_b)
+    A_el = F_b - matmul_vpu(QW, chol_slv(H, WTF))
+    return (A_el, b_el, U_el, eta_el, Z_el)
+
+
+def qr_init_posterior(C0, bobs0, mu0, P0):
+    """(b0, U0): the first filtered posterior from the prior (mu0, P0).
+
+    The t = 0 element is (A=0, b0, U0, eta=0, Z=0) — it absorbs the prior,
+    so every prefix product carries A = 0 and b = filtered mean directly.
+    """
+    dtype = bobs0.dtype
+    k = mu0.shape[0]
+    unroll = k <= QR_UNROLL_K_MAX
+    chol = chol_unrolled if unroll else (lambda M: psd_cholesky(M, jitter=0.0))
+    chol_slv = chol_solve_unrolled if unroll else chol_solve
+    I_k = jnp.eye(k, dtype=dtype)
+    Lp0 = psd_factor(P0)
+    E0 = chol(I_k + Lp0.T @ C0 @ Lp0)
+    U0 = jnp.swapaxes(tri_solve(E0, Lp0.T), -1, -2)
+    # (I + C0 P0)^{-1} v = v - W0 chol_slv(Hp, W0' P0 v), Hp = chol(I+W0'P0 W0)
+    W0 = psd_factor(C0)
+    Hp = chol(I_k + W0.T @ P0 @ W0)
+    v0 = bobs0 - C0 @ mu0
+    n0 = v0 - W0 @ chol_slv(Hp, W0.T @ (P0 @ v0))
+    b0 = mu0 + P0 @ n0
+    return b0, U0
+
+
+def qr_filter_elements(stats: ObsStats, A, Q, mu0, P0):
+    """Generic square-root elements with the t = 0 prior correction applied
+    (single-device entry — see ``qr_generic_elements``)."""
+    dtype = stats.b.dtype
+    k = A.shape[0]
+    A_el, b_el, U_el, eta_el, Z_el = qr_generic_elements(stats, A, Q)
+    C0 = stats.C if stats.C.ndim == 2 else stats.C[0]
+    b0, U0 = qr_init_posterior(C0, stats.b[0], mu0, P0)
+    zeros_kk = jnp.zeros((k, k), dtype)
+    A_el = A_el.at[0].set(zeros_kk)
+    b_el = b_el.at[0].set(b0)
+    U_el = U_el.at[0].set(U0)
+    eta_el = eta_el.at[0].set(jnp.zeros((k,), dtype))
+    Z_el = Z_el.at[0].set(zeros_kk)
+    return (A_el, b_el, U_el, eta_el, Z_el)
+
+
+def qr_combine_filter(ei, ej):
+    """Square-root associative filtering product (ei earlier, ej later).
+
+    QR + triangular solves only — see the section comment for the algebra.
+    Works for single elements and arbitrary leading batch dims (the
+    blocked scan batches over blocks).
+    """
+    Ai, bi, Ui, etai, Zi = ei
+    Aj, bj, Uj, etaj, Zj = ej
+    k = Ai.shape[-1]
+    dtype = Ai.dtype
+    I_b = jnp.broadcast_to(jnp.eye(k, dtype=dtype), Ai.shape)
+    unroll = k <= QR_UNROLL_K_MAX
+    chol_slv = chol_solve_unrolled if unroll else chol_solve
+
+    UiT = jnp.swapaxes(Ui, -1, -2)
+    ZjT = jnp.swapaxes(Zj, -1, -2)
+    Yf = matmul_vpu(UiT, Zj)                      # U_i' Z_j
+    Theta = tria(jnp.concatenate([Yf, I_b], axis=-1))
+    Lam = tria(jnp.concatenate([jnp.swapaxes(Yf, -1, -2), I_b], axis=-1))
+
+    def Dinv(M):                                  # (I + C_i J_j)^{-1} M
+        return M - matmul_vpu(Ui, chol_slv(
+            Theta, matmul_vpu(Yf, matmul_vpu(ZjT, M))))
+
+    def Dinv_v(v):
+        return v - matvec_vpu(Ui, chol_slv(
+            Theta, matvec_vpu(Yf, matvec_vpu(ZjT, v))))
+
+    def Einv_v(v):                                # (I + J_j C_i)^{-1} v
+        return v - matvec_vpu(Zj, chol_slv(
+            Lam, matvec_vpu(jnp.swapaxes(Yf, -1, -2), matvec_vpu(UiT, v))))
+
+    A = matmul_vpu(Aj, Dinv(Ai))
+    b = matvec_vpu(Aj, Dinv_v(bi + matvec_vpu(Ui, matvec_vpu(UiT, etaj)))) + bj
+    AjUi = matmul_vpu(Aj, Ui)
+    # A_j U_i Theta^{-T}: solve Theta X = (A_j U_i)' then transpose.
+    U_half = jnp.swapaxes(tri_solve(Theta, jnp.swapaxes(AjUi, -1, -2)),
+                          -1, -2)
+    U = tria(jnp.concatenate([U_half, Uj], axis=-1))
+    AiT = jnp.swapaxes(Ai, -1, -2)
+    eta = matvec_vpu(AiT, Einv_v(etaj - matvec_vpu(Zj, matvec_vpu(ZjT, bi)))) \
+        + etai
+    AiTZj = matmul_vpu(AiT, Zj)
+    Z_half = jnp.swapaxes(tri_solve(Lam, jnp.swapaxes(AiTZj, -1, -2)),
+                          -1, -2)
+    Z = tria(jnp.concatenate([Z_half, Zi], axis=-1))
+    return (A, b, U, eta, Z)
+
+
+def pit_qr_from_stats(stats: ObsStats, p: SSMParams,
+                      scan_impl: str = "blocked"):
+    """QR-factor twin of ``pit_from_stats``: element build + prefix product
+    + factored moment/logdet assembly.  Same returns (x_pred, P_pred, x_f,
+    P_f, logdetG); the predicted factors come straight from
+    ``tria([A U_f | Lq])`` — never a re-factorization of an already-rounded
+    covariance, which is where the f32 stability of this path comes from.
+    """
+    elems = qr_filter_elements(stats, p.A, p.Q, p.mu0, p.P0)
+    if scan_impl == "blocked":
+        pref = blocked_scan(qr_combine_filter, elems)
+    else:
+        pref = lax.associative_scan(qr_combine_filter, elems)
+    x_f, U_f = pref[1], pref[2]
+    P_f = _gram(U_f)
+
+    T = stats.b.shape[0]
+    k = p.A.shape[0]
+    dtype = x_f.dtype
+    Lq = psd_factor(p.Q)
+    Lp0 = psd_factor(p.P0)
+    AU = matmul_vpu(jnp.broadcast_to(p.A, (T - 1, k, k)), U_f[:-1])
+    Lp_tail = tria(jnp.concatenate(
+        [AU, jnp.broadcast_to(Lq, (T - 1, k, k))], axis=-1))
+    Lp = jnp.concatenate([Lp0[None], Lp_tail], axis=0)
+    P_pred = _gram(Lp)
+    x_pred = jnp.concatenate([p.mu0[None], x_f[:-1] @ p.A.T], axis=0)
+
+    C_t = stats.C
+    if C_t.ndim == 2:
+        C_t = jnp.broadcast_to(C_t, (T, k, k))
+    LpT_C = matmul_vpu(jnp.swapaxes(Lp, -1, -2), C_t)
+    G = jnp.eye(k, dtype=dtype)[None] + matmul_vpu(LpT_C, Lp)
+    chol = chol_unrolled if k <= QR_UNROLL_K_MAX else \
+        (lambda M: psd_cholesky(M, jitter=0.0))
+    logdetG = chol_logdet(chol(G))
+    return x_pred, P_pred, x_f, P_f, logdetG
+
+
+def pit_qr_filter(Y: jax.Array, p: SSMParams,
+                  mask: Optional[jax.Array] = None,
+                  scan_impl: str = "blocked") -> FilterResult:
+    """Square-root parallel-in-time filter; same contract as ``info_filter``
+    / ``pit_filter`` (exact loglik, predicted/filtered moments)."""
+    p = p.astype(Y.dtype)
+    stats = obs_stats(Y, p.Lam, p.R, mask=mask)
+    x_pred, P_pred, x_f, P_f, logdetG = pit_qr_from_stats(stats, p, scan_impl)
+    quad_R, U = loglik_terms_local(Y, p.Lam, p.R, x_pred, mask)
+    ll = loglik_from_terms(stats, logdetG, P_f, quad_R, U)
+    return FilterResult(x_pred, P_pred, x_f, P_f, ll)
+
+
+def qr_combine_smoother(elater, eearlier):
+    """Square-root smoothing-element product (same reverse-argument
+    convention as ``_combine_smoother``): L = D D' combines as
+    D = tria([E_e D_l | D_e]) — one thin QR, no covariance products."""
+    El, gl, Dl = elater
+    Ee, ge, De = eearlier
+    E = matmul_vpu(Ee, El)
+    g = matvec_vpu(Ee, gl) + ge
+    D = tria(jnp.concatenate([matmul_vpu(Ee, Dl), De], axis=-1))
+    return (E, g, D)
+
+
+def _qr_smoother_elements(kf: FilterResult, A, Q):
+    """Square-root affine smoothing elements (E, g, D).
+
+    The residual covariance uses the Joseph form
+    L_t = (I - J A) P_f (I - J A)' + J Q J'  —  PSD by construction, so its
+    factor is one tria of [(I - J A) U_f | J Lq] and the combine never sees
+    a subtraction of covariances.
+    """
+    T, k = kf.x_filt.shape
+    dtype = kf.x_filt.dtype
+    unroll = k <= QR_UNROLL_K_MAX
+    chol_slv = chol_solve_unrolled if unroll else chol_solve
+    U_f = psd_factor(kf.P_filt)
+    Lq = psd_factor(Q)
+    Lp_next = psd_factor(kf.P_pred[1:])
+    APf = matmul_vpu(jnp.broadcast_to(A, (T - 1, k, k)), kf.P_filt[:-1])
+    J = jnp.swapaxes(chol_slv(Lp_next, APf), -1, -2)       # (T-1, k, k)
+    E = jnp.concatenate([J, jnp.zeros((1, k, k), J.dtype)], axis=0)
+    g_head = kf.x_filt[:-1] - jnp.einsum("tkl,tl->tk", J, kf.x_pred[1:])
+    g = jnp.concatenate([g_head, kf.x_filt[-1:]], axis=0)
+    ImJA = jnp.broadcast_to(jnp.eye(k, dtype=dtype), (T - 1, k, k)) \
+        - matmul_vpu(J, jnp.broadcast_to(A, (T - 1, k, k)))
+    D_head = tria(jnp.concatenate(
+        [matmul_vpu(ImJA, U_f[:-1]),
+         matmul_vpu(J, jnp.broadcast_to(Lq, (T - 1, k, k)))], axis=-1))
+    D = jnp.concatenate([D_head, U_f[-1:]], axis=0)
+    return (E, g, D), J
+
+
+def pit_qr_smoother(kf: FilterResult, p: SSMParams,
+                    scan_impl: str = "blocked") -> SmootherResult:
+    """Square-root parallel-in-time RTS smoother; contract of
+    ``rts_smoother``."""
+    dtype = kf.x_filt.dtype
+    p = p.astype(dtype)
+    T, k = kf.x_filt.shape
+    elems, J = _qr_smoother_elements(kf, p.A, p.Q)
+    if scan_impl == "blocked":
+        suf = blocked_scan(qr_combine_smoother, elems, reverse=True)
+    else:
+        suf = lax.associative_scan(qr_combine_smoother, elems, reverse=True)
+    x_sm, D_sm = suf[1], suf[2]
+    P_sm = _gram(D_sm)
+    P_lag_tail = jnp.einsum("tij,tkj->tik", P_sm[1:], J)
+    P_lag = jnp.concatenate([jnp.zeros((1, k, k), dtype), P_lag_tail], axis=0)
+    return SmootherResult(x_sm, P_sm, P_lag)
+
+
+def pit_qr_filter_smoother(Y, p, mask=None):
+    kf = pit_qr_filter(Y, p, mask=mask)
+    return kf, pit_qr_smoother(kf, p)

@@ -1,0 +1,173 @@
+"""Square-root (QR-factor) parallel-in-time filter/smoother == sequential
+(ISSUE 13 tentpole; arXiv 2502.11686's orthogonal-transformation elements).
+
+Covers x64-exact and f32-tolerance equivalence vs the sequential info
+scan (masked/unmasked, divisible/non-divisible T), EM-through-pit_qr
+(chunked AND fused drivers), the mixed-frequency augmented E-step, the
+f32 noise contract (pit_qr no noisier than the sequential scan — the
+reason the square-root rebuild exists), and the fit()-level plumbing
+(FitResult.filter stamp + trace event, advisor plan application).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dfm_tpu.estim.em import EMConfig, em_fit
+from dfm_tpu.ssm.info_filter import info_filter
+from dfm_tpu.ssm.kalman import rts_smoother
+from dfm_tpu.ssm.parallel_filter import (pit_qr_filter,
+                                         pit_qr_filter_smoother,
+                                         pit_qr_smoother)
+from dfm_tpu.ssm.params import SSMParams as JP
+from dfm_tpu.utils import dgp
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(61)
+    p = dgp.dfm_params(33, 3, rng)
+    Y, _ = dgp.simulate(p, 90, rng)
+    return p, Y
+
+
+@pytest.mark.parametrize("impl", ["blocked", "associative"])
+@pytest.mark.parametrize("masked", [False, True])
+def test_pit_qr_filter_matches_sequential(setup, impl, masked):
+    p, Y = setup
+    pj = JP.from_numpy(p, jnp.float64)
+    mask = None
+    if masked:
+        rng = np.random.default_rng(62)
+        W = dgp.random_mask(*Y.shape, rng, 0.3)
+        W[5] = 0.0          # a fully-missing step (C_t = 0 element)
+        mask = jnp.asarray(W)
+    kf_s = info_filter(jnp.asarray(Y), pj, mask=mask)
+    kf_q = pit_qr_filter(jnp.asarray(Y), pj, mask=mask, scan_impl=impl)
+    assert abs(float(kf_q.loglik) - float(kf_s.loglik)) < 1e-7 * abs(
+        float(kf_s.loglik))
+    np.testing.assert_allclose(np.asarray(kf_q.x_filt),
+                               np.asarray(kf_s.x_filt), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(kf_q.P_filt),
+                               np.asarray(kf_s.P_filt), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(kf_q.x_pred),
+                               np.asarray(kf_s.x_pred), atol=1e-9)
+    sm_s = rts_smoother(kf_s, pj)
+    sm_q = pit_qr_smoother(kf_q, pj, scan_impl=impl)
+    np.testing.assert_allclose(np.asarray(sm_q.x_sm),
+                               np.asarray(sm_s.x_sm), atol=1e-8)
+    np.testing.assert_allclose(np.asarray(sm_q.P_sm),
+                               np.asarray(sm_s.P_sm), atol=1e-8)
+    np.testing.assert_allclose(np.asarray(sm_q.P_lag),
+                               np.asarray(sm_s.P_lag), atol=1e-8)
+
+
+def test_pit_qr_non_divisible_lengths(setup):
+    p, _ = setup
+    rng = np.random.default_rng(63)
+    for T in (7, 29, 97):
+        Y, _ = dgp.simulate(p, T, rng)
+        pj = JP.from_numpy(p, jnp.float64)
+        kf_s = info_filter(jnp.asarray(Y), pj)
+        kf_q, sm_q = pit_qr_filter_smoother(jnp.asarray(Y), pj)
+        assert abs(float(kf_q.loglik) - float(kf_s.loglik)) < 1e-9 * abs(
+            float(kf_s.loglik)), T
+        sm_s = rts_smoother(kf_s, pj)
+        np.testing.assert_allclose(np.asarray(sm_q.x_sm),
+                                   np.asarray(sm_s.x_sm), atol=1e-8)
+
+
+def test_pit_qr_f32_noise_no_worse_than_sequential(setup):
+    """The matched-numerics half of the long-T contract: at f32 the
+    square-root combine must hold the sequential scan's noise level
+    (the covariance-form pit combine historically did not — that
+    instability is WHY the QR-factor rebuild exists)."""
+    p, _ = setup
+    rng = np.random.default_rng(64)
+    Y, _ = dgp.simulate(p, 400, rng)
+    p64 = JP.from_numpy(p, jnp.float64)
+    p32 = JP.from_numpy(p, jnp.float32)
+    Y64, Y32 = jnp.asarray(Y), jnp.asarray(Y, jnp.float32)
+    ll_ref = float(info_filter(Y64, p64).loglik)
+    err_seq = abs(float(info_filter(Y32, p32).loglik) - ll_ref)
+    err_qr = abs(float(pit_qr_filter(Y32, p32).loglik) - ll_ref)
+    # Both sit near eps*N*T; pit_qr must not blow past the sequential
+    # level (3x headroom over run-to-run wobble).
+    assert err_qr <= 3.0 * max(err_seq, 1e-7 * abs(ll_ref))
+
+
+def test_em_with_pit_qr_matches_info(setup):
+    p, Y = setup
+    from dfm_tpu.backends import cpu_ref
+    Yz = (Y - Y.mean(0)) / Y.std(0)
+    p0 = cpu_ref.pca_init(Yz, 3)
+    pj = JP.from_numpy(p0, jnp.float64)
+    _, lls_i, _, _ = em_fit(jnp.asarray(Yz), pj, max_iters=5,
+                            cfg=EMConfig(filter="info"))
+    _, lls_q, _, _ = em_fit(jnp.asarray(Yz), pj, max_iters=5,
+                            cfg=EMConfig(filter="pit_qr"))
+    np.testing.assert_allclose(np.asarray(lls_q), np.asarray(lls_i),
+                               rtol=1e-9)
+
+
+def test_fused_fit_with_pit_qr_matches_chunked(setup):
+    """filter="pit_qr" routes through the fused while-loop driver too
+    (the in-loop E-step is the same _em_chunk_body)."""
+    from dfm_tpu.api import DynamicFactorModel, TPUBackend, fit
+    p, Y = setup
+    model = DynamicFactorModel(n_factors=3)
+    kw = dict(max_iters=6, tol=0.0)
+    r_ch = fit(model, Y, backend=TPUBackend(dtype=jnp.float64,
+                                            filter="pit_qr"), **kw)
+    r_fu = fit(model, Y, backend=TPUBackend(dtype=jnp.float64,
+                                            filter="pit_qr"), fused=True,
+               **kw)
+    np.testing.assert_allclose(np.asarray(r_fu.logliks),
+                               np.asarray(r_ch.logliks), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(r_fu.params.Lam),
+                               np.asarray(r_ch.params.Lam), atol=1e-9)
+
+
+def test_mf_time_scan_pit_qr_matches_seq():
+    """MixedFreqSpec(time_scan="pit_qr") reproduces the sequential
+    augmented E-step (small state: the statically-unrolled QR kernels)."""
+    from dfm_tpu.models.mixed_freq import MixedFreqSpec, mf_fit
+    rng = np.random.default_rng(65)
+    Y, mask, _, _ = dgp.simulate_mixed_freq(
+        n_monthly=24, n_quarterly=6, T=60, k=2, rng=rng)
+    spec = MixedFreqSpec(n_monthly=24, n_quarterly=6, n_factors=2)
+    r_seq = mf_fit(Y, spec, mask=mask, max_iters=6, tol=0.0)
+    r_qr = mf_fit(Y, dataclasses.replace(spec, time_scan="pit_qr"),
+                  mask=mask, max_iters=6, tol=0.0)
+    np.testing.assert_allclose(np.asarray(r_qr.logliks),
+                               np.asarray(r_seq.logliks), rtol=1e-7)
+    with pytest.raises(ValueError):
+        MixedFreqSpec(n_monthly=24, n_quarterly=6, n_factors=2,
+                      time_scan="qr")
+
+
+def test_fit_stamps_resolved_filter(setup):
+    """FitResult.filter carries the resolved in-loop engine; the traced
+    fit event and summarize()/obs.report surface it."""
+    from dfm_tpu.api import DynamicFactorModel, TPUBackend, fit
+    from dfm_tpu.obs import Tracer
+    p, Y = setup
+    model = DynamicFactorModel(n_factors=3)
+    tr = Tracer()
+    res = fit(model, Y, backend=TPUBackend(dtype=jnp.float64,
+                                           filter="pit_qr"),
+              max_iters=3, tol=0.0, telemetry=tr)
+    assert res.filter == "pit_qr"
+    fit_evs = [e for e in tr.events if e.get("kind") == "fit"]
+    assert fit_evs and fit_evs[0]["filter"] == "pit_qr"
+    assert tr.summary()["fits"][0]["filter"] == "pit_qr"
+    # Backends without the filter knob leave the stamp unset.
+    assert fit(model, Y, backend="cpu", max_iters=2).filter is None
+
+
+def test_backend_rejects_unknown_filter():
+    from dfm_tpu.api import TPUBackend
+    with pytest.raises(ValueError):
+        TPUBackend(filter="qr")

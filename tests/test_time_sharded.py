@@ -1,0 +1,103 @@
+"""Time-sharded parallel-in-time QR filtering == single-device
+(ISSUE 13 tentpole: per-device blocked prefix scans + one log-depth
+cross-device combine of boundary elements, on the conftest fake
+8-device mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dfm_tpu.parallel import (TIME_AXIS, make_time_mesh,
+                              pit_qr_filter_time_sharded,
+                              pit_qr_time_sharded)
+from dfm_tpu.ssm.info_filter import info_filter
+from dfm_tpu.ssm.kalman import rts_smoother
+from dfm_tpu.ssm.parallel_filter import pit_qr_filter_smoother
+from dfm_tpu.ssm.params import SSMParams as JP
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from dfm_tpu.utils import dgp
+    rng = np.random.default_rng(71)
+    p = dgp.dfm_params(33, 3, rng)
+    return p, rng
+
+
+def test_make_time_mesh():
+    mesh = make_time_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == (TIME_AXIS,)
+    assert make_time_mesh(4).devices.size == 4
+
+
+@pytest.mark.parametrize("T", [96, 97])   # divisible / non-divisible by 8
+@pytest.mark.parametrize("masked", [False, True])
+def test_time_sharded_matches_single_device(setup, T, masked):
+    from dfm_tpu.utils import dgp
+    p, rng = setup
+    Y, _ = dgp.simulate(p, T, rng)
+    pj = JP.from_numpy(p, jnp.float64)
+    Yj = jnp.asarray(Y)
+    mask = None
+    if masked:
+        W = dgp.random_mask(*Y.shape, rng, 0.3)
+        W[3] = 0.0                         # fully-missing step
+        mask = jnp.asarray(W)
+    kf0, sm0 = pit_qr_filter_smoother(Yj, pj, mask=mask)
+    kf1, sm1 = pit_qr_time_sharded(Yj, pj, mask=mask)
+    assert abs(float(kf1.loglik) - float(kf0.loglik)) < 1e-10 * abs(
+        float(kf0.loglik))
+    for a, b in ((kf1.x_filt, kf0.x_filt), (kf1.P_filt, kf0.P_filt),
+                 (kf1.x_pred, kf0.x_pred), (kf1.P_pred, kf0.P_pred),
+                 (sm1.x_sm, sm0.x_sm), (sm1.P_sm, sm0.P_sm),
+                 (sm1.P_lag, sm0.P_lag)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-10)
+
+
+def test_time_sharded_matches_sequential_oracle(setup):
+    """Also pin directly against the sequential info scan + RTS — the
+    time-sharded path must not inherit a shared pit_qr bug."""
+    from dfm_tpu.utils import dgp
+    p, rng = setup
+    Y, _ = dgp.simulate(p, 90, rng)
+    pj = JP.from_numpy(p, jnp.float64)
+    Yj = jnp.asarray(Y)
+    kf_s = info_filter(Yj, pj)
+    sm_s = rts_smoother(kf_s, pj)
+    kf1, sm1 = pit_qr_time_sharded(Yj, pj)
+    assert abs(float(kf1.loglik) - float(kf_s.loglik)) < 1e-9 * abs(
+        float(kf_s.loglik))
+    np.testing.assert_allclose(np.asarray(kf1.x_filt),
+                               np.asarray(kf_s.x_filt), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(sm1.x_sm),
+                               np.asarray(sm_s.x_sm), atol=1e-8)
+
+
+def test_time_sharded_filter_only_and_small_mesh(setup):
+    from dfm_tpu.utils import dgp
+    p, rng = setup
+    Y, _ = dgp.simulate(p, 50, rng)
+    pj = JP.from_numpy(p, jnp.float64)
+    Yj = jnp.asarray(Y)
+    kf0 = pit_qr_filter_smoother(Yj, pj)[0]
+    kf1 = pit_qr_filter_time_sharded(Yj, pj)
+    np.testing.assert_allclose(np.asarray(kf1.x_filt),
+                               np.asarray(kf0.x_filt), atol=1e-10)
+    # An explicit smaller mesh (T=50 not divisible by 4 either).
+    kf2, _ = pit_qr_time_sharded(Yj, pj, n_devices=4)
+    assert abs(float(kf2.loglik) - float(kf0.loglik)) < 1e-10 * abs(
+        float(kf0.loglik))
+
+
+def test_time_sharded_f32_tolerance(setup):
+    from dfm_tpu.utils import dgp
+    p, rng = setup
+    Y, _ = dgp.simulate(p, 96, rng)
+    p64 = JP.from_numpy(p, jnp.float64)
+    p32 = JP.from_numpy(p, jnp.float32)
+    ll_ref = float(info_filter(jnp.asarray(Y), p64).loglik)
+    kf, _ = pit_qr_time_sharded(jnp.asarray(Y, jnp.float32), p32)
+    assert abs(float(kf.loglik) - ll_ref) < 1e-4 * abs(ll_ref)

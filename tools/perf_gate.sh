@@ -129,5 +129,23 @@ assert store.noise_floor("fleet_slo_burn_rate") > 0, \
 assert store.noise_floor("flight_dumps") > 0, \
     "perf_gate: flight_dumps lost its noise floor"'
 
+# The long-T time-parallel metrics (bench.longt / tools/pit_smoke.sh)
+# must stay registered: the per-T pit_qr speedups gate higher-is-better
+# (the T=1000 crossover is the headline contract); the f32 noise ratio
+# vs the sequential scan gates lower-is-better with its own floor.
+python -c '
+from dfm_tpu.obs import store
+need = ("pit_qr_speedup_t300", "pit_qr_speedup_t1000",
+        "pit_qr_speedup_t4000", "pit_qr_noise_ratio")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+for k in need[:3]:
+    assert not store.lower_is_better(k), \
+        f"perf_gate: {k} must gate higher-is-better"
+assert store.lower_is_better("pit_qr_noise_ratio"), \
+    "perf_gate: pit_qr_noise_ratio lost its lower-is-better marker"
+assert store.noise_floor("pit_qr_noise_ratio") > 0, \
+    "perf_gate: pit_qr_noise_ratio lost its noise floor"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"

@@ -1,0 +1,134 @@
+#!/usr/bin/env bash
+# One-command parallel-in-time check: QR-factor filter/smoother parity
+# vs the sequential scan (single-device AND time-sharded across the fake
+# 8-device mesh) -> a smoke-size bench.longt sweep (pit_qr must not lose
+# to the sequential scan at the longest smoke T) -> a seeded-registry
+# advisor selection (fit(auto=True) applies the pit_qr plan and matches
+# the explicit filter= knob bit for bit).  The quick answer to "does
+# parallel-in-time still win at long T, and does the advisor know".
+#
+# Usage (from the repo root):
+#   tools/pit_smoke.sh
+#
+# JAX_PLATFORMS defaults to cpu; the mesh legs force the 8-device fake
+# host platform in a fresh process (env var BEFORE jax import).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "--- pit_qr parity (single-device + time-sharded, fake 8-dev mesh) ---" >&2
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'PY'
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+
+from dfm_tpu.parallel import pit_qr_time_sharded
+from dfm_tpu.ssm.info_filter import info_filter
+from dfm_tpu.ssm.parallel_filter import pit_qr_filter_smoother
+from dfm_tpu.ssm.params import SSMParams as JP
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(13)
+p = dgp.dfm_params(33, 3, rng)
+for T in (96, 97):                     # divisible / non-divisible by 8
+    Y, _ = dgp.simulate(p, T, rng)
+    pj = JP.from_numpy(p, jnp.float64)
+    Yj = jnp.asarray(Y)
+    mask = jnp.asarray(dgp.random_mask(*Y.shape, rng, 0.3))
+    kf_s = info_filter(Yj, pj, mask=mask)
+    kf_q, sm_q = pit_qr_filter_smoother(Yj, pj, mask=mask)
+    dll = abs(float(kf_q.loglik - kf_s.loglik) / float(kf_s.loglik))
+    assert dll < 1e-9, f"pit_qr vs sequential loglik drift {dll} (T={T})"
+    kf_t, sm_t = pit_qr_time_sharded(Yj, pj, mask=mask)
+    dtl = abs(float(kf_t.loglik - kf_q.loglik) / float(kf_q.loglik))
+    dxs = float(jnp.abs(sm_t.x_sm - sm_q.x_sm).max())
+    assert dtl < 1e-10 and dxs < 1e-10, \
+        f"time-sharded drift loglik={dtl} x_sm={dxs} (T={T})"
+    print(f"T={T}: pit_qr==seq (dll {dll:.1e}), "
+          f"time-sharded==single (dll {dtl:.1e}, dx_sm {dxs:.1e})")
+print("parity OK")
+PY
+
+echo "--- bench.longt smoke sweep ---" >&2
+OUT=$(JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" \
+      DFM_BENCH_TSWEEP="${DFM_BENCH_TSWEEP:-128,512}" \
+      DFM_BENCH_ITERS="${DFM_BENCH_ITERS:-8}" \
+      DFM_BENCH_REPS="${DFM_BENCH_REPS:-3}" \
+      DFM_RUNS= python -m bench.longt)
+echo "$OUT"
+printf '%s' "$OUT" | python -c '
+import json, sys
+d = json.loads(sys.stdin.readline())
+spd = d["value"]
+ratio = d["pit_qr_noise_ratio"]
+assert spd >= 1.0, (
+    f"pit smoke FAILED: pit_qr {spd}x sequential at the longest smoke T")
+assert ratio <= 3.0, (
+    f"pit smoke FAILED: f32 noise ratio {ratio} vs sequential")
+print(f"longt smoke OK: pit_qr {spd}x sequential, "
+      f"f32 noise ratio {ratio}")'
+
+echo "--- advisor picks pit_qr from a profiled registry ---" >&2
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" python - <<'PY'
+import os
+import tempfile
+
+import numpy as np
+
+with tempfile.TemporaryDirectory() as d:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from dfm_tpu.api import DynamicFactorModel, TPUBackend, fit
+    from dfm_tpu.obs.advise import advise
+    from dfm_tpu.obs.profile import profile_shape
+    from dfm_tpu.obs.store import RunStore
+
+    N, T, K, ITERS = 24, 600, 2, 12
+    recs, _ = profile_shape(N, T, K, iters=ITERS, repeats=3,
+                            variants=("chunked", "pit_qr"),
+                            capture_costs=False)
+    store = RunStore(d)
+    for r in recs:
+        store.append(r)
+    res = advise(N, T, K, max_iters=ITERS, runs=d)
+    top = res["plans"][0]
+    print(f"top plan at T={T}: {top['engine']}+{top['filter']} "
+          f"(anchored={top['anchored']}, "
+          f"{top['predicted_wall_s']:.3f}s predicted)")
+    assert top["filter"] == "pit_qr", (
+        f"pit smoke FAILED: advisor kept {top} at the profiled long-T "
+        f"shape")
+
+    rng = np.random.default_rng(0)
+    from dfm_tpu.utils import dgp
+    p_true = dgp.dfm_params(N, K, rng)
+    Y, _ = dgp.simulate(p_true, T, rng)
+    os.environ["DFM_RUNS"] = d
+    r_auto = fit(DynamicFactorModel(n_factors=K), Y,
+                 backend=TPUBackend(), max_iters=ITERS, tol=0.0,
+                 auto=True)
+    del os.environ["DFM_RUNS"]
+    assert r_auto.filter == "pit_qr", r_auto.filter
+    # Re-run with the plan's knobs passed explicitly: must be bit-equal.
+    a = r_auto.advice
+    kw = {}
+    if a["engine"] == "fused":
+        kw["fused"] = True
+    elif int(a.get("depth") or 1) > 1 or a.get("bucket"):
+        from dfm_tpu.pipeline import PipelineConfig
+        kw["pipeline"] = PipelineConfig(depth=int(a["depth"]),
+                                        bucket=bool(a.get("bucket")))
+    r_exp = fit(DynamicFactorModel(n_factors=K), Y,
+                backend=TPUBackend(filter="pit_qr",
+                                   fused_chunk=int(a["fused_chunk"])),
+                max_iters=ITERS, tol=0.0, **kw)
+    assert np.array_equal(np.asarray(r_auto.logliks),
+                          np.asarray(r_exp.logliks)), \
+        "pit smoke FAILED: auto fit != explicit filter=pit_qr fit"
+    print("fit(auto=True) applied pit_qr, bit-identical to the knob")
+PY
+
+echo "pit smoke OK"
