@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Unbounded-stream serving benchmark: ring-buffer sessions + fleet
+snapshot tiering (ISSUE 14).
+
+Leg 1 soaks a ``ring=True`` nowcast session far past its capacity — the
+panel starts FULL, so every query rolls the oldest rows off in-graph
+while appending the new ones: constant memory, one executable, and the
+same ≤1-blocking-d2h budget as the fixed-capacity session it is raced
+against.  Leg 2 opens a fleet with more registered tenants than resident
+HBM lanes (``resident=``) and round-robins queries so every submit pages
+a warm tenant into a hot lane; the paging walls are the re-admission
+price the cost model trades against lane rent.  Prints exactly ONE JSON
+line to stdout:
+
+    {"metric": ..., "value": N, "unit": "queries/sec",
+     "stream_qps": N, "stream_p99_ms": N,
+     "evictions_per_query": N, "readmission_ms": N, ...}
+
+``value`` is the warm ring-session query throughput (host-observed,
+d2h barrier included).  ``recompiles_after_warmup`` must stay 0 — the
+traced eviction count rides the SAME executable as a non-ring session.
+
+Run on the real chip: ``python -m bench.stream``.  Smoke-size via
+DFM_BENCH_N/K, DFM_BENCH_STREAM_CAPACITY (ring window, default 160),
+DFM_BENCH_QUERIES (warm queries, default 50), DFM_BENCH_ROWS (rows per
+query, default 2), DFM_BENCH_SERVE_ITERS (EM iters/update, default 5),
+DFM_BENCH_ITERS (cold-fit budget, default 50),
+DFM_BENCH_STREAM_TENANTS / DFM_BENCH_STREAM_RESIDENT (fleet tiering
+leg, default 8 tenants on 2 lanes).  Diagnostics on stderr.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from bench._common import log, pct as _pct, record_run
+
+
+def main():
+    N = int(os.environ.get("DFM_BENCH_N", 24))
+    k = int(os.environ.get("DFM_BENCH_K", 2))
+    cap = int(os.environ.get("DFM_BENCH_STREAM_CAPACITY", 160))
+    n_queries = int(os.environ.get("DFM_BENCH_QUERIES", 50))
+    rows = int(os.environ.get("DFM_BENCH_ROWS", 2))
+    serve_iters = int(os.environ.get("DFM_BENCH_SERVE_ITERS", 5))
+    cold_iters = int(os.environ.get("DFM_BENCH_ITERS", 50))
+    n_tenants = int(os.environ.get("DFM_BENCH_STREAM_TENANTS", 8))
+    resident = int(os.environ.get("DFM_BENCH_STREAM_RESIDENT", 2))
+
+    import jax
+    jax.config.update("jax_enable_x64", True)  # f64 loglik assembly
+    from dfm_tpu import DynamicFactorModel, fit, open_fleet, open_session
+    from dfm_tpu.obs.trace import Tracer, activate, current_tracer
+    from dfm_tpu.utils import dgp
+
+    dev = jax.devices()[0]
+    n_stream = (n_queries + 1) * rows
+    log(f"device: {dev.platform} ({dev.device_kind}); ring window "
+        f"({N}, {cap}) k={k}, {n_queries} warm queries x {rows} rows "
+        f"past capacity, {serve_iters} EM iters/update; tiering leg "
+        f"{n_tenants} tenants / {resident} lanes")
+
+    rng = np.random.default_rng(177)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y_all, _ = dgp.simulate(p_true, cap + n_stream, rng)
+    Y0, Y_stream = Y_all[:cap], Y_all[cap:]
+
+    model = DynamicFactorModel(n_factors=k)
+    tracer = current_tracer()
+    if tracer is None:
+        tracer = Tracer()
+
+    with activate(tracer), jax.default_matmul_precision("highest"):
+        res = fit(model, Y0, max_iters=cold_iters, fused=True)
+
+        # Fixed-capacity yardstick: the PR 9 session with room for the
+        # whole stream (no eviction ever fires).  Ring p99 must sit
+        # within noise of this — the eviction roll is in-graph and free.
+        fixed = open_session(res, Y0, capacity=cap + n_stream,
+                             max_update_rows=rows, max_iters=serve_iters,
+                             tol=0.0)
+        fixed.update(Y_stream[:rows])       # compile + warm
+        fixed_walls = []
+        for i in range(1, n_queries + 1):
+            t0 = time.perf_counter()
+            fixed.update(Y_stream[i * rows:(i + 1) * rows])
+            fixed_walls.append(time.perf_counter() - t0)
+        fixed.close()
+        fixed_p50 = 1e3 * _pct(fixed_walls, 50)
+        fixed_p99 = 1e3 * _pct(fixed_walls, 99)
+        log(f"fixed-capacity session: p50 {fixed_p50:.1f} ms, "
+            f"p99 {fixed_p99:.1f} ms")
+
+        # The soak: the ring panel starts FULL, so EVERY query evicts
+        # exactly `rows` oldest rows in-graph while appending.
+        sess = open_session(res, Y0, capacity=cap, max_update_rows=rows,
+                            max_iters=serve_iters, tol=0.0, ring=True)
+        sess.update(Y_stream[:rows])        # compile + warm
+        base = tracer.summary()
+        walls = []
+        for i in range(1, n_queries + 1):
+            t0 = time.perf_counter()
+            sess.update(Y_stream[i * rows:(i + 1) * rows])
+            walls.append(time.perf_counter() - t0)
+        warm = tracer.summary()
+        n_evicted = sess.n_evicted
+        assert sess.t == cap, "ring session must hold exactly capacity"
+        sess.close()
+
+    p50_ms = 1e3 * _pct(walls, 50)
+    p99_ms = 1e3 * _pct(walls, 99)
+    qps = n_queries / sum(walls)
+    blocking = warm["blocking_transfers"] - base["blocking_transfers"]
+    per_query = blocking / n_queries
+    recomp = (warm["programs"].get("serve_update", {}).get("recompiles", 0)
+              - base["programs"].get("serve_update", {}).get("recompiles",
+                                                             0))
+    evictions_per_query = n_evicted / ((n_queries + 1))
+    log(f"ring soak: p50 {p50_ms:.1f} ms, p99 {p99_ms:.1f} ms "
+        f"({qps:.1f} queries/sec), {evictions_per_query:.2f} rows "
+        f"evicted/query, {per_query:.2f} blocking transfers/query, "
+        f"{recomp} recompiles after warmup; p99 {p99_ms / fixed_p99:.2f}x "
+        "the fixed-capacity session's")
+
+    # -- leg 2: fleet tiering (more tenants than lanes) -----------------
+    n_t0 = 40
+    rng2 = np.random.default_rng(178)
+    tn = max(2, n_tenants)
+    resident = max(1, min(resident, tn - 1))
+    with jax.default_matmul_precision("highest"):
+        tenants, panels, streams = [], [], []
+        for i in range(tn):
+            pt = dgp.dfm_params(10, 2, rng2)
+            Yt, _ = dgp.simulate(pt, n_t0 + 8, rng2)
+            r = fit(DynamicFactorModel(n_factors=2), Yt[:n_t0],
+                    max_iters=8, telemetry=False)
+            tenants.append(r)
+            panels.append(Yt[:n_t0])
+            streams.append(Yt[n_t0:])
+        tr2 = Tracer()
+        with activate(tr2):
+            fl = open_fleet(tenants, panels, capacity=n_t0 + 8,
+                            max_update_rows=2, max_iters=3, tol=0.0,
+                            resident=resident, max_classes=1)
+            # Round-robin queries: with resident < tenants every submit
+            # beyond the hot set pages a warm tenant in (and demotes the
+            # LRU hot one) — the admit walls ARE the re-admission price.
+            for rnd in range(2):
+                for i in range(tn):
+                    fl.submit(f"t{i}", streams[i][2 * rnd:2 * rnd + 2])
+                    fl.drain()
+            fl.close()
+        admit_walls = [e["wall"] for e in tr2.events
+                       if e.get("kind") == "page"
+                       and e.get("action") == "admit"]
+    readmission_ms = (1e3 * _pct(admit_walls, 50)) if admit_walls else 0.0
+    log(f"tiering: {tn} tenants on {resident} lanes, "
+        f"{len(admit_walls)} page-ins, readmission p50 "
+        f"{readmission_ms:.1f} ms")
+
+    ts_sum = tracer.summary()
+    log(f"telemetry: {ts_sum['dispatches']} dispatches, "
+        f"{ts_sum['recompiles']} recompiles"
+        + (f" -> {tracer.path}" if tracer.path else ""))
+
+    from dfm_tpu.obs.store import new_run_id
+    payload = {
+        "metric": f"stream_qps_{N}x{cap}",
+        "value": round(qps, 2),
+        "unit": "queries/sec",
+        "value_definition": ("warm ring-session query throughput at a "
+                             "FULL panel: every query evicts the oldest "
+                             "rows in-graph and appends new ones (one "
+                             "fused dispatch, d2h barrier included)"),
+        "stream_qps": round(qps, 2),
+        "stream_p50_ms": round(p50_ms, 2),
+        "stream_p99_ms": round(p99_ms, 2),
+        "stream_fixed_p99_ms": round(fixed_p99, 2),
+        "evictions_per_query": round(evictions_per_query, 3),
+        "readmission_ms": round(readmission_ms, 2),
+        "stream_blocking_transfers_per_query": round(per_query, 3),
+        "recompiles_after_warmup": int(recomp),
+        "rows_evicted": int(n_evicted),
+        "n_queries": n_queries,
+        "rows_per_query": rows,
+        "serve_iters": serve_iters,
+        "tiering_tenants": tn,
+        "tiering_resident_lanes": resident,
+        "tiering_page_ins": len(admit_walls),
+        "shape": [N, cap, k],
+        "dispatches": ts_sum["dispatches"],
+        "recompiles": ts_sum["recompiles"],
+        "run_id": new_run_id(),
+    }
+    print(json.dumps(payload))
+    record_run(payload, dev, "bench_stream")
+
+
+if __name__ == "__main__":
+    main()

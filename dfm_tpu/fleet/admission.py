@@ -20,9 +20,10 @@ import dataclasses
 from typing import List, Optional, Sequence, Tuple
 
 from ..obs.cost import em_iter_work, fit_cost_model
-from ..sched.buckets import plan_capacity_classes
+from ..sched.buckets import lane_rent_bytes, plan_capacity_classes
 
-__all__ = ["ClassAssignment", "plan_admission", "fleet_pad_waste"]
+__all__ = ["ClassAssignment", "plan_admission", "fleet_pad_waste",
+           "plan_residency", "readmission_cost_s"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,3 +121,55 @@ def fleet_pad_waste(shapes: Sequence[Tuple[int, int, int]],
             true_fl += em_iter_work(N, T, k)[0] * iters[i]
             padded_fl += em_iter_work(bN, bT, bk)[0] * iters[i]
     return 1.0 - true_fl / padded_fl if padded_fl > 0 else 0.0
+
+
+def readmission_cost_s(dims: Tuple[int, int, int], *, r_max: int = 0,
+                       model=None, runs: Optional[str] = None,
+                       device: Optional[str] = None) -> float:
+    """Predicted wall of paging one warm tenant back into a hot lane of a
+    class with padded ``dims``: a d2h of the bucket params (the shadow
+    refresh that keeps bucket-mates exact), the full-lane h2d re-upload,
+    and one dispatch floor — priced with the SAME calibrated coefficients
+    ``obs.advise`` ranks plans with (``per_byte_s``/``dispatch_floor_s``;
+    ``sched.buckets.lane_rent_bytes`` supplies the byte count).
+    Deterministic given a fixed profile registry."""
+    m = model if model is not None else _load_model(runs, device)
+    rent = lane_rent_bytes(dims, r_max)
+    return float(m.dispatch_floor_s + 2.0 * rent * m.per_byte_s)
+
+
+def plan_residency(classes: Sequence[ClassAssignment],
+                   resident: Optional[int], *, r_max: int = 0,
+                   model=None, runs: Optional[str] = None,
+                   device: Optional[str] = None) -> List[int]:
+    """Split a fleet-wide resident-lane budget over capacity classes.
+
+    Returns per-class hot-lane counts.  Every class keeps >= 1 lane (a
+    bucket with zero lanes has no program to serve its members), then
+    the remaining budget goes greedily to the class where a hot lane
+    AVOIDS the most predicted paging cost: ``readmission_cost_s(dims) *
+    unhoused members`` — the calibrated cost model's re-admission price
+    against the HBM rent the lane charges.  ``resident=None`` (no cap)
+    makes every member hot.  Deterministic: ties break on class index.
+    """
+    n_members = [len(ca.members) for ca in classes]
+    if resident is None:
+        return n_members
+    m = model if model is not None else _load_model(runs, device)
+    want = max(len(classes), int(resident))
+    lanes = [1 if n else 0 for n in n_members]
+    budget = want - sum(lanes)
+    costs = [readmission_cost_s(ca.dims, r_max=r_max, model=m)
+             for ca in classes]
+    while budget > 0:
+        best, best_gain = -1, 0.0
+        for ci, ca in enumerate(classes):
+            unhoused = n_members[ci] - lanes[ci]
+            gain = costs[ci] * unhoused
+            if unhoused > 0 and gain > best_gain:
+                best, best_gain = ci, gain
+        if best < 0:
+            break
+        lanes[best] += 1
+        budget -= 1
+    return lanes

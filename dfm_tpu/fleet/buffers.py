@@ -39,10 +39,17 @@ __all__ = ["TenantSlot", "FleetBucket"]
 
 @dataclasses.dataclass
 class TenantSlot:
-    """Host record of one fleet tenant (see module docstring)."""
+    """Host record of one fleet tenant (see module docstring).
+
+    Tiering (PR 14): ``tier`` is "hot" (device-resident lane), "warm"
+    (lane freed; the exact padded host shadows parked in ``warm_Y`` /
+    ``warm_W`` / ``warm_p``) or "cold" (shadows spilled to an on-disk
+    npz at ``cold_path``).  A warm/cold slot has ``lane is None``; re-
+    admission restores the shadows into a free lane bit-for-bit.
+    """
 
     name: str
-    lane: int                  # index along the bucket's batch axis
+    lane: Optional[int]        # index along the bucket's batch axis
     N: int
     k: int
     t: int                     # live panel length (rows so far)
@@ -57,12 +64,35 @@ class TenantSlot:
     div_run: int = 0           # consecutive diverged ticks (escalation)
     n_queries: int = 0
     evicted: Optional[object] = None   # lone NowcastSession after eviction
+    t_total: int = 0           # stream position: rows EVER held
+    tier: str = "hot"
+    warm_Y: Optional[np.ndarray] = None   # (T_cap, N_max) parked shadow
+    warm_W: Optional[np.ndarray] = None
+    warm_p: Optional[object] = None       # padded cpu_ref params (f64)
+    cold_path: Optional[str] = None
+    last_used: int = 0         # LRU stamp (fleet submit sequence)
+
+    @property
+    def n_evicted(self) -> int:
+        """Rows retired by the ring buffer so far (0 outside ring mode)."""
+        return self.t_total - self.t
 
     def append_orig(self, rows: np.ndarray, W_rows: np.ndarray):
         """Track an accepted update in original units (eviction seed)."""
         self.Y_orig = np.concatenate([self.Y_orig, rows], axis=0)
         self.W_orig = np.concatenate([self.W_orig, W_rows], axis=0)
         self.t += rows.shape[0]
+        self.t_total += rows.shape[0]
+
+    def evict_orig(self, n_evict: int):
+        """Drop the oldest ``n_evict`` rows of the original-units seed —
+        the host mirror of the in-graph ring eviction, keeping the
+        quarantine/snapshot seed bounded at the trailing window."""
+        if n_evict <= 0:
+            return
+        self.Y_orig = self.Y_orig[n_evict:]
+        self.W_orig = self.W_orig[n_evict:]
+        self.t -= n_evict
 
 
 class FleetBucket:
@@ -73,10 +103,17 @@ class FleetBucket:
     member is padded to.  ``pad_lanes`` appends that many FILLER lanes
     (copies of lane 0, permanently ``tick_act=False``) so the batch axis
     divides a mesh — value-inert by the freeze algebra.
+
+    ``lanes`` (default: every member) caps the RESIDENT lane count: the
+    first ``lanes`` members start hot, the rest start WARM — their padded
+    shadows parked on the slot, no device footprint — and page in on
+    demand via :meth:`admit` (``driver.SessionFleet`` chooses victims
+    with the calibrated paging economics).  ``lane_of`` maps a device
+    lane to its current occupant (free lanes absent).
     """
 
     def __init__(self, entries, dims, *, r_max: int, backend, opts,
-                 pad_lanes: int = 0):
+                 pad_lanes: int = 0, lanes: Optional[int] = None):
         T_cap, N_max, k_max = dims
         self.dims = dims
         self.r_max = int(r_max)
@@ -85,20 +122,20 @@ class FleetBucket:
         self.dt = backend._dtype()
         self.acc = accum_dtype(self.dt)
         self.slots: List[TenantSlot] = []
+        n_hot = len(entries) if lanes is None else max(1, int(lanes))
         Yh, Wh, ps = [], [], []
         est = None
-        for lane, (name, res, Y, mask, cap, m_it, tol) in enumerate(entries):
+        for i, (name, res, Y, mask, cap, m_it, tol) in enumerate(entries):
             Y = np.asarray(Y, dtype=np.float64)
             T0, N = Y.shape
             W = build_mask(Y, mask)
             std = res.standardizer
             Yz = std.transform(Y) if std is not None else Y
             Yz = np.where(W > 0, np.nan_to_num(Yz), 0.0)
-            Yh.append(pad_panel_to_t(pad_panel_to_n(Yz, N_max), T_cap))
-            Wh.append(pad_panel_to_t(pad_panel_to_n(W, N_max), T_cap))
+            Yp = pad_panel_to_t(pad_panel_to_n(Yz, N_max), T_cap)
+            Wp = pad_panel_to_t(pad_panel_to_n(W, N_max), T_cap)
             k = res.params.Lam.shape[1]
-            ps.append(pad_params_to_n(pad_params_to_k(res.params, k_max),
-                                      N_max))
+            pp = pad_params_to_n(pad_params_to_k(res.params, k_max), N_max)
             m = res.model
             e = (m.estimate_A, m.estimate_Q, m.estimate_init)
             if est is None:
@@ -107,15 +144,29 @@ class FleetBucket:
                 raise ValueError(
                     f"tenant {name!r} has estimation flags {e} but the "
                     f"bucket was planned for {est}")
-            self.slots.append(TenantSlot(
-                name=name, lane=lane, N=N, k=k, t=T0, capacity=int(cap),
+            slot = TenantSlot(
+                name=name, lane=None, N=N, k=k, t=T0, capacity=int(cap),
                 max_iters=int(m_it), tol=float(tol), std=std, model=m,
-                Y_orig=Y.copy(), W_orig=W.copy()))
+                Y_orig=Y.copy(), W_orig=W.copy(), t_total=T0)
+            if i < n_hot:
+                slot.lane = len(Yh)
+                Yh.append(Yp)
+                Wh.append(Wp)
+                ps.append(pp)
+            else:           # over-subscribed: park the shadows, no lane
+                slot.tier = "warm"
+                slot.warm_Y = np.asarray(Yp, np.float64)
+                slot.warm_W = np.asarray(Wp, np.float64)
+                slot.warm_p = pp
+            self.slots.append(slot)
         for _ in range(int(pad_lanes)):     # frozen mesh-filler lanes
             Yh.append(Yh[0].copy())
             Wh.append(Wh[0].copy())
             ps.append(ps[0])
         self.B = len(Yh)
+        self.n_lanes = self.B - int(pad_lanes)   # tenant-usable lanes
+        self.lane_of = {s.lane: s for s in self.slots if s.lane is not None}
+        self.free_lanes: List[int] = []
         self.Yhost = np.stack(Yh).astype(np.float64)
         self.Whost = np.stack(Wh).astype(np.float64)
         self.p_host = ps                      # padded cpu_ref params, f64
@@ -159,6 +210,48 @@ class FleetBucket:
         """Per-lane padded cpu_ref params from a (possibly fresh) stacked
         pytree — one small d2h when reading the resident params."""
         return unstack_params(out_p if out_p is not None else self.p)
+
+    # -- snapshot tiering ----------------------------------------------
+    def demote(self, slot: TenantSlot):
+        """Hot -> warm: park the tenant's exact device state on the slot
+        and free its lane.  One small params d2h (the f64 read is an
+        exact representation of the device values, so a later
+        :meth:`admit` reproduces them bit-for-bit); the lane's stale
+        device data stays behind, value-inert under the tick freezes."""
+        ln = slot.lane
+        # Refresh the params shadows from the device first: outside the
+        # guarded donated path p_host lags the resident params.
+        self.p_host = self.params_host()
+        slot.warm_Y = self.Yhost[ln].copy()
+        slot.warm_W = self.Whost[ln].copy()
+        slot.warm_p = self.p_host[ln]
+        slot.lane = None
+        slot.tier = "warm"
+        del self.lane_of[ln]
+        self.free_lanes.append(ln)
+        self.free_lanes.sort()
+
+    def admit(self, slot: TenantSlot) -> int:
+        """Warm -> hot: restore the parked shadows into a free lane and
+        redeploy the bucket.  Costs one params d2h (bucket-mates' shadow
+        refresh — without it the full-bucket re-upload would roll them
+        back) + the bucket h2d; the re-admitted tenant's device state is
+        bit-identical to its never-evicted twin's."""
+        if not self.free_lanes:
+            raise RuntimeError("bucket has no free lane (driver bug: "
+                               "admit() needs a demote first)")
+        ln = self.free_lanes.pop(0)
+        self.p_host = self.params_host()
+        self.Yhost[ln] = slot.warm_Y
+        self.Whost[ln] = slot.warm_W
+        self.p_host[ln] = slot.warm_p
+        self.redeploy()
+        slot.lane = ln
+        slot.tier = "hot"
+        slot.warm_Y = slot.warm_W = slot.warm_p = None
+        slot.cold_path = None
+        self.lane_of[ln] = slot
+        return ln
 
     def __repr__(self):
         T, N, k = self.dims

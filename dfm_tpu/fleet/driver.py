@@ -16,6 +16,14 @@ same tenant's ``NowcastSession.update`` at the same budget
 (tests/test_fleet.py).  Tenants with no query this tick are frozen
 bit-inert; a tick with Q active tenants costs the same dispatch as one.
 
+Unbounded streams (PR 14): ``ring=True`` rolls each tenant's oldest rows
+off in-graph once its capacity fills (a traced per-lane ``n_evict``
+vector rides the SAME executable — non-ring fleets pay nothing), and
+``resident=`` caps the hot-lane budget: tenants beyond it park as WARM
+host shadows (or COLD on-disk snapshots via ``evict(tier="cold")``) and
+page back in on submit, bit-identical to never-evicted twins — the fleet
+registers far more tenants than it holds HBM lanes for.
+
 Self-healing mirrors the serving stack (PR 10): every tick runs under
 ``robust.dispatch.guarded_dispatch`` with the tenant fan-out (a bucket
 dispatch failure is every member's failure), donated-retry rebuilds from
@@ -48,7 +56,8 @@ from ..serve.batched import (FleetOptions, _fleet_impl, _fleet_impl_donated,
                              fleet_impl_sharded)
 from ..serve.session import NowcastSession, SessionUpdate
 from ..utils.data import build_mask
-from .admission import fleet_pad_waste, plan_admission
+from .admission import (fleet_pad_waste, plan_admission, plan_residency,
+                        readmission_cost_s)
 from .buffers import FleetBucket
 
 __all__ = ["SessionFleet", "open_fleet"]
@@ -106,8 +115,10 @@ class SessionFleet:
                  tenants: Optional[Sequence[str]] = None,
                  capacity=None, max_update_rows: int = 8, max_iters=5,
                  tol=1e-6, horizon: Optional[int] = None,
-                 di: Optional[bool] = None, backend=None, robust=None,
-                 max_classes: int = 3, runs: Optional[str] = None):
+                 di: Optional[bool] = None, ring: bool = False,
+                 resident: Optional[int] = None, backend=None,
+                 robust=None, max_classes: int = 3,
+                 runs: Optional[str] = None):
         from ..api import (CPUBackend, DynamicFactorModel, FitResult,
                            ShardedBackend, _resolve_policy, get_backend)
         results = list(results)
@@ -168,6 +179,12 @@ class SessionFleet:
                 raise ValueError(
                     f"tenant {names[i]!r}: capacity={cap} < panel "
                     f"length T={T0}")
+            if ring and max_update_rows > cap:
+                raise ValueError(
+                    f"tenant {names[i]!r}: ring mode needs "
+                    f"max_update_rows <= capacity so an update never "
+                    f"evicts more rows than it appends; got "
+                    f"max_update_rows={max_update_rows} > capacity={cap}")
             m_it = max(1, 5 if m_its[i] is None else int(m_its[i]))
             tl = 1e-6 if tols[i] is None else float(tols[i])
             k = Lam.shape[1]
@@ -187,14 +204,22 @@ class SessionFleet:
             self._mesh = make_batch_mesh(getattr(b, "n_devices", None))
             mesh_d = self._mesh.devices.size
         self._r_max = max(1, int(max_update_rows))
+        self._ring = bool(ring)
         self._backend = b
+        # Resident-lane budget: how many tenants start hot per class —
+        # the calibrated paging economics (re-admission cost vs lane
+        # rent) split the budget; members beyond a class's allocation
+        # start WARM and page in on first submit.
+        lane_plan = plan_residency(classes, resident, r_max=self._r_max,
+                                   runs=runs)
         self._buckets: List[FleetBucket] = []
         self._slot_of = {}           # tenant -> (bucket, slot)
-        for ca in classes:
+        for ca, n_lanes in zip(classes, lane_plan):
             ents = [entries[i] for i in ca.members]
-            pad = (-len(ents)) % mesh_d
+            n_hot = min(len(ents), max(1, n_lanes))
+            pad = (-n_hot) % mesh_d
             bk = FleetBucket(ents, ca.dims, r_max=self._r_max, backend=b,
-                             opts=self._opts, pad_lanes=pad)
+                             opts=self._opts, pad_lanes=pad, lanes=n_hot)
             self._buckets.append(bk)
             for s in bk.slots:
                 self._slot_of[s.name] = (bk, s)
@@ -237,6 +262,23 @@ class SessionFleet:
         """Live panel length of one tenant (accepted rows only)."""
         _, slot = self._slot_of[tenant]
         return slot.t
+
+    @property
+    def ring(self) -> bool:
+        """True if tenants evict their oldest rows past capacity
+        (unbounded streams) instead of raising at submit."""
+        return self._ring
+
+    @property
+    def resident_lanes(self) -> int:
+        """Device lanes available to tenants (mesh fillers excluded)."""
+        return sum(bk.n_lanes for bk in self._buckets)
+
+    def tier(self, tenant: str) -> str:
+        """Tenant residency tier: "hot" (device lane), "warm" (host
+        shadow parked, lane freed) or "cold" (on-disk snapshot)."""
+        _, slot = self._slot_of[tenant]
+        return slot.tier
 
     def quarantined(self) -> List[str]:
         return [t for t, (_, s) in self._slot_of.items() if s.quarantined]
@@ -305,14 +347,165 @@ class SessionFleet:
             rz = slot.std.transform(r) if slot.std is not None else r
             rz = np.where(W_rows > 0, np.nan_to_num(rz), 0.0)
         queued = sum(q.n_new for q in self._pending if q.tenant == tenant)
-        if slot.t + queued + r.shape[0] > slot.capacity:
+        if (not self._ring
+                and slot.t + queued + r.shape[0] > slot.capacity):
             raise ValueError(
                 f"tenant {tenant!r}: capacity overflow — holds {slot.t} "
                 f"rows (+{queued} queued) of {slot.capacity} and cannot "
-                f"take {r.shape[0]} more")
+                f"take {r.shape[0]} more; open the fleet with ring=True "
+                "to evict the oldest rows in place (unbounded streams "
+                "at constant memory)")
+        # Admission-pressure paging: a warm/cold tenant pages into a hot
+        # lane before its query can ride a tick (quarantined tenants are
+        # served on their lone sessions and need no lane).
+        if slot.tier != "hot" and not slot.quarantined:
+            self.admit(tenant)
+        slot.last_used = next(self._seq)
         self._pending.append(_Query(tenant, r, W_rows, rz, r.shape[0],
                                     next(self._seq)))
         return len(self._pending)
+
+    # -- snapshot tiering ----------------------------------------------
+    def evict(self, tenant: str, tier: str = "warm",
+              path: Optional[str] = None) -> str:
+        """Demote a hot tenant out of its device lane.
+
+        ``tier="warm"`` parks the exact padded host shadows (panel +
+        params, one small d2h) on the slot and frees the lane for a
+        bucket-mate; ``tier="cold"`` additionally spills the shadows to
+        an on-disk npz at ``path`` and drops the host copies.  The
+        tenant stays registered — its next ``submit`` pages it back in
+        automatically (admission-pressure paging) and serves bit-
+        identically to a never-evicted twin.  Returns the new tier.
+        Tenants with pending queries (drain first) or quarantined
+        tenants (they live on lone sessions, no lane) cannot be evicted.
+        """
+        self._check_open()
+        if tier not in ("warm", "cold"):
+            raise ValueError(f"tier must be 'warm' or 'cold'; got {tier!r}")
+        if tenant not in self._slot_of:
+            raise KeyError(f"unknown tenant {tenant!r} (fleet has "
+                           f"{sorted(self._slot_of)})")
+        bucket, slot = self._slot_of[tenant]
+        if slot.quarantined:
+            raise ValueError(
+                f"tenant {tenant!r} is quarantined: it already lives on "
+                "a lone session and holds no lane to evict")
+        if any(q.tenant == tenant for q in self._pending):
+            raise ValueError(
+                f"tenant {tenant!r} has pending queries; drain() before "
+                "evicting")
+        if slot.tier == "hot":
+            t0 = time.perf_counter()
+            bucket.demote(slot)
+            self._page("demote", slot, bucket,
+                       time.perf_counter() - t0)
+        if tier == "cold" and slot.tier == "warm":
+            if path is None:
+                raise ValueError(
+                    "cold eviction spills to disk: pass path= for the "
+                    "lane snapshot npz")
+            self._spill(slot, bucket, str(path))
+        return slot.tier
+
+    def admit(self, tenant: str) -> None:
+        """Page a warm/cold tenant back into a hot device lane (no-op if
+        already hot).  If the bucket has no free lane, the least-
+        recently-used hot bucket-mate WITHOUT pending queries is demoted
+        to warm first — the victim's re-admission price is the class's
+        ``admission.readmission_cost_s``, already weighed against lane
+        rent by the residency plan.  The restored device state is bit-
+        identical to a never-evicted twin's (d2h/h2d of the f64 shadows
+        is exact)."""
+        self._check_open()
+        if tenant not in self._slot_of:
+            raise KeyError(f"unknown tenant {tenant!r} (fleet has "
+                           f"{sorted(self._slot_of)})")
+        bucket, slot = self._slot_of[tenant]
+        if slot.quarantined:
+            raise ValueError(
+                f"tenant {tenant!r} is quarantined: queries route to its "
+                "lone session; there is no lane state to admit")
+        if slot.tier == "hot":
+            return
+        t0 = time.perf_counter()
+        if slot.tier == "cold":
+            self._thaw(slot, bucket)
+        if not bucket.free_lanes:
+            victim = self._choose_victim(bucket)
+            if victim is None:
+                raise RuntimeError(
+                    f"cannot admit tenant {tenant!r}: no free lane and "
+                    "every hot bucket-mate has pending queries — drain() "
+                    "first or open the fleet with a larger resident= "
+                    "budget")
+            bucket.demote(victim)
+            self._page("demote", victim, bucket, 0.0, reason="pressure")
+        lane = bucket.admit(slot)
+        self._page("admit", slot, bucket, time.perf_counter() - t0,
+                   lane=lane)
+
+    def _choose_victim(self, bucket):
+        """Pick the hot lane to page out: among bucket-mates with no
+        pending work (and not quarantined), the least-recently-used.
+        Candidates share the bucket's dims, so the cost model prices
+        their re-admission identically (``readmission_cost_s`` priced
+        the class when the residency plan was cut) — recency is the
+        remaining signal.  Deterministic."""
+        busy = {q.tenant for q in self._pending}
+        cands = [s for s in bucket.slots
+                 if s.tier == "hot" and not s.quarantined
+                 and s.name not in busy]
+        if not cands:
+            return None
+        return min(cands, key=lambda s: (s.last_used, s.lane))
+
+    def _spill(self, slot, bucket, path: str):
+        """Warm -> cold: write the parked shadows to one npz and drop
+        the host copies.  The file round-trips bit-exactly (f64)."""
+        from ..utils.checkpoint import _FIELDS
+        np.savez(path, fleet_lane_format=1,
+                 Y=slot.warm_Y, W=slot.warm_W,
+                 t=slot.t, t_total=slot.t_total,
+                 dims=np.asarray(bucket.dims, np.int64),
+                 **{f: np.asarray(getattr(slot.warm_p, f), np.float64)
+                    for f in _FIELDS})
+        slot.cold_path = path
+        slot.warm_Y = slot.warm_W = slot.warm_p = None
+        slot.tier = "cold"
+        self._page("spill", slot, bucket, 0.0, path=path)
+
+    def _thaw(self, slot, bucket):
+        """Cold -> warm: reload the spilled shadows from disk."""
+        from ..backends.cpu_ref import SSMParams
+        from ..utils.checkpoint import _FIELDS
+        with np.load(slot.cold_path) as z:
+            if "fleet_lane_format" not in z.files:
+                raise ValueError(
+                    f"{slot.cold_path!r} is not a fleet lane snapshot")
+            dims = tuple(int(d) for d in z["dims"])
+            if dims != tuple(bucket.dims):
+                raise ValueError(
+                    f"lane snapshot {slot.cold_path!r} was taken at "
+                    f"class dims {dims}, bucket is {tuple(bucket.dims)}")
+            slot.warm_Y = np.asarray(z["Y"], np.float64)
+            slot.warm_W = np.asarray(z["W"], np.float64)
+            slot.warm_p = SSMParams(*(np.asarray(z[f], np.float64)
+                                      for f in _FIELDS))
+        slot.tier = "warm"
+
+    def _page(self, action: str, slot, bucket, wall: float, **extra):
+        """Emit one paging event (trace stream or the always-on live
+        plane) — ``obs.report``/``bench.stream`` read these for
+        occupancy and ``readmission_ms``."""
+        ev = dict(session=self._fid, tenant=slot.name, action=action,
+                  bucket=self._buckets.index(bucket), wall=wall,
+                  tier=slot.tier, **extra)
+        tr = current_tracer()
+        if tr is not None:
+            tr.emit("page", **ev)
+        else:
+            live_observe({"t": time.perf_counter(), "kind": "page", **ev})
 
     def drain(self) -> Dict[str, List[SessionUpdate]]:
         """Serve the whole queue: repeated TICKS (one fused dispatch per
@@ -362,28 +555,38 @@ class SessionFleet:
         rows_b = np.zeros((B, r_max, N_max))
         rmask_b = np.zeros((B, r_max, N_max))
         n_new = np.zeros(B, np.int32)
-        t_cur = np.zeros(B, np.int32)
+        evictv = np.zeros(B, np.int32)
+        # Free / mesh-filler lanes default to t_cur = T_cap: with
+        # n_evict = 0 the ring pass keeps every row (bit-identical
+        # passthrough) and the zero-row scatter lands past the buffer
+        # (mode="drop") — the lane is inert whatever stale data it holds.
+        t_cur = np.full(B, T_cap, np.int32)
         tolv = np.zeros(B)
         floorv = np.zeros(B)
-        capv = np.zeros(B, np.int32)
+        capv = np.ones(B, np.int32)
         act = np.zeros(B, bool)
         for lane in range(B):
-            # Mesh-filler lanes (lane >= len(slots)) carry lane 0's knobs:
-            # their buffers are lane-0 copies, so the zero-row scatter at
-            # slot 0's live length lands on pad (zeros over zeros).
-            slot = bucket.slots[lane if lane < len(bucket.slots) else 0]
+            slot = bucket.lane_of.get(lane)
+            if slot is None:
+                continue
             t_cur[lane] = slot.t
             tolv[lane] = slot.tol
             capv[lane] = slot.max_iters
             floorv[lane] = bucket.floor_for(slot, slot.t)
         active = []
         for lane, q in sorted(lane_q.items()):
-            slot = bucket.slots[lane]
+            slot = bucket.lane_of[lane]
             rows_b[lane, :q.n_new, :slot.N] = q.rz
             rmask_b[lane, :q.n_new, :slot.N] = q.W_rows
             n_new[lane] = q.n_new
+            # Ring eviction: past capacity the oldest rows roll off
+            # in-graph before the append (non-ring submit already raised,
+            # so e == 0 there).
+            e = max(0, slot.t + q.n_new - slot.capacity)
+            evictv[lane] = e
             act[lane] = True
-            floorv[lane] = bucket.floor_for(slot, slot.t + q.n_new)
+            floorv[lane] = bucket.floor_for(
+                slot, min(slot.t + q.n_new, slot.capacity))
             active.append(slot.name)
         if self._sharded:
             impl, donated = fleet_impl_sharded, False
@@ -401,9 +604,10 @@ class SessionFleet:
         with self._backend._precision_ctx():
             rows_j = jnp.asarray(rows_b, dt)
             rmask_j = jnp.asarray(rmask_b, dt)
-            consts = (jnp.asarray(n_new), jnp.asarray(t_cur),
-                      jnp.asarray(tolv, acc), jnp.asarray(floorv, acc),
-                      jnp.asarray(capv), jnp.asarray(act))
+            consts = (jnp.asarray(n_new), jnp.asarray(evictv),
+                      jnp.asarray(t_cur), jnp.asarray(tolv, acc),
+                      jnp.asarray(floorv, acc), jnp.asarray(capv),
+                      jnp.asarray(act))
 
             def _once(attempt):
                 if attempt > 0 and donated:
@@ -412,8 +616,8 @@ class SessionFleet:
                     # exact original values).
                     bucket.redeploy()
                 args = (bucket.Ybuf, bucket.Wbuf, rows_j, rmask_j,
-                        consts[0], consts[1], bucket.p, consts[2],
-                        consts[3], consts[4], consts[5])
+                        consts[0], consts[1], consts[2], bucket.p,
+                        consts[3], consts[4], consts[5], consts[6])
                 if tr is None:
                     o = impl(*args, **kw)
                     return o, self._read(o, donated and pol is not None)
@@ -452,9 +656,12 @@ class SessionFleet:
                     if not slot.quarantined:
                         self._quarantine(
                             bucket, slot, "bucket dispatch exhausted "
-                            "retries", p_pad=bucket.p_host[slot.lane])
+                            "retries",
+                            p_pad=(bucket.p_host[slot.lane]
+                                   if slot.lane is not None
+                                   else slot.warm_p))
                 for lane, q in sorted(lane_q.items()):
-                    slot = bucket.slots[lane]
+                    slot = bucket.lane_of[lane]
                     results.append(
                         (slot.name, self._serve_evicted(slot, q)))
                 return results
@@ -466,13 +673,25 @@ class SessionFleet:
         self._n_ticks += 1
         results = []
         for lane, q in sorted(lane_q.items()):
-            slot = bucket.slots[lane]
-            t_new = slot.t + q.n_new
-            # Host shadows track the same append in numpy (standardized
-            # units, exactly what the device scatter landed).
-            bucket.Yhost[lane, slot.t:t_new, :slot.N] = q.rz
-            bucket.Whost[lane, slot.t:t_new, :slot.N] = q.W_rows
+            slot = bucket.lane_of[lane]
+            e = int(evictv[lane])
+            t_mid = slot.t - e
+            t_new = t_mid + q.n_new
+            # Host shadows track the same roll + append in numpy
+            # (standardized units, exactly what the device ring pass and
+            # scatter landed: shift left by e, zero the wrapped tail that
+            # the append does not overwrite, then write the new rows).
+            if e:
+                bucket.Yhost[lane, :T_cap - e] = \
+                    bucket.Yhost[lane, e:].copy()
+                bucket.Whost[lane, :T_cap - e] = \
+                    bucket.Whost[lane, e:].copy()
+                bucket.Yhost[lane, T_cap - e:] = 0.0
+                bucket.Whost[lane, T_cap - e:] = 0.0
+            bucket.Yhost[lane, t_mid:t_new, :slot.N] = q.rz
+            bucket.Whost[lane, t_mid:t_new, :slot.N] = q.W_rows
             slot.append_orig(q.rows, q.W_rows)
+            slot.evict_orig(e)
             slot.n_queries += 1
             self._n_queries += 1
             upd = self._lane_update(bucket, host, slot, t_new, wall)
@@ -513,6 +732,7 @@ class SessionFleet:
                        converged=bool(int(host["status"][lane])
                                       == CONVERGED),
                        diverged=diverged,
+                       **({"n_evicted": int(e)} if e else {}),
                        **({"degraded": True} if degraded else {}))
             if tr is not None:
                 tr.emit("query", **qev)
@@ -584,7 +804,12 @@ class SessionFleet:
         are untouched (the frozen lane is value-inert by construction)."""
         from ..api import FitResult
         if p_pad is None:
-            p_pad = bucket.params_host()[slot.lane]
+            if slot.lane is not None:
+                p_pad = bucket.params_host()[slot.lane]
+            else:                       # warm/cold: the parked shadow
+                if slot.tier == "cold":
+                    self._thaw(slot, bucket)
+                p_pad = slot.warm_p
         p = slice_params_to_n(slice_params_to_k(p_pad, slot.k), slot.N)
         res = FitResult(
             params=p, logliks=np.zeros(0),
@@ -597,7 +822,7 @@ class SessionFleet:
             capacity=slot.capacity, max_update_rows=self._r_max,
             max_iters=slot.max_iters, tol=slot.tol,
             horizon=self._opts.horizon, di=self._opts.di,
-            backend=self._backend, robust=self._policy)
+            ring=self._ring, backend=self._backend, robust=self._policy)
         slot.evicted = sess
         slot.quarantined = True
         slot.div_run = 0
@@ -619,6 +844,10 @@ class SessionFleet:
             return slot.evicted.update(None)
         upd = slot.evicted.update(q.rows, mask=q.W_rows)
         slot.append_orig(q.rows, q.W_rows)
+        if self._ring and slot.t > slot.capacity:
+            # Mirror the lone session's ring: the quarantine seed stays
+            # bounded at the trailing window.
+            slot.evict_orig(slot.t - slot.capacity)
         return upd
 
     # -- lifecycle -----------------------------------------------------
@@ -656,6 +885,19 @@ def open_fleet(results, panels, masks=None, **kwargs) -> SessionFleet:
     max_iters / tol : per-tenant warm EM budget per query (scalar or
                       sequence; default 5 / 1e-6).
     horizon / di    : forecast steps and diffusion-index toggle.
+    ring            : ring-buffer panels — a submit past a tenant's
+                      capacity evicts its oldest rows IN-GRAPH instead
+                      of raising: unbounded streams at constant memory,
+                      zero recompiles, each tenant pinned to a lone
+                      ring session over the same trailing window.
+    resident        : fleet-wide hot-lane budget (default: every tenant
+                      resident).  With fewer lanes than tenants the
+                      overflow starts WARM (host shadows parked, no HBM
+                      footprint) and pages in on submit — victims are
+                      chosen by the calibrated paging economics
+                      (``admission.readmission_cost_s`` vs lane rent);
+                      see also ``fleet.evict(tenant, tier="warm"/"cold")``
+                      and ``fleet.admit(tenant)``.
     backend         : "tpu" (default), "sharded" (bucket batch axes
                       split over the mesh), or a TPUBackend instance.
     robust          : ``RobustPolicy`` / True / False — the tick guard +

@@ -397,6 +397,9 @@ def record_event(registry: MetricsRegistry, ledger: Optional[Ledger],
             registry.counter("degraded_queries_total", tenant=ten).inc()
         if ev.get("diverged"):
             registry.counter("diverged_queries_total", tenant=ten).inc()
+        ne = _num(ev.get("n_evicted"))
+        if ne:
+            registry.counter("evicted_rows_total", tenant=ten).inc(ne)
         if ledger is not None:
             row = ledger.row(sid, ten)
             row["queries"] += 1
@@ -471,6 +474,14 @@ def record_event(registry: MetricsRegistry, ledger: Optional[Ledger],
             registry.counter("dispatch_retries_total").inc()
         if event == "quarantine":
             registry.counter("quarantines_total").inc()
+    elif kind == "page":
+        fid = str(ev.get("session", "-"))
+        action = str(ev.get("action", "?"))
+        registry.counter("page_events_total", fleet=fid, action=action).inc()
+        wall = _num(ev.get("wall"))
+        if wall is not None and action == "admit":
+            registry.histogram("readmission_ms", fleet=fid).observe(
+                wall * 1e3)
     elif kind == "fit":
         registry.counter("fits_total").inc()
         wall = _num(ev.get("wall"))

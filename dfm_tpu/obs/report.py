@@ -171,6 +171,11 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
     occ: List[float] = []
     tick_walls: List[float] = []
     per_bucket: dict = {}
+    # ring eviction + snapshot tiering
+    rows_evicted = 0
+    n_evicting_q = 0
+    page_counts: dict = {}
+    admit_walls: List[float] = []
 
     for e in _event_stream(events_or_path):
         n_events += 1
@@ -264,6 +269,9 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
             if e.get("degraded"):
                 n_degraded += 1
                 degraded_sess.append(sid)
+            if e.get("n_evicted"):
+                rows_evicted += int(e["n_evicted"])
+                n_evicting_q += 1
             if e.get("queue_wait") is not None:
                 n_fleet_q += 1
                 pt = fleet_tenant.setdefault(str(e.get("tenant", "?")),
@@ -284,6 +292,11 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
             if (isinstance(e.get("n_active"), (int, float))
                     and e.get("batch")):
                 pb["occ"].append(float(e["n_active"]) / float(e["batch"]))
+        elif kind == "page":
+            act = str(e.get("action", "?"))
+            page_counts[act] = page_counts.get(act, 0) + 1
+            if act == "admit" and isinstance(e.get("wall"), (int, float)):
+                admit_walls.append(float(e["wall"]))
         elif kind == "health":
             n_health += 1
             health_kinds.add(e.get("event", e.get("name", "?")))
@@ -461,6 +474,8 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
         "diverged": q_div,
         "query_wall_s": _stats(q_walls),
         "recompiles_after_warmup": serve_recompiles,
+        "rows_evicted": rows_evicted,
+        "evicting_queries": n_evicting_q,
         "per_session": q_sessions,
     }
     # Fleet serving (fleet.SessionFleet): one event per drained tick with
@@ -485,6 +500,14 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
         "tick_wall_s": _stats(tick_walls),
         "per_bucket": per_bucket,
         "per_tenant": fleet_tenant,
+        # Snapshot tiering: hot/warm/cold paging traffic — admits are the
+        # latency that matters (the query that paid the page-in).
+        "paging": {
+            "admits": page_counts.get("admit", 0),
+            "demotes": page_counts.get("demote", 0),
+            "spills": page_counts.get("spill", 0),
+            "readmission_s": _stats(admit_walls),
+        },
     }
     # Serving-grade fault tolerance (robust.dispatch / sched quarantine /
     # self-healing sessions): the guard's forensic trail aggregated next
@@ -683,6 +706,9 @@ def _print_text(s: dict) -> None:
         r = qs.get("recompiles_after_warmup", 0)
         line += (f"; recompiles after warmup {r}"
                  + (" (!!)" if r else ""))
+        if qs.get("rows_evicted"):
+            line += (f"; ring evicted {qs['rows_evicted']} rows over "
+                     f"{qs['evicting_queries']} queries")
         print(line)
         for sid, ps in qs.get("per_session", {}).items():
             bits = [f"  session {sid}: {ps['queries']} "
@@ -707,6 +733,15 @@ def _print_text(s: dict) -> None:
             line += (f"; tick wall p50 {_fmt_s(tw['p50'])} / "
                      f"p99 {_fmt_s(tw['p99'])}")
         print(line)
+        pg = fl.get("paging") or {}
+        if pg.get("admits") or pg.get("demotes") or pg.get("spills"):
+            line = (f"  paging: {pg['admits']} admits / {pg['demotes']} "
+                    f"demotes / {pg['spills']} spills")
+            rs = pg.get("readmission_s") or {}
+            if rs:
+                line += (f"; readmission p50 {_fmt_s(rs['p50'])} / "
+                         f"p99 {_fmt_s(rs['p99'])}")
+            print(line)
         for bid, pb in fl.get("per_bucket", {}).items():
             bits = [f"  bucket {bid}: {pb['ticks']} "
                     f"tick{'s' if pb['ticks'] != 1 else ''}"]

@@ -45,7 +45,8 @@ _LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
                          "compile_s", "dispatch_s", "transfer_s", "host_s",
                          "rel_err", "blocking_transfers",
                          "dispatches_per_fit", "pad_waste", "degraded",
-                         "slo_burn_rate", "flight_dumps", "noise_ratio")
+                         "slo_burn_rate", "flight_dumps", "noise_ratio",
+                         "evictions_per")
 
 
 def lower_is_better(metric: str) -> bool:
@@ -75,6 +76,9 @@ _NOISE_FLOORS = (
     # errors sit near eps*N*T, so run-to-run DGP draws move the ratio by
     # halves without any numerics-level signal.
     ("noise_ratio", 0.5),
+    # Ring-buffer evictions per query (bench.stream) track the workload
+    # (rows/query), not a perf quality — only a whole-row move is signal.
+    ("evictions_per", 0.5),
     ("ms", 2.0),           # milliseconds: ms_per, _ms, dispatch_ms_...
     ("_s", 0.05),          # seconds: wall_s, dispatch_s, compile_s, time_s
     ("secs", 0.05),
@@ -255,7 +259,7 @@ class RunStore:
 
 # -- importer: seed the registry from the checked-in bench artifacts ------
 
-_DEVICE_RE = re.compile(r"JAX device: ([^\n]+)")
+_DEVICE_RE = re.compile(r"(?:JAX )?device: ([^\n;]+)")
 
 
 def _device_from_tail(tail: str) -> Optional[str]:
@@ -288,6 +292,12 @@ _BENCH_NUMERIC_KEYS = (
     # vs sequential (lower-is-better, "noise_ratio" marker rows above).
     "pit_qr_speedup_t300", "pit_qr_speedup_t1000", "pit_qr_speedup_t4000",
     "pit_qr_noise_ratio",
+    # Unbounded streams (bench.stream): ring-session throughput is the
+    # headline (higher-is-better); the p99 / readmission walls ride the
+    # "ms" marker rows, evictions/query its own marker row above.
+    "stream_qps", "stream_p50_ms", "stream_p99_ms",
+    "evictions_per_query", "readmission_ms",
+    "stream_blocking_transfers_per_query",
 )
 
 
@@ -346,13 +356,19 @@ def record_from_bench_all_entry(name: str, res: Dict[str, Any], *,
 
 def backfill(root: str = ".", store: Optional[RunStore] = None,
              runs: Optional[str] = None) -> int:
-    """Import ``BENCH_r*.json`` + ``BENCH_ALL.json`` under ``root`` into
-    the registry.  Idempotent: records whose ``source`` is already present
-    are skipped.  Returns the number of records appended."""
+    """Import ``BENCH_r*.json`` + ``BENCH_stream*.json`` +
+    ``BENCH_ALL.json`` under ``root`` into the registry.  Idempotent:
+    records whose ``source`` is already present are skipped.  Returns the
+    number of records appended."""
     store = store or RunStore(runs or runs_dir() or DEFAULT_DIR)
     existing = store.sources()
     n = 0
-    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+    # Round artifacts plus per-bench artifacts that share their format
+    # (e.g. BENCH_stream.json from bench.stream — ISSUE 14).
+    paths = sorted(
+        set(glob.glob(os.path.join(root, "BENCH_r*.json")))
+        | set(glob.glob(os.path.join(root, "BENCH_stream*.json"))))
+    for path in paths:
         src = os.path.basename(path)
         if src in existing:
             continue
@@ -365,9 +381,12 @@ def backfill(root: str = ".", store: Optional[RunStore] = None,
         parsed = data.get("parsed") or {}
         if _num(parsed.get("value")) is None:
             continue
+        kind = ("bench_stream" if src.startswith("BENCH_stream")
+                else "bench")
         rec = record_from_bench_json(
             parsed, device=_device_from_tail(data.get("tail", "")),
-            source=src, t_unix=os.path.getmtime(path), root=root)
+            source=src, t_unix=os.path.getmtime(path), root=root,
+            kind=kind)
         store.append(rec)
         n += 1
     path = os.path.join(root, "BENCH_ALL.json")
@@ -401,8 +420,9 @@ def main(argv=None) -> int:
         prog="python -m dfm_tpu.obs.store",
         description="Perf-observatory run registry (jax-free).")
     sub = ap.add_subparsers(dest="cmd", required=True)
-    bf = sub.add_parser("backfill",
-                        help="import BENCH_r*.json + BENCH_ALL.json")
+    bf = sub.add_parser(
+        "backfill",
+        help="import BENCH_r*.json + BENCH_stream*.json + BENCH_ALL.json")
     bf.add_argument("--root", default=".")
     bf.add_argument("--runs", default=None)
     ls = sub.add_parser("list", help="list recorded runs")

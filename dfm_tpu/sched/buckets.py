@@ -27,7 +27,25 @@ from typing import List, Optional, Sequence, Tuple
 
 from ..obs.cost import CostModel, DEFAULT_COEFFS, em_iter_work
 
-__all__ = ["Bucket", "BucketPlan", "plan_buckets", "plan_capacity_classes"]
+__all__ = ["Bucket", "BucketPlan", "lane_rent_bytes", "plan_buckets",
+           "plan_capacity_classes"]
+
+
+def lane_rent_bytes(dims: Tuple[int, int, int], r_max: int = 0,
+                    bytes_per: int = 4) -> float:
+    """HBM rent of ONE resident lane of a capacity class: the device
+    bytes a tenant occupies just by being hot — padded panel + mask
+    (T_cap x N each), the stacked params slice, and its share of the
+    per-tick row staging.  This is the "rent" side of the paging
+    economics: ``fleet.admission.readmission_cost_s`` prices the other
+    side (what paging the tenant back in would cost), and the fleet's
+    admission-pressure paging trades the two.  Pure arithmetic,
+    deterministic; ``bytes_per`` = device dtype width (4 = f32)."""
+    T, N, k = (int(d) for d in dims)
+    panel = 2 * T * N                       # Ybuf + Wbuf
+    params = N * k + N + 3 * k * k + k      # Lam, R, A/Q/P0, x0
+    staging = 2 * max(0, int(r_max)) * N    # rows + rmask slice
+    return float(bytes_per * (panel + params + staging))
 
 
 @dataclass(frozen=True)

@@ -58,7 +58,40 @@ from ..ops.linalg import matmul_vpu, matvec_vpu
 from ..ops.precision import accum_dtype
 
 __all__ = ["FleetOptions", "_fleet_core", "_fleet_impl",
-           "_fleet_impl_donated", "fleet_impl_sharded"]
+           "_fleet_impl_donated", "batched_ring_evict", "fleet_impl_sharded",
+           "ring_evict"]
+
+
+def ring_evict(Ybuf, Wbuf, n_evict, t_cur):
+    """Retire the oldest ``n_evict`` rows of a capacity-padded panel IN
+    GRAPH: roll the live window back to the buffer origin and re-zero
+    everything past the surviving prefix.
+
+    ``n_evict``/``t_cur`` are traced int32 scalars, so ONE executable
+    serves every eviction count — the ring-buffer seam that lets a
+    session outlive its capacity at constant memory.  The roll wraps the
+    evicted rows to the tail of the buffer; the ``where`` mask lands
+    exact zeros there (and on the whole former pad region), restoring
+    the invariant the masked filter/M-step rely on: rows past the live
+    prefix are exactly zero with zero mask.  With ``n_evict == 0`` the
+    select reproduces the input bit-for-bit (live rows selected
+    unchanged, pad rows already exactly zero), so non-ring sessions pay
+    nothing numerically for sharing the executable.
+    """
+    t_keep = t_cur - n_evict
+    keep = (jnp.arange(Ybuf.shape[0]) < t_keep)[:, None]
+    Yr = jnp.where(keep, jnp.roll(Ybuf, -n_evict, axis=0),
+                   jnp.zeros((), Ybuf.dtype))
+    Wr = jnp.where(keep, jnp.roll(Wbuf, -n_evict, axis=0),
+                   jnp.zeros((), Wbuf.dtype))
+    return Yr, Wr
+
+
+def batched_ring_evict(Ybuf, Wbuf, n_evict, t_cur):
+    """Per-lane ``ring_evict``: (B, T_cap, N) buffers, (B,) int32 counts.
+    Lanes are independent (a pure vmap), so frozen and mesh-filler lanes
+    pass ``n_evict=0`` and hold bit-exactly."""
+    return jax.vmap(ring_evict)(Ybuf, Wbuf, n_evict, t_cur)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,15 +177,20 @@ def _fleet_em_scan(Ybuf, Wbuf, p0, tol, floor, iter_cap, tick_act, t_new,
     return p, state, n_lls, good_it, jnp.moveaxis(lls, 0, 1)
 
 
-def _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol, floor,
-                iter_cap, tick_act, cfg, max_iters, opts):
-    """One fleet tick: ragged append, per-lane warm EM, smooth, nowcast +
-    forecasts for every lane — the (B,)-batched ``_session_core``.
+def _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0, tol,
+                floor, iter_cap, tick_act, cfg, max_iters, opts):
+    """One fleet tick: ring eviction, ragged append, per-lane warm EM,
+    smooth, nowcast + forecasts for every lane — the (B,)-batched
+    ``_session_core``.
 
     Ybuf/Wbuf (B, T_cap, N); rows/rmask (B, r_max, N) with exact-zero
-    fill past each tenant's true count; n_new/t_cur/iter_cap (B,) int32;
-    tol/floor (B,) accum dtype; tick_act (B,) bool.
+    fill past each tenant's true count; n_new/n_evict/t_cur/iter_cap (B,)
+    int32; tol/floor (B,) accum dtype; tick_act (B,) bool.  ``n_evict``
+    retires each lane's oldest rows in graph (ring fleets; all-zero for
+    pinned-capacity fleets, where the select is bit-inert).
     """
+    Ybuf, Wbuf = batched_ring_evict(Ybuf, Wbuf, n_evict, t_cur)
+    t_cur = t_cur - n_evict
     Ybuf, Wbuf = batched_ragged_append(Ybuf, Wbuf, rows, rmask, t_cur)
     t_new = t_cur + n_new
     p_fit, state, n_iters, good_it, lls = _fleet_em_scan(
@@ -202,39 +240,42 @@ _FLEET_STATICS = ("cfg", "max_iters", "opts")
 
 
 @partial(jax.jit, static_argnames=_FLEET_STATICS)
-def _fleet_impl(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol, floor,
-                iter_cap, tick_act, *, cfg, max_iters, opts):
-    return _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
-                       floor, iter_cap, tick_act, cfg, max_iters, opts)
+def _fleet_impl(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0, tol,
+                floor, iter_cap, tick_act, *, cfg, max_iters, opts):
+    return _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0,
+                       tol, floor, iter_cap, tick_act, cfg, max_iters, opts)
 
 
-# Donated twin: panel buffers (0, 1) and params (6) consumed in place —
+# Donated twin: panel buffers (0, 1) and params (7) consumed in place —
 # the fleet rebinds the returned arrays, so device memory stays one
 # bucket-buffer set deep.  CPU backends use the plain twin.
-@partial(jax.jit, static_argnames=_FLEET_STATICS, donate_argnums=(0, 1, 6))
-def _fleet_impl_donated(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
-                        floor, iter_cap, tick_act, *, cfg, max_iters, opts):
-    return _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
-                       floor, iter_cap, tick_act, cfg, max_iters, opts)
+@partial(jax.jit, static_argnames=_FLEET_STATICS, donate_argnums=(0, 1, 7))
+def _fleet_impl_donated(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0,
+                        tol, floor, iter_cap, tick_act, *, cfg, max_iters,
+                        opts):
+    return _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0,
+                       tol, floor, iter_cap, tick_act, cfg, max_iters, opts)
 
 
 @partial(jax.jit, static_argnames=_FLEET_STATICS + ("mesh",))
-def fleet_impl_sharded(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
-                       floor, iter_cap, tick_act, *, cfg, max_iters, opts,
-                       mesh):
+def fleet_impl_sharded(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0,
+                       tol, floor, iter_cap, tick_act, *, cfg, max_iters,
+                       opts, mesh):
     """shard_map'd tick: the bucket's batch axis split over the mesh.
 
-    The lanes are INDEPENDENT (no op contracts across B), so every input
-    and every output leaf shards with the same P("batch") pytree-prefix
-    spec and the body needs no collectives — the ``parallel.batched``
-    recipe applied to the serving tick.  The caller pads B to a multiple
-    of the mesh size with ``tick_act=False`` copies of lane 0 (frozen
-    from the start, value-inert)."""
+    The lanes are INDEPENDENT (no op contracts across B; the ring
+    eviction is a per-lane vmap), so every input and every output leaf
+    shards with the same P("batch") pytree-prefix spec and the body needs
+    no collectives — the ``parallel.batched`` recipe applied to the
+    serving tick.  The caller pads B to a multiple of the mesh size with
+    ``tick_act=False`` copies of lane 0 (frozen from the start,
+    value-inert)."""
     from ..parallel.batched import BATCH_AXIS
     from ..parallel.mesh import shard_map
     Pb = _PSpec(BATCH_AXIS)
     body = lambda *a: _fleet_core(*a, cfg=cfg, max_iters=max_iters,  # noqa: E731
                                   opts=opts)
-    return shard_map(body, mesh=mesh, in_specs=(Pb,) * 11,
-                     out_specs=Pb)(Ybuf, Wbuf, rows, rmask, n_new, t_cur,
-                                   p0, tol, floor, iter_cap, tick_act)
+    return shard_map(body, mesh=mesh, in_specs=(Pb,) * 12,
+                     out_specs=Pb)(Ybuf, Wbuf, rows, rmask, n_new, n_evict,
+                                   t_cur, p0, tol, floor, iter_cap,
+                                   tick_act)

@@ -30,6 +30,16 @@ Capacity overflow and row-budget violations raise on host BEFORE any
 dispatch.  A diverged update keeps the on-device last-good params (the
 fused driver's replay rule) and warns.
 
+Unbounded streams (``ring=True``): the capacity-padded panel becomes a
+RING BUFFER — an update past capacity retires the oldest rows in graph
+(``serve.batched.ring_evict``: a traced roll back to the buffer origin
+plus an exact re-zero of the vacated tail) instead of raising, so ONE
+executable serves an infinite stream at constant device + host memory.
+The eviction count is a traced scalar riding the same dispatch: zero
+recompiles, still at most one blocking d2h per query.  Post-eviction
+results are pinned to a cold ``fit(fused=True)`` on the equivalent
+trailing window (tests/test_stream.py, x64-exact + f32 variants).
+
 Self-healing (robust layer): sessions resolve a ``RobustPolicy`` from
 the backend (or the ``robust=`` argument) and route every query through
 ``robust.dispatch.guarded_dispatch`` — a failed dispatch retries from
@@ -71,6 +81,7 @@ from ..ssm.info_filter import info_filter
 from ..ssm.kalman import kalman_filter, rts_smoother
 from ..ssm.params import SSMParams as JaxParams
 from ..utils.data import build_mask
+from .batched import ring_evict
 
 __all__ = ["NowcastSession", "SessionUpdate", "open_session"]
 
@@ -89,17 +100,25 @@ def _live_accounting(session: str) -> dict:
     return accounting(session)
 
 
-def _session_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol, floor,
-                  cfg, max_iters, chunk, opts):
-    """One query: append rows, m warm EM iters, smooth, nowcast/forecast.
+def _session_core(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0, tol,
+                  floor, cfg, max_iters, chunk, opts):
+    """One query: evict, append rows, m warm EM iters, smooth, forecast.
 
     ``rows``/``rmask`` are (r_max, N) with exact-zero rows past ``n_new``
     (host-padded), so the scatter lands zeros on zero-masked tail slots —
     the buffer invariant (pad region exactly zero) is preserved for every
     ragged row count.  ``mode="drop"`` discards rim-adjacent writes past
     capacity (the host raised on real overflow before dispatch).
+
+    ``n_evict`` (traced int32, 0 outside ring mode) first retires the
+    oldest rows via ``ring_evict`` — the roll wraps them into the append
+    region where the incoming scatter overwrites them (eviction only
+    fires when ``t_new == capacity`` and ``n_evict <= n_new``), so the
+    buffer always holds exactly the trailing window, zero-padded.
     """
     r_max = rows.shape[0]
+    Ybuf, Wbuf = ring_evict(Ybuf, Wbuf, n_evict, t_cur)
+    t_cur = t_cur - n_evict
     idx = t_cur + jnp.arange(r_max)
     Ybuf = Ybuf.at[idx].set(rows, mode="drop")
     Wbuf = Wbuf.at[idx].set(rmask, mode="drop")
@@ -149,21 +168,21 @@ _STATICS = ("cfg", "max_iters", "chunk", "opts")
 
 
 @partial(jax.jit, static_argnames=_STATICS)
-def _session_impl(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol, floor, *,
-                  cfg, max_iters, chunk, opts):
-    return _session_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
-                         floor, cfg, max_iters, chunk, opts)
+def _session_impl(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0, tol,
+                  floor, *, cfg, max_iters, chunk, opts):
+    return _session_core(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur,
+                         p0, tol, floor, cfg, max_iters, chunk, opts)
 
 
-# Donated twin: panel buffers (0, 1) and params (6) are consumed in place
+# Donated twin: panel buffers (0, 1) and params (7) are consumed in place
 # — the session immediately rebinds the returned arrays, so device memory
 # stays one buffer set deep.  CPU backends use the plain twin (donation is
 # unimplemented there and warns).
-@partial(jax.jit, static_argnames=_STATICS, donate_argnums=(0, 1, 6))
-def _session_impl_donated(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
-                          floor, *, cfg, max_iters, chunk, opts):
-    return _session_core(Ybuf, Wbuf, rows, rmask, n_new, t_cur, p0, tol,
-                         floor, cfg, max_iters, chunk, opts)
+@partial(jax.jit, static_argnames=_STATICS, donate_argnums=(0, 1, 7))
+def _session_impl_donated(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur,
+                          p0, tol, floor, *, cfg, max_iters, chunk, opts):
+    return _session_core(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur,
+                         p0, tol, floor, cfg, max_iters, chunk, opts)
 
 
 @dataclasses.dataclass
@@ -194,7 +213,8 @@ class NowcastSession:
     def __init__(self, res, Y, mask=None, *, capacity: Optional[int] = None,
                  max_update_rows: int = 8, max_iters: int = 5,
                  tol: float = 1e-6, horizon: Optional[int] = None,
-                 di: Optional[bool] = None, backend=None, robust=None):
+                 di: Optional[bool] = None, ring: bool = False,
+                 backend=None, robust=None):
         from ..api import (CPUBackend, DynamicFactorModel, FitResult,
                            _resolve_policy, get_backend)
         if not isinstance(res, FitResult):
@@ -229,6 +249,11 @@ class NowcastSession:
         capacity = 2 * T0 if capacity is None else int(capacity)
         if capacity < T0:
             raise ValueError(f"capacity={capacity} < panel length T={T0}")
+        if ring and max_update_rows > capacity:
+            raise ValueError(
+                f"ring mode needs max_update_rows <= capacity so an "
+                f"update never evicts more rows than it appends; got "
+                f"max_update_rows={max_update_rows} > capacity={capacity}")
         # Frozen standardizer: incoming rows are transformed with the
         # OPEN-time stats (re-standardizing per query would re-unit the
         # device-resident params).  NaNs stay NaN through the affine map.
@@ -258,7 +283,9 @@ class NowcastSession:
         self._acc = accum_dtype(dt)
         self._N = N
         self._t = T0
+        self._t_total = T0
         self._capacity = capacity
+        self._ring = bool(ring)
         self._r_max = max(1, int(max_update_rows))
         self._max_iters = max(1, int(max_iters))
         self._tol = float(tol)
@@ -287,8 +314,32 @@ class NowcastSession:
         return self._capacity
 
     @property
-    def remaining(self) -> int:
-        """Rows that can still be appended before capacity overflow."""
+    def ring(self) -> bool:
+        """True if the session evicts its oldest rows past capacity
+        (unbounded stream) instead of raising."""
+        return self._ring
+
+    @property
+    def total_rows(self) -> int:
+        """Rows the session has EVER held (open panel + every append),
+        including rows since evicted — the stream position, as opposed
+        to ``t`` (the live trailing-window length)."""
+        return self._t_total
+
+    @property
+    def n_evicted(self) -> int:
+        """Rows retired by the ring buffer so far (0 outside ring mode)."""
+        return self._t_total - self._t
+
+    @property
+    def remaining(self) -> Optional[int]:
+        """Rows that can still be appended before capacity overflow.
+
+        ``None`` in ring mode: the stream is unbounded (appends past
+        capacity evict the oldest rows instead of raising), so there is
+        no finite remaining budget to report."""
+        if self._ring:
+            return None
         return self._capacity - self._t
 
     @property
@@ -342,11 +393,16 @@ class NowcastSession:
                 f"update has {n_new} rows but the session was opened with "
                 f"max_update_rows={self._r_max}; open with a larger row "
                 "budget (one executable serves every count up to it)")
+        n_evict = 0
         if self._t + n_new > self._capacity:
-            raise ValueError(
-                f"capacity overflow: session holds {self._t} rows of "
-                f"{self._capacity} and cannot take {n_new} more; open a "
-                "fresh session with a larger capacity")
+            if not self._ring:
+                raise ValueError(
+                    f"capacity overflow: session holds {self._t} rows of "
+                    f"{self._capacity} and cannot take {n_new} more; open "
+                    "with ring=True to evict the oldest rows in place "
+                    "(unbounded stream at constant memory), or open a "
+                    "fresh session with a larger capacity")
+            n_evict = self._t + n_new - self._capacity
         W_rows = build_mask(rows, mask)
         rz = self._std.transform(rows) if self._std is not None else rows
         rz = np.where(W_rows > 0, np.nan_to_num(rz), 0.0)
@@ -356,7 +412,8 @@ class NowcastSession:
                 [rz, np.zeros((pad, self._N), rz.dtype)], axis=0)
             W_rows = np.concatenate(
                 [W_rows, np.zeros((pad, self._N), W_rows.dtype)], axis=0)
-        t_new = self._t + n_new
+        t_mid = self._t - n_evict
+        t_new = t_mid + n_new
         # Per-update absolute loglik noise floor at the LIVE panel size —
         # the same floor a cold fit of the extended panel would use.
         floor = noise_floor_for(self._dt, t_new * self._N,
@@ -364,6 +421,7 @@ class NowcastSession:
         rows_j = jnp.asarray(rz, self._dt)
         rmask_j = jnp.asarray(W_rows, self._dt)
         consts = (jnp.asarray(n_new, jnp.int32),
+                  jnp.asarray(n_evict, jnp.int32),
                   jnp.asarray(self._t, jnp.int32),
                   jnp.asarray(self._tol, self._acc),
                   jnp.asarray(floor, self._acc))
@@ -383,7 +441,7 @@ class NowcastSession:
                 # recovery h2d upload of the exact original values).
                 self._redeploy()
             args = (self._Ybuf, self._Wbuf, rows_j, rmask_j, consts[0],
-                    consts[1], self._p, consts[2], consts[3])
+                    consts[1], consts[2], self._p, consts[3], consts[4])
             if tr is None:
                 out = impl(*args, **kw)
                 return out, self._read(out, donated and pol is not None)
@@ -412,9 +470,17 @@ class NowcastSession:
         # inputs are gone on real devices); the host shadows track the
         # same append in numpy.
         self._Ybuf, self._Wbuf = out["Ybuf"], out["Wbuf"]
-        self._Yhost[self._t:t_new] = rz[:n_new]
-        self._Whost[self._t:t_new] = W_rows[:n_new]
+        if n_evict:
+            # Mirror the in-graph ring eviction in numpy: shift the
+            # survivors to the origin; the wrapped tail [cap-e, cap) is
+            # inside the append range below (e <= n_new), so the row
+            # write restores exact host/device agreement.
+            self._Yhost[:-n_evict] = self._Yhost[n_evict:].copy()
+            self._Whost[:-n_evict] = self._Whost[n_evict:].copy()
+        self._Yhost[t_mid:t_new] = rz[:n_new]
+        self._Whost[t_mid:t_new] = W_rows[:n_new]
         self._t = t_new
+        self._t_total += n_new
         self._n_queries += 1
         if "p_np" in host:     # guarded donated path: last-good shadow
             self._p_host = host["p_np"]
@@ -453,6 +519,7 @@ class NowcastSession:
                    N=int(self._N), k=int(self._model.n_factors),
                    converged=bool(host["status"] == _CONVERGED),
                    diverged=bool(diverged),
+                   **({"n_evicted": int(n_evict)} if n_evict else {}),
                    **({"degraded": True} if degraded else {}))
         if tr is not None:
             tr.emit("query", **qev)
@@ -570,6 +637,8 @@ class NowcastSession:
             "std_scale": (self._std.scale if self._std is not None
                           else np.zeros(0)),
             "capacity": self._capacity,
+            "ring": self._ring,
+            "t_total": self._t_total,
             "max_update_rows": self._r_max,
             "max_iters": self._max_iters,
             "tol": self._tol,
@@ -587,8 +656,9 @@ class NowcastSession:
         return path
 
     @classmethod
-    def restore(cls, path: str, *, backend=None,
-                robust=None) -> "NowcastSession":
+    def restore(cls, path: str, *, backend=None, robust=None,
+                capacity: Optional[int] = None,
+                ring: Optional[bool] = None) -> "NowcastSession":
         """Rebuild a warm session from ``snapshot(path)``.
 
         The stored panel is verified against its content fingerprint
@@ -596,7 +666,16 @@ class NowcastSession:
         standardized live panel + params are re-uploaded exactly as the
         original session held them — the restored session's updates are
         numerically identical to the uninterrupted session's (pinned by
-        tests/test_chaos.py)."""
+        tests/test_chaos.py).
+
+        ``capacity``/``ring`` override the stored values (default: keep
+        them).  Restoring into a LARGER capacity just re-pads — the live
+        window is untouched.  Restoring into a capacity SMALLER than the
+        stored live length keeps the TRAILING ``capacity`` rows (the
+        ring-eviction semantics applied at restore time; the dropped
+        rows count as evicted) and requires ring mode — a pinned-
+        capacity session never drops data silently, so it raises
+        instead.  Pinned by tests/test_stream.py."""
         from ..api import (CPUBackend, DynamicFactorModel, _resolve_policy,
                            get_backend)
         from ..backends.cpu_ref import SSMParams
@@ -620,6 +699,10 @@ class NowcastSession:
             mean = np.asarray(z["std_mean"], np.float64)
             scale = np.asarray(z["std_scale"], np.float64)
             meta = {k: z[k][()] for k in meta_keys}
+            # PR 14 fields; default for snapshots written before ring mode.
+            meta["ring"] = (z["ring"][()] if "ring" in z.files else False)
+            meta["t_total"] = (z["t_total"][()] if "t_total" in z.files
+                               else Y_live.shape[0])
         if fp and panel_fingerprint(Y_live, W_live) != fp:
             raise ValueError(
                 f"session snapshot {path!r} is corrupt: the stored live "
@@ -637,9 +720,35 @@ class NowcastSession:
             standardize=bool(meta["model_standardize"]),
             estimate_init=bool(meta["model_estimate_init"]))
         T_live, N = Y_live.shape
-        capacity = int(meta["capacity"])
         self._opts = FusedOptions(horizon=int(meta["horizon"]),
                                   di=bool(meta["di"]))
+        ring_mode = bool(meta["ring"]) if ring is None else bool(ring)
+        capacity = (int(meta["capacity"]) if capacity is None
+                    else int(capacity))
+        if capacity < self._opts.horizon + 3:
+            raise ValueError(
+                f"capacity={capacity} < horizon + 3 = "
+                f"{self._opts.horizon + 3}: the restored session could "
+                "not anchor its forecast regressions")
+        if ring_mode and int(meta["max_update_rows"]) > capacity:
+            raise ValueError(
+                f"ring mode needs max_update_rows <= capacity; the "
+                f"snapshot was taken with max_update_rows="
+                f"{int(meta['max_update_rows'])} > capacity={capacity}")
+        if T_live > capacity:
+            # Trailing-window restore: a smaller capacity keeps the most
+            # recent ``capacity`` rows — the ring-eviction rule applied
+            # at restore time.  Only ring mode may drop data.
+            if not ring_mode:
+                raise ValueError(
+                    f"capacity={capacity} is smaller than the stored "
+                    f"live panel (T={T_live}): restoring would drop the "
+                    "oldest rows, which only ring mode allows — pass "
+                    "ring=True (trailing-window semantics) or a "
+                    "capacity >= the stored length")
+            Y_live = Y_live[T_live - capacity:]
+            W_live = W_live[T_live - capacity:]
+            T_live = capacity
         from ..utils.data import Standardizer
         self._std = (Standardizer(mean=mean, scale=scale) if mean.size
                      else None)
@@ -665,7 +774,9 @@ class NowcastSession:
         self._acc = accum_dtype(dt)
         self._N = N
         self._t = T_live
+        self._t_total = int(meta["t_total"])
         self._capacity = capacity
+        self._ring = ring_mode
         self._r_max = int(meta["max_update_rows"])
         self._max_iters = int(meta["max_iters"])
         self._tol = float(meta["tol"])
@@ -690,7 +801,9 @@ class NowcastSession:
 
     def __repr__(self):
         state = "closed" if self._closed else (
-            f"t={self._t}/{self._capacity}, {self._n_queries} queries")
+            f"t={self._t}/{self._capacity}"
+            + (f", ring (evicted {self.n_evicted})" if self._ring else "")
+            + f", {self._n_queries} queries")
         return (f"NowcastSession({self._sid}, N={self._N}, "
                 f"filter={self._cfg.filter}, {state})")
 
@@ -703,17 +816,25 @@ def open_session(res=None, Y=None, mask=None, *, snapshot=None,
     Y    : (T, N) panel the model was fitted on (original units; NaNs =
            missing), ``mask`` as in ``fit``.
     capacity        : padded time budget (default 2*T) — updates can
-                      append ``capacity - T`` rows before overflow.
+                      append ``capacity - T`` rows before overflow
+                      (ring mode: before eviction starts).
     max_update_rows : largest per-update row count (default 8); ONE
                       executable serves every count up to it.
     max_iters / tol : warm EM budget per query (default 5 / 1e-6).
     horizon / di    : forecast steps and diffusion-index toggle.
+    ring            : True turns the panel into a ring buffer — updates
+                      past capacity evict the oldest rows in graph
+                      (same executable, constant memory, unbounded
+                      stream) instead of raising; the session always
+                      holds the trailing ``capacity``-row window.
     backend         : "tpu" (default) or a TPUBackend instance.
     robust          : ``RobustPolicy`` / True / False — the self-healing
                       query guard; default inherits the backend's policy.
     snapshot        : path written by ``session.snapshot(path)`` —
                       restores the saved session instead (pass no
-                      res/Y/mask; ``backend``/``robust`` still apply).
+                      res/Y/mask; ``backend``/``robust``/``capacity``/
+                      ``ring`` still apply; a smaller capacity keeps the
+                      trailing window, ring mode only).
     """
     if snapshot is not None:
         if res is not None or Y is not None or mask is not None:

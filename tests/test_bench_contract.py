@@ -192,6 +192,90 @@ def test_headline_bench_prints_one_json_line_with_telemetry(tmp_path):
     assert gate.returncode == 0, gate.stdout + gate.stderr
 
 
+def test_longt_bench_prints_one_json_line(tmp_path):
+    """bench.longt (ported onto bench/_common.py, ISSUE 14 satellite)
+    keeps the contract: ONE JSON line, speedup keys for every swept T,
+    a run_id that round-trips through the DFM_RUNS registry, and a clean
+    regression gate."""
+    import json
+    import subprocess
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runs = tmp_path / "runs"
+    env = _driver_env()
+    env.update({"JAX_PLATFORMS": "cpu", "DFM_BENCH_N": "8",
+                "DFM_BENCH_K": "2", "DFM_BENCH_TSWEEP": "24,32",
+                "DFM_BENCH_ITERS": "2", "DFM_BENCH_REPS": "1",
+                "DFM_RUNS": str(runs)})
+    proc = subprocess.run(
+        [sys.executable, "-m", "bench.longt"], cwd=repo, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    out = json.loads(lines[0])
+    assert out["unit"] == "x"
+    assert out["pit_qr_speedup_t24"] > 0
+    assert out["pit_qr_speedup_t32"] > 0
+    assert out["pit_qr_noise_ratio"] >= 0
+    from dfm_tpu.obs.store import RunStore
+    (rec,) = RunStore(str(runs)).load()
+    assert rec["run_id"] == out["run_id"]
+    assert rec["kind"] == "bench_longt"
+    gate = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.regress", out["run_id"]],
+        cwd=repo, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, timeout=120)
+    assert gate.returncode == 0, gate.stdout + gate.stderr
+
+
+def test_stream_bench_prints_one_json_line(tmp_path):
+    """bench.stream (ISSUE 14): ONE JSON line carrying the ring-soak and
+    tiering metrics, zero recompiles after warmup, a registry round-trip
+    under kind="bench_stream", and a clean regression gate."""
+    import json
+    import subprocess
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runs = tmp_path / "runs"
+    env = _driver_env()
+    env.update({"JAX_PLATFORMS": "cpu", "DFM_BENCH_N": "10",
+                "DFM_BENCH_K": "2", "DFM_BENCH_STREAM_CAPACITY": "40",
+                "DFM_BENCH_QUERIES": "6", "DFM_BENCH_ROWS": "2",
+                "DFM_BENCH_SERVE_ITERS": "3", "DFM_BENCH_ITERS": "6",
+                "DFM_BENCH_STREAM_TENANTS": "4",
+                "DFM_BENCH_STREAM_RESIDENT": "2",
+                "DFM_RUNS": str(runs)})
+    proc = subprocess.run(
+        [sys.executable, "-m", "bench.stream"], cwd=repo, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    out = json.loads(lines[0])
+    assert out["unit"] == "queries/sec"
+    assert out["stream_qps"] > 0 and out["stream_p99_ms"] > 0
+    # The soak runs at a FULL panel: every query evicts exactly `rows`,
+    # on the ONE warm executable with <= 1 blocking d2h per query.
+    assert out["evictions_per_query"] == out["rows_per_query"]
+    assert out["recompiles_after_warmup"] == 0
+    assert out["stream_blocking_transfers_per_query"] <= 1
+    assert out["readmission_ms"] >= 0 and out["tiering_page_ins"] > 0
+    # The traced cold fit records its own run too (DFM_RUNS is set) —
+    # the bench line is the one bench_stream record.
+    from dfm_tpu.obs.store import RunStore
+    recs = RunStore(str(runs)).load()
+    (rec,) = [r for r in recs if r["kind"] == "bench_stream"]
+    assert rec["run_id"] == out["run_id"]
+    gate = subprocess.run(
+        [sys.executable, "-m", "dfm_tpu.obs.regress", out["run_id"]],
+        cwd=repo, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, timeout=120)
+    assert gate.returncode == 0, gate.stdout + gate.stderr
+
+
 def test_dryrun_multichip_driver_context():
     """The VERDICT r1 failure: plain import + dryrun, no conftest, no env.
 

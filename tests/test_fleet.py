@@ -242,7 +242,8 @@ def _tick_direct(bk, rows, n_new, active=True):
     return _fleet_impl(
         bk.Ybuf, bk.Wbuf, jnp.asarray(rows_b, bk.dt),
         jnp.asarray(rmask_b, bk.dt),
-        jnp.asarray([n_new], np.int32), jnp.asarray([slot.t], np.int32),
+        jnp.asarray([n_new], np.int32), jnp.asarray([0], np.int32),
+        jnp.asarray([slot.t], np.int32),
         bk.p, jnp.asarray([0.0], bk.acc),
         jnp.asarray([bk.floor_for(slot, slot.t + n_new)], bk.acc),
         jnp.asarray([slot.max_iters], np.int32), jnp.asarray([active]),

@@ -136,11 +136,11 @@ def test_mf_time_scan_pit_qr_matches_seq():
     from dfm_tpu.models.mixed_freq import MixedFreqSpec, mf_fit
     rng = np.random.default_rng(65)
     Y, mask, _, _ = dgp.simulate_mixed_freq(
-        n_monthly=24, n_quarterly=6, T=60, k=2, rng=rng)
-    spec = MixedFreqSpec(n_monthly=24, n_quarterly=6, n_factors=2)
-    r_seq = mf_fit(Y, spec, mask=mask, max_iters=6, tol=0.0)
+        n_monthly=12, n_quarterly=3, T=36, k=1, rng=rng)
+    spec = MixedFreqSpec(n_monthly=12, n_quarterly=3, n_factors=1)
+    r_seq = mf_fit(Y, spec, mask=mask, max_iters=4, tol=0.0)
     r_qr = mf_fit(Y, dataclasses.replace(spec, time_scan="pit_qr"),
-                  mask=mask, max_iters=6, tol=0.0)
+                  mask=mask, max_iters=4, tol=0.0)
     np.testing.assert_allclose(np.asarray(r_qr.logliks),
                                np.asarray(r_seq.logliks), rtol=1e-7)
     with pytest.raises(ValueError):

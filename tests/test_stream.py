@@ -1,0 +1,513 @@
+"""Unbounded streams (ISSUE 14): ring-buffer panels + snapshot tiering.
+
+The operative contracts, verified on the fake 8-device CPU mesh:
+
+- RING PARITY: a ``ring=True`` session update past capacity retires the
+  oldest rows IN GRAPH and is numerically pinned to a cold
+  ``fit(fused=True)`` of the equivalent TRAILING WINDOW at the same
+  start params and iteration budget — x64 to ~1e-11, an f32 variant to
+  f32 tolerance; ring fleets match lone ring sessions and the sharded
+  ring fleet matches the single-device one.
+- CONSTANT-MEMORY BUDGET: the eviction roll rides the one serve_update
+  executable — across a soak past capacity a traced ring session pays
+  1 first-call + 0 recompiles, exactly one blocking d2h per query, and
+  neither the device buffer nor the host shadows grow a byte.
+- OVERFLOW ERGONOMICS (satellite): non-ring overflow names ``ring=True``
+  as the fix; ``remaining`` is None (unbounded) in ring mode.
+- SNAPSHOT ACROSS CAPACITY (satellite): restoring a ring snapshot into
+  a smaller capacity keeps the TRAILING window (the eviction rule
+  applied retroactively); a non-ring restore refuses to drop data.
+- TIERING: a fleet holds >= 4x more registered tenants than resident
+  HBM lanes — paged tenants heal BIT-IDENTICAL to an all-hot twin,
+  including a cold (on-disk) spill/thaw round-trip; paging is traced
+  into the report's fleet section.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dfm_tpu import DynamicFactorModel, fit, open_fleet, open_session
+from dfm_tpu.api import TPUBackend
+from dfm_tpu.fleet.admission import plan_residency, readmission_cost_s
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.report import _print_text, summarize
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.sched.buckets import lane_rent_bytes
+from dfm_tpu.utils import dgp
+
+MODEL = DynamicFactorModel(n_factors=2, standardize=False)
+# The fleet core is info-filter-only; parity references must run the
+# same filter (the auto heuristic would pick dense at these small N).
+BE = TPUBackend(filter="info")
+
+
+@pytest.fixture(scope="module")
+def panel():
+    """(T_all, N) panel with one missing cell; the first 40 rows open a
+    FULL ring session (capacity 40), the rest stream in past capacity."""
+    rng = np.random.default_rng(14)
+    p = dgp.dfm_params(N=12, k=2, rng=rng)
+    Y, _ = dgp.simulate(p, T=60, rng=rng)
+    Y[3, 5] = np.nan
+    return Y
+
+
+def _cold_ref(Ywin, init, m, backend=None):
+    """The ring parity oracle: a cold fused fit of the TRAILING WINDOW
+    from the same start params at the same pinned budget."""
+    return fit(MODEL, Ywin, backend=backend, fused=True, max_iters=m,
+               tol=0.0, init=init)
+
+
+def _assert_update_matches(u, ref, tol=1e-9, atol=1e-10, ll_rtol=1e-7):
+    np.testing.assert_allclose(u.nowcast, ref.nowcast, rtol=tol, atol=atol)
+    np.testing.assert_allclose(u.factors, ref.factors, rtol=tol, atol=atol)
+    np.testing.assert_allclose(u.forecasts["y"], ref.forecasts["y"],
+                               rtol=tol, atol=atol)
+    assert u.n_iters == ref.n_iters
+    np.testing.assert_allclose(u.logliks, ref.logliks, rtol=ll_rtol,
+                               atol=1e-6)
+
+
+def _tenant(N, T, k, seed, extra=10):
+    rng = np.random.default_rng(seed)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y, _ = dgp.simulate(p_true, T + extra, rng)
+    res = fit(DynamicFactorModel(n_factors=k), Y[:T], max_iters=8,
+              backend=BE, telemetry=False)
+    return res, Y[:T], Y[T:]
+
+
+# ------------------------------------------------------- ring parity --
+
+def test_ring_update_matches_cold_fit_trailing_window(panel):
+    """The acceptance pin: every post-capacity update == a cold fused
+    fit of the trailing ``capacity``-row window, chained across queries
+    (update 2 starts from update 1's params, window slides by n_new)."""
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=20, tol=1e-6)
+    sess = open_session(res0, Y0, capacity=40, max_update_rows=4,
+                        max_iters=5, tol=0.0, ring=True)
+    assert sess.t == 40 and sess.ring
+
+    u1 = sess.update(panel[40:43])       # evicts rows 0-2 in graph
+    assert u1.t == 40 and sess.t == 40
+    assert sess.n_evicted == 3 and sess.total_rows == 43
+    ref1 = _cold_ref(panel[3:43], res0.params, 5)
+    _assert_update_matches(u1, ref1)
+
+    u2 = sess.update(panel[43:45])       # window slides to rows 5..45
+    assert sess.n_evicted == 5 and sess.total_rows == 45
+    ref2 = _cold_ref(panel[5:45], ref1.params, 5)
+    _assert_update_matches(u2, ref2)
+    np.testing.assert_allclose(u2.factor_cov, ref2.factor_cov,
+                               rtol=1e-9, atol=1e-10)
+    sess.close()
+
+
+def test_ring_partial_overflow_and_below_capacity_updates(panel):
+    """A session BELOW capacity evicts only the overflow: a 3-row update
+    at t=38 of 40 retires one row; an update that still fits evicts
+    none (bit-path-identical to a non-ring session)."""
+    Y0 = panel[:38]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=16, tol=1e-6)
+    sess = open_session(res0, Y0, capacity=40, max_update_rows=3,
+                        max_iters=4, tol=0.0, ring=True)
+
+    u0 = sess.update(panel[38:40])       # fits: no eviction
+    assert sess.t == 40 and sess.n_evicted == 0
+    ref0 = _cold_ref(panel[:40], res0.params, 4)
+    _assert_update_matches(u0, ref0)
+
+    u1 = sess.update(panel[40:43])       # 40 + 3 -> evict exactly 3
+    assert sess.t == 40 and sess.n_evicted == 3
+    ref1 = _cold_ref(panel[3:43], ref0.params, 4)
+    _assert_update_matches(u1, ref1)
+    sess.close()
+
+
+def test_ring_update_matches_cold_fit_f32(panel):
+    b = TPUBackend(dtype=jnp.float32)
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, backend=b, fused=True, max_iters=16, tol=1e-5)
+    sess = open_session(res0, Y0, backend=b, capacity=40,
+                        max_update_rows=2, max_iters=4, tol=0.0,
+                        ring=True)
+    u = sess.update(panel[40:42])
+    assert sess.n_evicted == 2
+    ref = _cold_ref(panel[2:42], res0.params, 4,
+                    backend=TPUBackend(dtype=jnp.float32))
+    np.testing.assert_allclose(u.nowcast, ref.nowcast, rtol=5e-3,
+                               atol=5e-3)
+    np.testing.assert_allclose(u.factors, ref.factors, rtol=5e-3,
+                               atol=5e-3)
+    assert u.n_iters == ref.n_iters
+    sess.close()
+
+
+# ------------------------------------------- constant-memory budget --
+
+def test_ring_soak_one_executable_flat_footprint(panel):
+    """Queries >> remaining capacity: 1 first-call + 0 recompiles, one
+    blocking d2h per query, and the buffers never grow — the report
+    carries the traced eviction ledger."""
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=12, tol=1e-6)
+    tr = Tracer(detector=RecompileDetector())
+    with activate(tr):
+        sess = open_session(res0, Y0, capacity=40, max_update_rows=3,
+                            max_iters=3, tol=0.0, ring=True)
+        dev_shape = sess._Ybuf.shape
+        host_bytes = sess._Yhost.nbytes + sess._Whost.nbytes
+        t = 40
+        for n in (2, 3, 1, 2, 3):   # ragged row counts, one padded shape
+            u = sess.update(panel[t:t + n])
+            t += n
+            assert u.t == 40 and sess.t == 40
+        assert sess._Ybuf.shape == dev_shape
+        assert sess._Yhost.nbytes + sess._Whost.nbytes == host_bytes
+        assert sess.n_evicted == 11 and sess.total_rows == 51
+
+    disp = [e for e in tr.events if e.get("kind") == "dispatch"
+            and e.get("program") == "serve_update"]
+    assert len(disp) == 5
+    assert sum(1 for e in disp if e.get("first_call")) == 1
+    assert sum(1 for e in disp if e.get("recompile")) == 0
+
+    s = summarize(tr.events)
+    assert s["blocking_transfers"] == 5
+    q = s["queries"]
+    assert q["n_queries"] == 5 and q["recompiles_after_warmup"] == 0
+    assert q["rows_evicted"] == 11 and q["evicting_queries"] == 5
+    _print_text(s)   # the text report renders the eviction ledger
+    sess.close()
+
+
+def test_ring_query_events_carry_eviction_count(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=8, tol=1e-6)
+    tr = Tracer()
+    with activate(tr):
+        sess = open_session(res0, Y0, capacity=40, max_update_rows=2,
+                            max_iters=3, tol=0.0, ring=True)
+        sess.update(panel[40:42])
+        sess.close()
+    ev = [e for e in tr.events if e.get("kind") == "query"]
+    assert len(ev) == 1 and ev[0]["n_evicted"] == 2
+
+    # The always-on metrics plane sees the same ledger.
+    from dfm_tpu.obs.metrics import MetricsRegistry, record_event
+    reg = MetricsRegistry()
+    for e in tr.events:
+        record_event(reg, None, e)
+    snap = reg.snapshot()
+    assert any(k.startswith("evicted_rows_total") and v == 2
+               for k, v in snap["counters"].items())
+
+
+# ------------------------------------------- overflow ergonomics -----
+
+def test_non_ring_overflow_message_names_ring_option(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=8, tol=1e-6)
+    sess = open_session(res0, Y0, capacity=41, max_update_rows=4)
+    assert not sess.ring and sess.remaining == 1
+    with pytest.raises(ValueError, match="capacity overflow") as ei:
+        sess.update(panel[40:43])
+    assert "ring=True" in str(ei.value)
+    assert sess.t == 40          # raised BEFORE any dispatch
+    sess.close()
+
+
+def test_ring_remaining_is_none_and_repr(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=8, tol=1e-6)
+    sess = open_session(res0, Y0, capacity=40, max_update_rows=2,
+                        ring=True)
+    assert sess.remaining is None     # unbounded: no overflow exists
+    assert "ring" in repr(sess)
+    sess.close()
+
+
+def test_ring_rejects_update_rows_above_capacity(panel):
+    res0 = fit(MODEL, panel[:40], fused=True, max_iters=8, tol=1e-6)
+    with pytest.raises(ValueError, match="max_update_rows"):
+        open_session(res0, panel[:40], capacity=40, max_update_rows=41,
+                     ring=True)
+
+
+# ------------------------------- snapshot across a capacity change ---
+
+def _open_ring(res0, Y0, **kw):
+    kw.setdefault("capacity", 40)
+    kw.setdefault("max_update_rows", 3)
+    kw.setdefault("max_iters", 3)
+    kw.setdefault("tol", 0.0)
+    kw.setdefault("ring", True)
+    return open_session(res0, Y0, **kw)
+
+
+def test_snapshot_restore_smaller_capacity_keeps_trailing_window(
+        panel, tmp_path):
+    """The pinned semantics: restoring into capacity C keeps the LAST C
+    live rows — the ring eviction rule applied retroactively — and the
+    restored session's next update matches a cold fused fit of the new
+    (smaller) trailing window."""
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=12, tol=1e-6)
+    sess = _open_ring(res0, Y0)
+    u1 = sess.update(panel[40:43])        # live window = rows 3..43
+    path = sess.snapshot(str(tmp_path / "ring.npz"))
+    p_now = sess._p.to_numpy()
+    sess.close()
+
+    re = open_session(snapshot=path, capacity=36)
+    assert re.ring and re.t == 36 and re.capacity == 36
+    # The kept rows ARE the trailing 36 of the live window (rows 7..43).
+    np.testing.assert_allclose(re._Yhost[:36],
+                               np.nan_to_num(panel[7:43]), atol=1e-12)
+
+    u2 = re.update(panel[43:45])          # window slides to rows 9..45
+    # The lifetime total rides the snapshot: 43 streamed + 2 new; the
+    # eviction ledger is the difference to the held window.
+    assert re.t == 36 and re.total_rows == 45 and re.n_evicted == 9
+    ref = _cold_ref(panel[9:45], p_now, 3)
+    _assert_update_matches(u2, ref)
+    assert np.isfinite(u1.nowcast).all()
+    re.close()
+
+
+def test_snapshot_restore_larger_capacity_repads(panel, tmp_path):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=12, tol=1e-6)
+    sess = _open_ring(res0, Y0)
+    sess.update(panel[40:42])
+    path = sess.snapshot(str(tmp_path / "ring.npz"))
+    p_now = sess._p.to_numpy()
+    sess.close()
+
+    re = open_session(snapshot=path, capacity=64)
+    assert re.t == 40 and re.capacity == 64 and re.ring
+    u = re.update(panel[42:44])           # room again: NO eviction —
+    # t grows by n_new; the ledger still remembers the 2 pre-snapshot
+    # evictions (lifetime total 44, held 42).
+    assert re.t == 42 and re.n_evicted == 2 and re.total_rows == 44
+    ref = _cold_ref(panel[2:44], p_now, 3)
+    _assert_update_matches(u, ref)
+    re.close()
+
+
+def test_non_ring_restore_refuses_to_drop_data(panel, tmp_path):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=8, tol=1e-6)
+    sess = open_session(res0, Y0, capacity=44, max_update_rows=2)
+    sess.update(panel[40:42])
+    path = sess.snapshot(str(tmp_path / "flat.npz"))
+    sess.close()
+
+    # A fixed-capacity session never drops data silently.
+    with pytest.raises(ValueError, match="ring"):
+        open_session(snapshot=path, capacity=38)
+    # The SAME shrink is legal once the caller opts into ring semantics.
+    re = open_session(snapshot=path, capacity=38, ring=True)
+    assert re.ring and re.t == 38
+    re.close()
+
+
+# ------------------------------------------------------ ring fleets --
+
+@pytest.fixture(scope="module")
+def duo():
+    return [_tenant(10, 40, 2, 31), _tenant(12, 40, 2, 32)]
+
+
+def _open_fleet(tenants, **kw):
+    kw.setdefault("capacity", 42)
+    kw.setdefault("max_update_rows", 2)
+    kw.setdefault("max_iters", 3)
+    kw.setdefault("tol", 0.0)
+    kw.setdefault("backend", BE)
+    kw.setdefault("max_classes", 1)
+    return open_fleet([t[0] for t in tenants], [t[1] for t in tenants],
+                      **kw)
+
+
+def test_ring_fleet_matches_lone_ring_sessions(duo):
+    """Each tenant's ring-fleet answer IS its lone ring session's,
+    through rounds that roll both panels past capacity."""
+    fl = _open_fleet(duo, ring=True)
+    lone = [open_session(r, Y, capacity=42, max_update_rows=2,
+                         max_iters=3, tol=0.0, backend=BE, ring=True)
+            for r, Y, _ in duo]
+    for rnd in range(4):                 # 8 rows streamed into cap 42
+        for i in range(2):
+            fl.submit(f"t{i}", duo[i][2][2 * rnd:2 * rnd + 2])
+        out = fl.drain()
+        for i in range(2):
+            u = out[f"t{i}"][0]
+            ref = lone[i].update(duo[i][2][2 * rnd:2 * rnd + 2])
+            assert u.t == ref.t and u.n_iters == ref.n_iters
+            np.testing.assert_allclose(u.nowcast, ref.nowcast,
+                                       rtol=1e-9, atol=1e-10)
+            np.testing.assert_allclose(u.forecasts["y"],
+                                       ref.forecasts["y"],
+                                       rtol=1e-9, atol=1e-10)
+    assert lone[0].n_evicted == 6 and lone[0].t == 42
+    for s in lone:
+        s.close()
+    fl.close()
+
+
+def test_sharded_ring_fleet_matches_single_device(duo):
+    outs = []
+    for backend in (BE, "sharded"):
+        fl = _open_fleet(duo, ring=True, backend=backend)
+        for rnd in range(3):
+            for i in range(2):
+                fl.submit(f"t{i}", duo[i][2][2 * rnd:2 * rnd + 2])
+            out = fl.drain()
+        outs.append(out)
+        fl.close()
+    for t in ("t0", "t1"):
+        a, b = outs[0][t][0], outs[1][t][0]
+        np.testing.assert_allclose(a.nowcast, b.nowcast, rtol=1e-9,
+                                   atol=1e-10)
+        np.testing.assert_allclose(a.forecasts["y"], b.forecasts["y"],
+                                   rtol=1e-9, atol=1e-10)
+        assert a.n_iters == b.n_iters
+
+
+def test_non_ring_fleet_overflow_names_ring_option(duo):
+    fl = _open_fleet(duo, capacity=42)
+    assert fl.submit("t0", duo[0][2][:2]) == 1      # 40 -> 42, exact fit
+    with pytest.raises(ValueError, match="capacity overflow") as ei:
+        fl.submit("t0", duo[0][2][2:4])             # projected 44 > 42
+    assert "ring=True" in str(ei.value)
+    fl.drain()
+    fl.close()
+
+
+# -------------------------------------------------- snapshot tiering --
+
+@pytest.fixture(scope="module")
+def octet():
+    """Eight tenants, one shape — the >= 4x-over-lanes acceptance mix."""
+    return [_tenant(8, 36, 2, 40 + i) for i in range(8)]
+
+
+def test_fleet_tiering_4x_tenants_bit_identical(octet):
+    """The acceptance pin: 8 registered tenants on 2 resident lanes —
+    every answer through warm-paging churn is BIT-IDENTICAL to an
+    all-hot twin's, and the paging traffic lands in the report."""
+    kw = dict(capacity=42, max_update_rows=2, max_iters=3, tol=0.0,
+              backend=BE, max_classes=1)
+    results = [t[0] for t in octet]
+    panels = [t[1] for t in octet]
+    tw = open_fleet(results, panels, **kw)
+    tr = Tracer()
+    with activate(tr):
+        fl = open_fleet(results, panels, resident=2, **kw)
+        assert fl.resident_lanes == 2
+        assert sum(fl.tier(f"t{i}") == "hot" for i in range(8)) == 2
+        n_paged = 0
+        for rnd in range(2):
+            for i in range(8):
+                rows = octet[i][2][2 * rnd:2 * rnd + 2]
+                n_paged += fl.tier(f"t{i}") != "hot"
+                fl.submit(f"t{i}", rows)
+                tw.submit(f"t{i}", rows)
+                a = fl.drain()[f"t{i}"][0]
+                b = tw.drain()[f"t{i}"][0]
+                assert np.array_equal(a.nowcast, b.nowcast), (i, rnd)
+                assert np.array_equal(a.forecasts["y"],
+                                      b.forecasts["y"]), (i, rnd)
+                assert np.array_equal(a.factors, b.factors), (i, rnd)
+        fl.close()
+    assert n_paged >= 12          # churn: nearly every submit paged
+
+    s = summarize(tr.events)
+    pg = s["fleet"]["paging"]
+    assert pg["admits"] == n_paged and pg["demotes"] >= n_paged - 2
+    assert pg["readmission_s"]["p50"] > 0
+    _print_text(s)                # renders the paging line
+    tw.close()
+
+
+def test_cold_spill_thaw_roundtrip(octet, tmp_path):
+    kw = dict(capacity=42, max_update_rows=2, max_iters=3, tol=0.0,
+              backend=BE, max_classes=1)
+    results = [t[0] for t in octet[:3]]
+    panels = [t[1] for t in octet[:3]]
+    tw = open_fleet(results, panels, **kw)
+    fl = open_fleet(results, panels, **kw)
+
+    path = str(tmp_path / "t1.npz")
+    fl.evict("t1", tier="cold", path=path)
+    assert fl.tier("t1") == "cold"
+    import os
+    assert os.path.exists(path)
+
+    rows = octet[1][2][:2]
+    fl.submit("t1", rows)         # auto-thaws + re-admits
+    tw.submit("t1", rows)
+    a, b = fl.drain()["t1"][0], tw.drain()["t1"][0]
+    assert fl.tier("t1") == "hot"
+    assert np.array_equal(a.nowcast, b.nowcast)
+    assert np.array_equal(a.forecasts["y"], b.forecasts["y"])
+
+    # Validation: unknown tenants and tiers fail fast; a tenant with a
+    # pending query can't be paged out from under its own tick.
+    with pytest.raises(KeyError):
+        fl.evict("nope")
+    with pytest.raises(ValueError, match="tier"):
+        fl.evict("t0", tier="lukewarm")
+    fl.submit("t0", octet[0][2][:2])
+    with pytest.raises(ValueError, match="pending"):
+        fl.evict("t0")
+    fl.drain()
+    fl.close()
+    tw.close()
+
+
+# -------------------------------------------- admission economics ----
+
+def test_plan_residency_properties():
+    from dfm_tpu.fleet.admission import ClassAssignment
+    classes = [ClassAssignment(dims=(48, 12, 2), members=(0, 1, 2, 3)),
+               ClassAssignment(dims=(64, 20, 3), members=(4, 5))]
+    # No budget: every tenant is hot.
+    assert plan_residency(classes, None) == [4, 2]
+    # The budget is split deterministically, >= 1 lane per class, and
+    # never exceeds a class's tenant count.
+    plan = plan_residency(classes, 3)
+    assert plan == plan_residency(classes, 3)        # deterministic
+    assert sum(plan) == 3 and all(p >= 1 for p in plan)
+    assert all(p <= len(ca.members) for p, ca in zip(plan, classes))
+    # A budget covering everyone degenerates to all-hot.
+    assert plan_residency(classes, 99) == [4, 2]
+
+
+def test_readmission_cost_scales_with_lane_rent():
+    small = readmission_cost_s((48, 12, 2), r_max=2)
+    big = readmission_cost_s((512, 120, 8), r_max=2)
+    assert 0 < small < big
+    assert lane_rent_bytes((48, 12, 2), 2) < lane_rent_bytes(
+        (512, 120, 8), 2)
+
+
+# ------------------------------------------------------ obs plumbing --
+
+def test_stream_metrics_registered_in_store():
+    from dfm_tpu.obs import store
+    need = ("stream_qps", "stream_p99_ms", "evictions_per_query",
+            "readmission_ms", "stream_blocking_transfers_per_query")
+    for k in need:
+        assert k in store._BENCH_NUMERIC_KEYS
+    assert not store.lower_is_better("stream_qps")
+    for k in need[1:]:
+        assert store.lower_is_better(k)
+    assert store.noise_floor("evictions_per_query") == 0.5
+    assert store.noise_floor("stream_p99_ms") == 2.0
+    assert store.noise_floor("readmission_ms") == 2.0

@@ -147,5 +147,27 @@ assert store.lower_is_better("pit_qr_noise_ratio"), \
 assert store.noise_floor("pit_qr_noise_ratio") > 0, \
     "perf_gate: pit_qr_noise_ratio lost its noise floor"'
 
+# The unbounded-stream metrics (bench.stream / tools/stream_smoke.sh)
+# must stay registered: ring-session throughput gates higher-is-better;
+# the p99 query wall and warm/cold re-admission walls ride the ms noise
+# floor, evictions/query its own whole-row floor (all lower-is-better).
+python -c '
+from dfm_tpu.obs import store
+need = ("stream_qps", "stream_p99_ms", "evictions_per_query",
+        "readmission_ms", "stream_blocking_transfers_per_query")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+assert not store.lower_is_better("stream_qps"), \
+    "perf_gate: stream_qps must gate higher-is-better"
+for k in need[1:]:
+    assert store.lower_is_better(k), \
+        f"perf_gate: {k} lost its lower-is-better marker"
+assert store.noise_floor("stream_p99_ms") > 0, \
+    "perf_gate: stream_p99_ms lost its ms noise floor"
+assert store.noise_floor("readmission_ms") > 0, \
+    "perf_gate: readmission_ms lost its ms noise floor"
+assert store.noise_floor("evictions_per_query") > 0, \
+    "perf_gate: evictions_per_query lost its noise floor"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"

@@ -1,0 +1,130 @@
+#!/usr/bin/env bash
+# One-command unbounded-stream soak (ISSUE 14): run a ring-buffer session
+# far past its capacity on the fake mesh and assert the constant-memory
+# contract from the trace via the report CLI — flat host+device buffer
+# footprint, ZERO recompiles after warmup (the in-graph eviction roll
+# rides the one serve_update executable), and <= 1 blocking d2h per
+# query.  A second leg opens a fleet with more registered tenants than
+# resident HBM lanes, churns the hot set through warm AND cold tiers,
+# and asserts every paged-out tenant heals BIT-EXACT against an all-hot
+# twin after re-admission.  The quick way to answer "can this serve an
+# infinite stream at constant memory" without the real chip.
+#
+# Usage (from the repo root):
+#   tools/stream_smoke.sh [trace_path]       # default /tmp/dfm_stream.jsonl
+#
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time;
+# export JAX_PLATFORMS= (empty) to smoke the default backend instead.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRACE="${1:-/tmp/dfm_stream.jsonl}"
+rm -f "$TRACE"
+
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" python - "$TRACE" <<'PY'
+import sys
+import tempfile
+
+import numpy as np
+
+from dfm_tpu import DynamicFactorModel, fit, open_fleet, open_session
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.utils import dgp
+
+# -- leg 1: ring-session soak at queries >> capacity --------------------
+rng = np.random.default_rng(14)
+p_true = dgp.dfm_params(20, 2, rng)
+CAP, ROWS, QUERIES = 40, 2, 30          # 62 rows streamed past a full panel
+Y, _ = dgp.simulate(p_true, CAP + (QUERIES + 1) * ROWS, rng)
+Y0, stream = Y[:CAP], Y[CAP:]
+
+model = DynamicFactorModel(n_factors=2)
+res = fit(model, Y0, max_iters=24, tol=1e-6, fused=True)
+print(f"cold fused fit: {res.n_iters} iters, "
+      f"converged={bool(res.converged)}")
+
+tr = Tracer(path=sys.argv[1], detector=RecompileDetector())
+with activate(tr):
+    # The panel starts FULL, so every update evicts `ROWS` oldest rows
+    # in-graph while appending — the buffer never grows.
+    sess = open_session(res, Y0, capacity=CAP, max_update_rows=ROWS,
+                        max_iters=4, tol=0.0, ring=True)
+    assert sess.remaining is None, "ring session must report unbounded"
+    sess.update(stream[:ROWS])                      # compile + warm
+    dev_shape = sess._Ybuf.shape
+    host_bytes = sess._Yhost.nbytes + sess._Whost.nbytes
+    for i in range(1, QUERIES + 1):
+        u = sess.update(stream[i * ROWS:(i + 1) * ROWS])
+    assert sess._Ybuf.shape == dev_shape, "device buffer grew"
+    assert sess._Yhost.nbytes + sess._Whost.nbytes == host_bytes, \
+        "host shadow grew"
+    assert sess.t == CAP and sess.n_evicted == (QUERIES + 1) * ROWS, \
+        (sess.t, sess.n_evicted)
+    print(f"ring soak: {QUERIES + 1} queries streamed "
+          f"{sess.total_rows - CAP} rows past capacity={CAP}; "
+          f"evicted {sess.n_evicted}, footprint flat, "
+          f"nowcast[:3]={np.round(u.nowcast[:3], 3).tolist()}")
+    sess.close()
+tr.close()
+
+# -- leg 2: tiering churn heals bit-exact -------------------------------
+# 4 tenants on 2 resident lanes: every submit past the hot set pages a
+# warm tenant in and demotes the LRU hot one.  The all-hot twin fleet
+# (resident=None) never pages; answers must agree to the BIT.
+rng2 = np.random.default_rng(15)
+tenants, panels, streams = [], [], []
+for i in range(4):
+    pt = dgp.dfm_params(10, 2, rng2)
+    Yt, _ = dgp.simulate(pt, 46, rng2)
+    r = fit(DynamicFactorModel(n_factors=2), Yt[:40], max_iters=8,
+            telemetry=False)
+    tenants.append(r); panels.append(Yt[:40]); streams.append(Yt[40:])
+
+kw = dict(capacity=48, max_update_rows=2, max_iters=3, tol=0.0,
+          max_classes=1)
+fl = open_fleet(tenants, panels, resident=2, **kw)
+tw = open_fleet(tenants, panels, **kw)
+n_paged = 0
+for rnd in range(2):
+    for i in range(4):
+        name, rows = f"t{i}", streams[i][2 * rnd:2 * rnd + 2]
+        paged = fl.tier(name) != "hot"
+        fl.submit(name, rows); tw.submit(name, rows)
+        a, b = fl.drain()[name][0], tw.drain()[name][0]
+        assert np.array_equal(a.nowcast, b.nowcast) \
+            and np.array_equal(a.forecasts["y"], b.forecasts["y"]), \
+            f"{name} diverged from all-hot twin after paging"
+        n_paged += paged
+assert n_paged > 0, "tiering leg never paged a tenant in"
+
+# Cold tier round-trip: spill one tenant to disk, thaw + re-admit, and
+# the next answer still matches the never-evicted twin bit-exactly.
+with tempfile.NamedTemporaryFile(suffix=".npz") as f:
+    fl.evict("t0", tier="cold", path=f.name)
+    assert fl.tier("t0") == "cold"
+    fl.submit("t0", None); tw.submit("t0", None)
+    a, b = fl.drain()["t0"][0], tw.drain()["t0"][0]
+    assert np.array_equal(a.nowcast, b.nowcast), \
+        "cold spill/thaw round-trip diverged"
+fl.close(); tw.close()
+print(f"tiering: 4 tenants on 2 lanes, {n_paged} re-admissions + one "
+      "cold round-trip, all bit-exact vs the all-hot twin")
+PY
+
+echo "--- stream smoke gate ($TRACE) ---"
+python -m dfm_tpu.obs.report "$TRACE"
+python -m dfm_tpu.obs.report "$TRACE" --json | python -c '
+import json, sys
+s = json.load(sys.stdin)
+q = s.get("queries") or {}
+n = q.get("n_queries", 0)
+ev = q.get("rows_evicted", 0)
+bt = s.get("blocking_transfers", 99)
+rc = q.get("recompiles_after_warmup", 99)
+assert n == 31, f"stream smoke FAILED: expected 31 query events, got {n}"
+assert ev == 62, f"stream smoke FAILED: expected 62 evicted rows, got {ev}"
+assert rc == 0, f"stream smoke FAILED: {rc} recompiles after warmup"
+assert bt <= n, f"stream smoke FAILED: {bt} blocking transfers for {n} queries"
+print(f"stream smoke OK: {n} queries evicted {ev} rows in-graph, "
+      f"{bt} blocking transfer(s) (<= 1/query), 0 recompiles after warmup")'
