@@ -10,7 +10,8 @@ they all duplicate; the contracts themselves are unchanged.
 import sys
 import time
 
-__all__ = ["log", "pct", "timed", "parse_mix", "record_run"]
+__all__ = ["log", "pct", "timed", "parse_mix", "record_run",
+           "engine_sweep_point"]
 
 
 def log(*a):
@@ -49,6 +50,57 @@ def parse_mix(spec):
         N, T, k = (int(x) for x in part.split(","))
         shapes.extend([(N, T, k)] * mult)
     return shapes
+
+
+def engine_sweep_point(model, N, T, k, *, backends, iters, reps, seed,
+                       baseline):
+    """One engine-comparison sweep point (shared by bench.longt /
+    bench.kscale — the sweep-loop scaffolding they would otherwise each
+    copy).
+
+    Builds the panel (DGP -> standardize -> PCA init), fits an f64
+    sequential-info reference at the same budget, then for each entry of
+    ``backends`` (name -> zero-arg TPUBackend factory) fits once for the
+    f32 final-loglik error and times the warm chunked fit best-of-``reps``
+    (the fit's own d2h read is the execution barrier — CLAUDE.md).
+
+    Returns {"walls", "errs" (relative final-loglik error vs the f64
+    reference), "speedup" (wall of ``baseline`` over each engine),
+    "ll_ref", "panel": (Y standardized, Y raw, F true factors, p_true,
+    p0)} so callers can run engine-specific extra legs (noise ratios,
+    calibration) without rebuilding the panel.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dfm_tpu import TPUBackend, fit
+    from dfm_tpu.backends import cpu_ref
+    from dfm_tpu.utils import dgp
+
+    rng = np.random.default_rng(seed)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y_raw, F = dgp.simulate(p_true, T, rng)
+    Y = (Y_raw - Y_raw.mean(0)) / Y_raw.std(0)
+    p0 = cpu_ref.pca_init(Y, k)
+
+    # f64 sequential reference loglik at the same budget: the yardstick
+    # every f32 engine's final-loglik error divides against.
+    ref = fit(model, Y, max_iters=iters, tol=0.0, init=p0,
+              backend=TPUBackend(dtype=jnp.float64, filter="info"))
+    ll_ref = float(ref.logliks[-1])
+
+    walls, errs = {}, {}
+    for name, make in backends.items():
+        b = make()
+        r = fit(model, Y, max_iters=iters, tol=0.0, init=p0, backend=b)
+        errs[name] = abs(float(r.logliks[-1]) - ll_ref) / abs(ll_ref)
+        walls[name] = timed(
+            lambda b=b: fit(model, Y, max_iters=iters, tol=0.0,
+                            init=p0, backend=b), reps)
+    speedup = {name: walls[baseline] / walls[name] for name in walls}
+    return {"walls": walls, "errs": errs, "speedup": speedup,
+            "ll_ref": ll_ref, "panel": (Y, Y_raw, F, p_true, p0)}
 
 
 def record_run(payload, dev, kind):

@@ -29,9 +29,7 @@ DFM_BENCH_ITERS (EM budget per fit, default 16), DFM_BENCH_REPS
 import json
 import os
 
-import numpy as np
-
-from bench._common import log, record_run, timed
+from bench._common import engine_sweep_point, log, record_run
 
 
 def main():
@@ -46,9 +44,7 @@ def main():
     jax.config.update("jax_enable_x64", True)  # f64 reference fits
     import jax.numpy as jnp
 
-    from dfm_tpu import DynamicFactorModel, TPUBackend, fit
-    from dfm_tpu.backends import cpu_ref
-    from dfm_tpu.utils import dgp
+    from dfm_tpu import DynamicFactorModel, TPUBackend
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind}); N={N} k={k} "
@@ -60,29 +56,13 @@ def main():
     results = []
     with jax.default_matmul_precision("highest"):
         for T in sweep:
-            rng = np.random.default_rng(1000 + T)
-            p_true = dgp.dfm_params(N, k, rng)
-            Y, _ = dgp.simulate(p_true, T, rng)
-            Y = (Y - Y.mean(0)) / Y.std(0)
-            p0 = cpu_ref.pca_init(Y, k)
-
-            # f64 sequential reference loglik at the same budget: the
-            # yardstick both f32 engines' final-loglik errors divide
-            # against.
-            ref = fit(model, Y, max_iters=iters, tol=0.0, init=p0,
-                      backend=TPUBackend(dtype=jnp.float64, filter="info"))
-            ll_ref = float(ref.logliks[-1])
-
-            walls, errs = {}, {}
-            for eng in engines:
-                b = TPUBackend(dtype=jnp.float32, filter=eng)
-                r = fit(model, Y, max_iters=iters, tol=0.0, init=p0,
-                        backend=b)
-                errs[eng] = abs(float(r.logliks[-1]) - ll_ref) / abs(ll_ref)
-                walls[eng] = timed(
-                    lambda b=b: fit(model, Y, max_iters=iters, tol=0.0,
-                                    init=p0, backend=b), reps)
-            spd = {e: walls["info"] / walls[e] for e in engines}
+            res = engine_sweep_point(
+                model, N, T, k,
+                backends={e: (lambda e=e: TPUBackend(dtype=jnp.float32,
+                                                     filter=e))
+                          for e in engines},
+                iters=iters, reps=reps, seed=1000 + T, baseline="info")
+            walls, errs, spd = res["walls"], res["errs"], res["speedup"]
             log(f"T={T}: seq {1e3 * walls['info']:.1f} ms"
                 + "".join(f", {e} {1e3 * walls[e]:.1f} ms "
                           f"({spd[e]:.2f}x, f32 err {errs[e]:.2e})"

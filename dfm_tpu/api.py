@@ -206,7 +206,12 @@ class TPUBackend(Backend):
     "ss" (steady-state accelerated), "pit" (parallel-in-time,
     covariance-form), "pit_qr" (parallel-in-time on square-root factors —
     thin-QR combines in unrolled VPU form; the long-T engine, ~2*sqrt(T)
-    sequential depth, f32-stable), or "auto": dense below N=32, info from
+    sequential depth, f32-stable), "lowrank" (rank-r computation-aware
+    downdate filter/smoother — only r x r linalg in the time scans,
+    conservative calibrated covariances, exact at ``rank=k``; the wide-k
+    engine, and the one that compiles at the MF m~25 augmented shape
+    where the exact scan SIGABRTs — see ``ssm.lowrank_filter`` and the
+    ``rank`` knob), or "auto": dense below N=32, info from
     there, ss for unmasked panels at N >= 512 (benchmark scale — ~5-30x
     faster in-loop, trajectory contract-checked; masked panels stay on the
     exact info scan).  ``fit(auto=True)`` additionally consults the
@@ -233,11 +238,16 @@ class TPUBackend(Backend):
 
     def __init__(self, dtype=None, filter: str = "auto",
                  matmul_precision: str = "highest", fused_chunk: int = 8,
-                 debug: bool = False, device_init="auto", robust=True):
+                 debug: bool = False, device_init="auto", robust=True,
+                 rank: int = 0):
         self.dtype = dtype
-        if filter not in ("auto", "dense", "info", "ss", "pit", "pit_qr"):
+        if filter not in ("auto", "dense", "info", "ss", "pit", "pit_qr",
+                          "lowrank"):
             raise ValueError(f"unknown filter {filter!r}")
         self.filter = filter
+        # filter="lowrank" only: downdate rank r (<= 0 -> auto, min(k, 8);
+        # see ssm.lowrank_filter.resolve_rank).  Ignored by exact engines.
+        self.rank = int(rank)
         self.matmul_precision = matmul_precision
         self.fused_chunk = max(1, int(fused_chunk))
         # checkify NaN/inf guard around the filter scans (EMConfig.debug):
@@ -397,7 +407,7 @@ class TPUBackend(Backend):
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
                        estimate_init=model.estimate_init,
-                       filter=flt, debug=self.debug)
+                       filter=flt, debug=self.debug, rank=self.rank)
         if flt == "ss":
             # tau from the measured covariance-recursion mixing time at the
             # init params (k x k on host, microseconds) — the same choice
@@ -503,7 +513,7 @@ class TPUBackend(Backend):
         cfg = EMConfig(estimate_A=model.estimate_A,
                        estimate_Q=model.estimate_Q,
                        estimate_init=model.estimate_init,
-                       filter=flt, debug=False)
+                       filter=flt, debug=False, rank=self.rank)
         if flt == "ss":
             from .ssm.steady import auto_tau
             cfg = dataclasses.replace(cfg, tau=auto_tau(p0))
@@ -698,7 +708,7 @@ class TPUBackend(Backend):
         # sequential info form here.
         ff = {"dense": kalman_filter, "info": info_filter,
               "ss": info_filter, "pit": info_filter,
-              "pit_qr": info_filter}[
+              "pit_qr": info_filter, "lowrank": info_filter}[
                   self._filter_for(Y.shape[1])]
         pj = JaxParams.from_numpy(params, dtype=dt)
         tr = current_tracer()
@@ -1460,11 +1470,13 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             if chunk and getattr(b, "fused_chunk", chunk) != chunk:
                 restore_chunk = (b.fused_chunk,)
                 b.fused_chunk = chunk
-            # Time-scan engine choice (seq vs pit_qr): applied transiently
-            # and only when the backend's own knob is "auto" — an explicit
-            # filter= on the backend always wins.  The override resolves to
-            # the SAME EMConfig an explicit TPUBackend(filter="pit_qr")
-            # would build, so the result is bit-identical to that knob.
+            # Time-scan engine choice (seq vs pit_qr vs lowrank): applied
+            # transiently and only when the backend's own knob is "auto" —
+            # an explicit filter= on the backend always wins.  The override
+            # resolves to the SAME EMConfig an explicit
+            # TPUBackend(filter="pit_qr") / TPUBackend(filter="lowrank")
+            # (default rank — plans carry no rank key) would build, so the
+            # result is bit-identical to that knob.
             plan_flt = auto_plan.get("filter")
             if (plan_flt and plan_flt != "seq"
                     and getattr(b, "filter", None) == "auto"):

@@ -238,6 +238,178 @@ def kalman_filter_info(Y: np.ndarray, p: SSMParams,
     return KalmanResult(x_pred, P_pred, x_filt, P_filt, loglik)
 
 
+def resolve_rank(k: int, rank: int = 0) -> int:
+    """Shared rank convention for the low-rank engines (mirrored by
+    ``ssm.lowrank_filter``): ``rank<=0`` means auto — min(k, 8), the
+    largest rank whose triangular work stays in unrolled VPU form
+    (``ops.linalg.UNROLL_K_MAX``); explicit ranks clamp to [1, k]."""
+    r = int(rank)
+    if r <= 0:
+        r = min(k, 8)
+    return max(1, min(r, k))
+
+
+def _lowrank_basis(Lam: np.ndarray, R: np.ndarray, r: int) -> np.ndarray:
+    """Rank-r action basis: top-r eigenvectors of the model's static
+    observation information C = Lam' R^{-1} Lam — the directions the data
+    is most informative about, per the computation-aware policy of arXiv
+    2405.08971.  Every downstream formula is a V...V' sandwich, so the
+    eigh sign/permutation ambiguity is inert, and ANY full-rank V at r=k
+    reproduces the exact filter."""
+    C = _sym((Lam * (1.0 / R)[:, None]).T @ Lam)
+    _, vecs = np.linalg.eigh(C)           # ascending eigenvalues
+    return vecs[:, ::-1][:, :r]
+
+
+def _chol_solve_np(L: np.ndarray, B: np.ndarray) -> np.ndarray:
+    return np.linalg.solve(L.T, np.linalg.solve(L, B))
+
+
+def kalman_filter_lowrank(Y: np.ndarray, p: SSMParams,
+                          mask: Optional[np.ndarray] = None,
+                          rank: int = 0) -> KalmanResult:
+    """Rank-r computation-aware filter (arXiv 2405.08971, downdate form).
+
+    Projects the information-form update onto r fixed observation-space
+    actions Z = R^{-1} Lam V (V from ``_lowrank_basis``), which reduces
+    entirely to k-space: the update conditions EXACTLY on the r projected
+    observations V'(Lam' R^{-1} y), so P_filt is the true posterior
+    covariance of that coarsened problem — PSD by construction and
+    CONSERVATIVE (P_filt^lowrank >= P_filt^exact in the PSD order), which
+    is what keeps the uncertainty bands calibrated rather than
+    overconfident.  At r=k the update is algebraically the exact
+    information filter (gain P C (I + PC)^{-1}... identities).  Per-step
+    cost: no k-sized factorization — one r x r Cholesky plus k x r
+    matmuls (+ the 2 k^3 predict matmuls).
+
+    The golden f64 oracle for ``dfm_tpu.ssm.lowrank_filter``.
+    """
+    Y = np.asarray(Y, dtype=np.float64)
+    T, N = Y.shape
+    k = p.n_factors
+    Lam, A, Q, R = (np.asarray(p.Lam, np.float64), np.asarray(p.A, np.float64),
+                    np.asarray(p.Q, np.float64), np.asarray(p.R, np.float64))
+    r = resolve_rank(k, rank)
+    V = _lowrank_basis(Lam, R, r)
+    Rinv = 1.0 / R
+    logR = np.log(R)
+    G = Lam * Rinv[:, None]
+    eps = 1e-10
+    I_r = np.eye(r)
+    if mask is None:
+        B = Y @ G
+        C_static = Lam.T @ G
+        J_static = C_static @ V                    # (k, r)
+        Gam_static = _sym(V.T @ J_static) + eps * I_r
+        Lgam_static = np.linalg.cholesky(Gam_static)
+        n_t_all = np.full(T, float(N))
+        ldR_all = np.full(T, logR.sum())
+    else:
+        W = np.asarray(mask, dtype=np.float64)
+        Yz = np.where(W > 0, np.nan_to_num(Y), 0.0)
+        Y = Yz
+        B = Yz @ G
+        n_t_all = W.sum(axis=1)
+        ldR_all = W @ logR
+
+    x_pred = np.zeros((T, k))
+    P_pred = np.zeros((T, k, k))
+    x_filt = np.zeros((T, k))
+    P_filt = np.zeros((T, k, k))
+    logdetG = np.zeros(T)
+    corr = np.zeros(T)
+    x, P = np.asarray(p.mu0, np.float64), np.asarray(p.P0, np.float64)
+    for t in range(T):
+        if t > 0:
+            x = A @ x_filt[t - 1]
+            P = _sym(A @ P_filt[t - 1] @ A.T + Q)
+        x_pred[t] = x
+        P_pred[t] = P
+        if mask is None:
+            C, J, Gam, Lgam = C_static, J_static, Gam_static, Lgam_static
+        else:
+            C = (Lam * (W[t] * Rinv)[:, None]).T @ Lam
+            J = C @ V
+            Gam = _sym(V.T @ J) + eps * I_r
+            Lgam = np.linalg.cholesky(Gam)
+        PJ = P @ J                                  # (k, r)
+        # S = Z' (Lam P Lam' + R) Z pushed to k-space: J'PJ + V'CV.  The
+        # SAME eps rides both S and Gam, so a fully-masked step (C = 0)
+        # gives logdetG = 0 and an inert update exactly.
+        S = _sym(J.T @ PJ) + Gam
+        Ls = np.linalg.cholesky(S)
+        u = B[t] - C @ x
+        z = V.T @ u
+        alpha = _chol_solve_np(Ls, z)
+        x = x + PJ @ alpha
+        P = _sym(P - PJ @ _chol_solve_np(Ls, PJ.T))   # rank-r downdate
+        x_filt[t] = x
+        P_filt[t] = P
+        logdetG[t] = 2.0 * (np.sum(np.log(np.diag(Ls)))
+                            - np.sum(np.log(np.diag(Lgam))))
+        # Consistent quad correction z'(Gam^{-1} - S^{-1})z — the
+        # quadratic of the SAME approximating Gaussian the determinant
+        # above belongs to (see ssm.lowrank_filter's module docstring);
+        # >= 0 always, exactly the full Woodbury term at r = k.
+        corr[t] = float(z @ _chol_solve_np(Lgam, z) - z @ alpha)
+    # Residual-pass quadratic — identical assembly to the info filter;
+    # with the subspace correction this is the exact log-likelihood of
+    # the rank-r approximating predictive model (and the exact data
+    # log-likelihood at r=k).
+    Vres = Y - x_pred @ Lam.T
+    if mask is not None:
+        Vres = W * Vres
+    VR = Vres * Rinv[None, :]
+    quad_R = np.einsum("tn,tn->t", Vres, VR)
+    quad = quad_R - corr
+    log2pi = np.log(2.0 * np.pi)
+    loglik = float(np.sum(-0.5 * (n_t_all * log2pi + ldR_all + logdetG
+                                  + quad)))
+    return KalmanResult(x_pred, P_pred, x_filt, P_filt, loglik)
+
+
+def rts_smoother_lowrank(kf: KalmanResult, p: SSMParams,
+                         rank: int = 0) -> SmootherResult:
+    """Rank-r RTS smoother: the backward gain's P_pred^{-1} is replaced by
+    its projection V (V' P_pred V)^{-1} V' onto the same rank-r action
+    basis as the filter, so each backward step is one r x r Cholesky plus
+    k x r matmuls instead of a k x k solve.  Exact at r=k (V orthonormal:
+    V Sigma^{-1} V' = P_pred^{-1}).  Lag-one covariances follow the same
+    factored identity P_lag[t] = (P_sm[t] V) Sigma^{-1} G1' used by the
+    exact smoother's P_sm[t] J[t-1]'.
+    """
+    T, k = kf.x_filt.shape
+    A = np.asarray(p.A, np.float64)
+    Lam = np.asarray(p.Lam, np.float64)
+    R = np.asarray(p.R, np.float64)
+    r = resolve_rank(k, rank)
+    V = _lowrank_basis(Lam, R, r)
+    AV = A.T @ V                                   # (k, r)
+    eps = 1e-10
+    I_r = np.eye(r)
+    x_sm = np.zeros((T, k))
+    P_sm = np.zeros((T, k, k))
+    P_lag = np.zeros((T, k, k))
+    G1 = np.zeros((T, k, r))                       # defined for t < T-1
+    Lsig = np.zeros((T, r, r))
+
+    x_sm[-1] = kf.x_filt[-1]
+    P_sm[-1] = kf.P_filt[-1]
+    for t in range(T - 2, -1, -1):
+        Pp = kf.P_pred[t + 1]
+        Lsig[t] = np.linalg.cholesky(_sym(V.T @ Pp @ V) + eps * I_r)
+        G1[t] = kf.P_filt[t] @ AV
+        a = _chol_solve_np(Lsig[t], V.T @ (x_sm[t + 1] - kf.x_pred[t + 1]))
+        x_sm[t] = kf.x_filt[t] + G1[t] @ a
+        E = V.T @ (P_sm[t + 1] - Pp) @ V
+        S = _chol_solve_np(Lsig[t], _chol_solve_np(Lsig[t], E).T).T
+        P_sm[t] = _sym(kf.P_filt[t] + G1[t] @ _sym(S) @ G1[t].T)
+    for t in range(1, T):
+        PV = P_sm[t] @ V
+        P_lag[t] = _chol_solve_np(Lsig[t - 1], PV.T).T @ G1[t - 1].T
+    return SmootherResult(x_sm, P_sm, P_lag)
+
+
 def rts_smoother(kf: KalmanResult, p: SSMParams) -> SmootherResult:
     """Rauch-Tung-Striebel backward smoother with lag-one covariances.
 
@@ -301,7 +473,7 @@ def em_step(Y: np.ndarray, p: SSMParams,
             estimate_Q: bool = True,
             estimate_init: bool = False,
             r_floor: float = 1e-6,
-            filter: str = "dense"):
+            filter: str = "dense", rank: int = 0):
     """One EM iteration: E-step (filter+smoother) then closed-form M-step.
 
     Returns (new_params, loglik_of_old_params, smoother_result).
@@ -318,9 +490,13 @@ def em_step(Y: np.ndarray, p: SSMParams,
     """
     Y = np.asarray(Y, dtype=np.float64)
     T, N = Y.shape
-    ff = {"dense": kalman_filter, "info": kalman_filter_info}[filter]
-    kf = ff(Y, p, mask=mask)
-    sm = rts_smoother(kf, p)
+    if filter == "lowrank":
+        kf = kalman_filter_lowrank(Y, p, mask=mask, rank=rank)
+        sm = rts_smoother_lowrank(kf, p, rank=rank)
+    else:
+        ff = {"dense": kalman_filter, "info": kalman_filter_info}[filter]
+        kf = ff(Y, p, mask=mask)
+        sm = rts_smoother(kf, p)
     mom = smoothed_moments(sm)
     Ef, EffT = mom["Ef"], mom["EffT"]
 
@@ -374,7 +550,7 @@ def em_fit(Y: np.ndarray, p0: SSMParams,
            max_iters: int = 50, tol: float = 1e-6,
            estimate_A: bool = True, estimate_Q: bool = True,
            estimate_init: bool = False,
-           callback=None, filter: str = "dense"):
+           callback=None, filter: str = "dense", rank: int = 0):
     """EM driver with relative-loglik convergence (SURVEY.md section 3.1).
 
     Returns (params, logliks, converged) where logliks[i] is the
@@ -387,7 +563,8 @@ def em_fit(Y: np.ndarray, p0: SSMParams,
     for it in range(max_iters):
         p_new, ll, _ = em_step(Y, p, mask=mask, estimate_A=estimate_A,
                                estimate_Q=estimate_Q,
-                               estimate_init=estimate_init, filter=filter)
+                               estimate_init=estimate_init, filter=filter,
+                               rank=rank)
         logliks.append(ll)
         if callback is not None:
             callback(it, ll, p)

@@ -21,6 +21,7 @@ from ..ops.linalg import sym, solve_psd
 from ..pipeline import resolve_pipeline
 from ..ssm.kalman import kalman_filter, rts_smoother
 from ..ssm.info_filter import info_filter
+from ..ssm.lowrank_filter import lowrank_filter, lowrank_smoother
 from ..ssm.parallel_filter import (pit_filter, pit_smoother, pit_qr_filter,
                                    pit_qr_smoother)
 from ..ssm.params import SSMParams, SmootherResult
@@ -42,10 +43,14 @@ class EMConfig:
             ``ssm.parallel_filter``), "pit_qr" (parallel-in-time on
             SQUARE-ROOT factors — combines are thin-QR + triangular solves
             in unrolled VPU form, the long-T engine: ~2*sqrt(T) sequential
-            depth at f32 noise at-or-below the sequential scan's), or "ss"
-            (steady-state accelerated — ~3*tau sequential covariance steps
-            + blocked affine mean scans, see ``ssm.steady``; falls back to
-            exact when masked/short).
+            depth at f32 noise at-or-below the sequential scan's),
+            "lowrank" (rank-r computation-aware downdate filter/smoother,
+            see ``ssm.lowrank_filter`` — the wide-k engine: only r x r
+            linalg in the scans, conservative calibrated covariances,
+            exact at rank = k; ``rank`` below sets r, <= 0 auto-picks
+            min(k, 8)), or "ss" (steady-state accelerated — ~3*tau
+            sequential covariance steps + blocked affine mean scans, see
+            ``ssm.steady``; falls back to exact when masked/short).
 
     debug: instrument the jitted EM step with ``jax.experimental.checkify``
            float checks (NaN/inf/div-by-zero on every primitive, threaded
@@ -64,12 +69,18 @@ class EMConfig:
     debug: bool = False
     noise_floor_mult: float = 100.0   # headroom for the absolute loglik
                                       # noise floor (see noise_floor_for)
+    rank: int = 0        # filter="lowrank" only: rank r (<= 0 -> auto,
+                         # min(k, 8); see ssm.lowrank_filter.resolve_rank)
 
     def filter_fn(self):
+        if self.filter == "lowrank":
+            return partial(lowrank_filter, rank=self.rank)
         return {"dense": kalman_filter, "info": info_filter,
                 "pit": pit_filter, "pit_qr": pit_qr_filter}[self.filter]
 
     def smoother_fn(self):
+        if self.filter == "lowrank":
+            return partial(lowrank_smoother, rank=self.rank)
         return {"pit": pit_smoother,
                 "pit_qr": pit_qr_smoother}.get(self.filter, rts_smoother)
 

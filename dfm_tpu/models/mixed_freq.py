@@ -58,16 +58,21 @@ class MixedFreqSpec:
     # path), "pit" (parallel-in-time blocked prefix scans, ~2 sqrt(T)
     # sequential depth instead of 2T — the m = L*k augmented scans are the
     # S3 iteration's dominant cost and the mask rules out the steady-state
-    # shortcut), or "pit_qr" (same prefix-scan depth on square-root / QR
+    # shortcut), "pit_qr" (same prefix-scan depth on square-root / QR
     # elements — f32-stable combines; above QR_UNROLL_K_MAX the augmented
-    # state falls back to the generic triangular lowerings).  Exact same
-    # algebra; equivalence tested.
+    # state falls back to the generic triangular lowerings), or "lowrank"
+    # (rank-r computation-aware downdate scans, ``rank`` below — only
+    # r x r linalg touches the m-dim state per step, which keeps the
+    # m ~ 25 augmented program inside what the axon compiler will build
+    # where the exact masked scan SIGABRTs; conservative calibrated
+    # covariances, exact at rank = m).  Same algebra; equivalence tested.
     time_scan: str = "seq"
+    rank: int = 0   # time_scan="lowrank" only; <= 0 -> auto (min(m, 8))
 
     def __post_init__(self):
-        if self.time_scan not in ("seq", "pit", "pit_qr"):
+        if self.time_scan not in ("seq", "pit", "pit_qr", "lowrank"):
             raise ValueError(
-                f"time_scan must be 'seq', 'pit' or 'pit_qr'; "
+                f"time_scan must be 'seq', 'pit', 'pit_qr' or 'lowrank'; "
                 f"got {self.time_scan!r}")
 
     @property
@@ -161,7 +166,14 @@ def mf_em_core(Y, mask, p: MFParams, spec: MixedFreqSpec,
     acc = accum_dtype(dtype, native_only=True)
     aug_acc = aug.astype(acc)
     stats_acc = ObsStats(*(jnp.asarray(s, acc) for s in stats))
-    if spec.time_scan == "pit":
+    lr_corr = None
+    if spec.time_scan == "lowrank":
+        from ..ssm.lowrank_filter import (lowrank_from_stats,
+                                          lowrank_loglik_from_terms,
+                                          lowrank_smoother)
+        xp, Pp, xf, Pf, logdetG, lr_corr = lowrank_from_stats(
+            stats_acc, aug_acc, spec.rank)
+    elif spec.time_scan == "pit":
         from ..ssm.parallel_filter import pit_from_stats, pit_smoother
         xp, Pp, xf, Pf, logdetG = pit_from_stats(stats_acc, aug_acc)
     elif spec.time_scan == "pit_qr":
@@ -172,13 +184,20 @@ def mf_em_core(Y, mask, p: MFParams, spec: MixedFreqSpec,
                                             aug_acc.mu0, aug_acc.P0)
     quad_R, U = reduce_tree(
         loglik_terms_local(Y, aug.Lam, aug.R, xp.astype(dtype), mask))
-    kf = FilterResult(xp, Pp, xf, Pf,
-                      loglik_from_terms(stats_acc, logdetG, Pf,
-                                        quad_R, U.astype(acc)))
+    if lr_corr is not None:
+        # The rank-r scan's consistent quad correction replaces the
+        # u'P_f u plug-in (ssm.lowrank_filter docstring) — the reported
+        # loglik stays a true Gaussian density at any rank.
+        ll = lowrank_loglik_from_terms(stats_acc, logdetG, lr_corr, quad_R)
+    else:
+        ll = loglik_from_terms(stats_acc, logdetG, Pf, quad_R, U.astype(acc))
+    kf = FilterResult(xp, Pp, xf, Pf, ll)
     if spec.time_scan == "pit":
         sm = pit_smoother(kf, aug_acc)
     elif spec.time_scan == "pit_qr":
         sm = pit_qr_smoother(kf, aug_acc)
+    elif spec.time_scan == "lowrank":
+        sm = lowrank_smoother(kf, aug_acc, rank=spec.rank)
     else:
         sm = rts_smoother(kf, aug_acc)
 

@@ -31,7 +31,9 @@ def candidate_plans(chunk: int = 8) -> List[dict]:
     Kept small and structured — each row maps 1:1 onto fit() knobs
     (``fused=``/``pipeline=``/backend ``fused_chunk``/``filter=``);
     ``filter`` is the time-scan engine (``seq`` = sequential scan,
-    ``pit_qr`` = parallel-in-time QR — the long-T log-depth play)."""
+    ``pit_qr`` = parallel-in-time QR — the long-T log-depth play,
+    ``lowrank`` = rank-r computation-aware downdate — the wide-k play:
+    only r x r linalg in the scans)."""
     return [
         {"engine": "fused", "fused_chunk": chunk, "depth": 1,
          "bucket": False, "filter": "seq"},
@@ -49,6 +51,10 @@ def candidate_plans(chunk: int = 8) -> List[dict]:
          "bucket": False, "filter": "pit_qr"},
         {"engine": "fused", "fused_chunk": chunk, "depth": 1,
          "bucket": False, "filter": "pit_qr"},
+        {"engine": "chunked", "fused_chunk": chunk, "depth": 1,
+         "bucket": False, "filter": "lowrank"},
+        {"engine": "fused", "fused_chunk": chunk, "depth": 1,
+         "bucket": False, "filter": "lowrank"},
     ]
 
 
@@ -75,11 +81,31 @@ def advise(N: int, T: int, k: int, *, max_iters: int = 50, chunk: int = 8,
                              depth=cand["depth"], bucket=cand["bucket"],
                              filter=cand.get("filter", "seq"))
         plans.append({**cand, **pred})
-    # Deterministic rank: predicted wall, then the stable knob tuple
-    # (ties prefer the sequential scan — "seq" < "pit_qr" alphabetically
-    # is a happy accident we pin here on purpose: equal predictions keep
-    # the default engine).
-    plans.sort(key=lambda p: (p["predicted_wall_s"], p["engine"],
+    # Evidence gate: an engine-switch plan (pit_qr / lowrank) whose
+    # family has NO measured profiles may never undercut the best
+    # measured plan at this shape — its prediction is pure structural
+    # prior, and acting on it forces a fresh compile of an engine nobody
+    # timed (the one cost the model can't see).  Clamp such plans to the
+    # best anchored wall; the tie-break below then keeps the measured
+    # plan on top.  A profiled family (calibrated scale or any measured
+    # wall) competes on its numbers, anywhere in shape space.
+    anchored = [p["predicted_wall_s"] for p in plans if p.get("anchored")]
+    if anchored:
+        floor = min(anchored)
+        for p in plans:
+            flt = p.get("filter", "seq")
+            if (flt != "seq" and not p.get("anchored")
+                    and not getattr(model, f"{flt}_calibrated", False)
+                    and p["predicted_wall_s"] < floor):
+                p["predicted_wall_s"] = floor
+                p["evidence_clamped"] = True
+    # Deterministic rank: predicted wall, then the stable knob tuple.
+    # Ties prefer the sequential scan FIRST (equal predictions keep the
+    # default engine — and a clamped engine-switch plan tied at the
+    # anchored floor must lose to the measured plan), then the engine.
+    plans.sort(key=lambda p: (p["predicted_wall_s"],
+                              p.get("filter", "seq") != "seq",
+                              p["engine"],
                               p.get("filter", "seq"), p["depth"],
                               p["fused_chunk"], p["bucket"]))
     for i, p in enumerate(plans):

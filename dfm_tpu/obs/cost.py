@@ -168,6 +168,13 @@ DEFAULT_COEFFS: Dict[str, Dict[str, float]] = {
 # a structural prior — profiles anchor the real number per shape.
 PIT_QR_FLOP_MULT = 4.0
 
+# The rank-r computation-aware engine keeps the O(T) depth but strips the
+# k x k linalg out of the scan body (only r x r factorizations + plain
+# matmuls remain), cutting per-iteration flops by roughly half at the
+# profiled shapes.  A structural prior like PIT_QR_FLOP_MULT — measured
+# "lowrank" profiles carry the real residual via ``lowrank_scale``.
+LOWRANK_FLOP_MULT = 0.5
+
 
 def _norm_plan(engine: str, chunk, depth, bucket, filt=None) -> Tuple:
     return (str(engine), int(chunk or 8), int(depth or 1), bool(bucket),
@@ -190,11 +197,12 @@ def _profile_plan(config: dict) -> Optional[Tuple]:
     flt = config.get("filter")
     if variant == "fused":
         return _norm_plan("fused", config.get("chunk"), 1, False, flt)
-    if variant in ("chunked", "pipelined", "pit_qr"):
+    if variant in ("chunked", "pipelined", "pit_qr", "lowrank"):
         depth = config.get("depth") or (2 if variant == "pipelined" else 1)
         return _norm_plan("chunked", config.get("chunk"), depth,
                           config.get("bucket"),
-                          "pit_qr" if variant == "pit_qr" else flt)
+                          variant if variant in ("pit_qr", "lowrank")
+                          else flt)
     return None
 
 
@@ -209,6 +217,10 @@ def _iter_features(T: float, flops: float, bytes_: float,
     if filt == "pit_qr":
         return (2.0 * math.sqrt(max(T, 1.0)), PIT_QR_FLOP_MULT * flops,
                 PIT_QR_FLOP_MULT * bytes_)
+    if filt == "lowrank":
+        # Same T-step depth; the scan body sheds its k x k linalg.
+        return (float(T), LOWRANK_FLOP_MULT * flops,
+                LOWRANK_FLOP_MULT * bytes_)
     return (float(T), float(flops), float(bytes_))
 
 
@@ -238,6 +250,16 @@ class CostModel:
     # pit_qr profiles so an UNmeasured pit_qr plan never undercuts the
     # family's own measurements at other knobs.
     pit_qr_scale: float = 1.0
+    # Same construction for the rank-r downdate family: LOWRANK_FLOP_MULT
+    # is the structural prior, measured "lowrank" profiles correct it.
+    lowrank_scale: float = 1.0
+    # Whether the residual scales above come from measured family
+    # profiles (vs the un-corrected structural prior).  The advisor uses
+    # these to keep an UNmeasured engine-switch plan from undercutting
+    # measured plans on raw-prior optimism — picking an engine nobody
+    # profiled forces a fresh compile, the one cost the model can't see.
+    pit_qr_calibrated: bool = False
+    lowrank_calibrated: bool = False
     anchors: List[dict] = dataclasses.field(default_factory=list)
 
     def iter_s(self, N: int, T: int, k: int, filt: str = "seq") -> float:
@@ -245,7 +267,11 @@ class CostModel:
         steps, flops, bytes_ = _iter_features(T, flops, bytes_, filt)
         it = (self.step_s * steps + self.per_flop_s * flops
               + self.per_byte_s * bytes_)
-        return it * self.pit_qr_scale if filt == "pit_qr" else it
+        if filt == "pit_qr":
+            return it * self.pit_qr_scale
+        if filt == "lowrank":
+            return it * self.lowrank_scale
+        return it
 
     def dispatches(self, iters: int, *, engine: str, chunk: int = 8,
                    depth: int = 1) -> int:
@@ -352,7 +378,8 @@ def fit_cost_model(profiles: Iterable[dict],
             flops = float(m["flops_per_iter"])
         if isinstance(m.get("bytes_per_iter"), (int, float)):
             bytes_ = float(m["bytes_per_iter"])
-        flt = ("pit_qr" if c.get("profile") == "pit_qr"
+        prof = c.get("profile")
+        flt = (prof if prof in ("pit_qr", "lowrank")
                else c.get("filter") or "seq")
         obs.append((_iter_features(T, flops, bytes_, flt),
                     float(it_ms) / 1e3, (N, T, k, flt)))
@@ -389,13 +416,20 @@ def fit_cost_model(profiles: Iterable[dict],
             coeffs = [prior["step_s"] * scale, prior["per_flop_s"] * scale,
                       prior["per_byte_s"] * scale]
         model.step_s, model.per_flop_s, model.per_byte_s = coeffs
+
+        def model_it(f):
+            return (model.step_s * f[0] + model.per_flop_s * f[1]
+                    + model.per_byte_s * f[2])
         pit_obs = [(f, y) for f, y, s in obs if s[3] == "pit_qr"]
         if pit_obs:
-            def model_it(f):
-                return (model.step_s * f[0] + model.per_flop_s * f[1]
-                        + model.per_byte_s * f[2])
             model.pit_qr_scale = median(
                 [y / max(model_it(f), 1e-30) for f, y in pit_obs])
+            model.pit_qr_calibrated = True
+        lowrank_obs = [(f, y) for f, y, s in obs if s[3] == "lowrank"]
+        if lowrank_obs:
+            model.lowrank_scale = median(
+                [y / max(model_it(f), 1e-30) for f, y in lowrank_obs])
+            model.lowrank_calibrated = True
 
     # Anchors + fixed overhead residual.
     overheads = []
@@ -414,6 +448,12 @@ def fit_cost_model(profiles: Iterable[dict],
                               "iters": iters,
                               "warm_wall_s": float(warm)})
         engine, chunk, depth, _, flt = plan
+        # A measured wall at any knob of an engine-switch family is
+        # evidence the family was profiled (even without iter metrics).
+        if flt == "pit_qr":
+            model.pit_qr_calibrated = True
+        elif flt == "lowrank":
+            model.lowrank_calibrated = True
         nd = model.dispatches(iters, engine=engine, chunk=chunk, depth=depth)
         ov = (float(warm) - nd * model.dispatch_floor_s
               - iters * model.iter_s(N, T, k, flt))

@@ -3,9 +3,10 @@ cost model (``python -m dfm_tpu.obs.profile --shape N,T,K``).
 
 Measures what the static ``program_cost`` numbers cannot — the REALIZED
 wall of each fit variant (chunked, pipelined, fused, pit_qr — the
-chunked driver under the parallel-in-time QR filter) at a concrete
-shape, split into the components the calibrated cost model
-(``obs.cost``) fits:
+chunked driver under the parallel-in-time QR filter — and lowrank, the
+same driver under the rank-r downdate filter) at a concrete shape,
+split into the components the calibrated cost model (``obs.cost``)
+fits:
 
 - warm/cold walls: cold pass compiles, warm passes are a best-of-N
   median of already-compiled fits (every wall is bounded by the fit's
@@ -40,7 +41,7 @@ __all__ = ["profile_record", "profile_shape", "main", "PROFILE_KIND",
            "VARIANTS"]
 
 PROFILE_KIND = "profile"
-VARIANTS = ("chunked", "pipelined", "fused", "pit_qr")
+VARIANTS = ("chunked", "pipelined", "fused", "pit_qr", "lowrank")
 
 
 def profile_record(variant: str, N: int, T: int, k: int, *, iters: int,
@@ -129,10 +130,12 @@ def profile_shape(N: int, T: int, k: int, *, iters: int = 24,
                 raise ValueError(f"unknown profile variant {variant!r} "
                                  f"(want one of {VARIANTS})")
             say(f"profile {variant} N={N} T={T} k={k} iters={iters} ...")
-            # pit_qr = the chunked driver with the parallel-in-time QR
-            # time scan; everything else (timing, tracing) is identical.
-            b = (TPUBackend(fused_chunk=chunk, filter="pit_qr")
-                 if variant == "pit_qr" else TPUBackend(fused_chunk=chunk))
+            # pit_qr / lowrank = the chunked driver under the respective
+            # time-scan engine; everything else (timing, tracing) is
+            # identical.
+            b = (TPUBackend(fused_chunk=chunk, filter=variant)
+                 if variant in ("pit_qr", "lowrank")
+                 else TPUBackend(fused_chunk=chunk))
             kw = ({"fused": True} if variant == "fused"
                   else {"pipeline": 2} if variant == "pipelined" else {})
             cold = timed(b, iters, **kw)

@@ -761,7 +761,7 @@ def _print_text(s: dict) -> None:
         pred, real = a.get("predicted_wall_s"), a.get("realized_wall_s")
         eng = a.get("engine", "?")
         if a.get("filter") not in (None, "seq"):
-            eng += f"+{a['filter']}"   # time-scan engine (e.g. pit_qr)
+            eng += f"+{a['filter']}"   # filter engine (pit_qr, lowrank)
         line = f"advice: {eng} plan"
         if a.get("engine") == "fused" and a.get("fused_chunk") is not None:
             line += f" (fused_chunk={a['fused_chunk']})"

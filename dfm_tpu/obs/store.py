@@ -4,8 +4,9 @@ An append-only JSONL registry of benchmark / fit runs so perf history
 survives the process: every ``bench.py`` / ``bench/all.py`` /
 ``bench/batched.py`` invocation appends a :class:`RunRecord` dict, and a
 traced ``fit()`` appends one when ``DFM_RUNS`` is explicitly set.  The
-``backfill`` importer seeds the registry from the checked-in
-``BENCH_r*.json`` + ``BENCH_ALL.json`` so history starts populated.
+``backfill`` importer seeds the registry from every checked-in
+``BENCH_*.json`` (per-file kind inference) + ``BENCH_ALL.json`` so
+history starts populated.
 ``obs.regress`` diffs a run against this history.
 
 Resolution of the registry directory (``runs_dir``):
@@ -43,7 +44,7 @@ RUNS_FILE = "runs.jsonl"
 # higher-is-better; walls / per-program costs are lower-is-better.
 _LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
                          "compile_s", "dispatch_s", "transfer_s", "host_s",
-                         "rel_err", "blocking_transfers",
+                         "rel_err", "calib_err", "blocking_transfers",
                          "dispatches_per_fit", "pad_waste", "degraded",
                          "slo_burn_rate", "flight_dumps", "noise_ratio",
                          "evictions_per")
@@ -64,6 +65,10 @@ _NOISE_FLOORS = (
     # moves it by several points), not an accuracy contract.
     ("advice_rel_err", 0.10),
     ("rel_err", 1e-6),     # accuracy drift toward the 1e-5 contract bound
+    # Posterior-band coverage error (bench.kscale): an empirical frequency
+    # over T*k indicator draws — sampling noise alone moves it by a couple
+    # of points between DGP seeds, with no numerics-level signal.
+    ("calib_err", 0.02),
     # pad_waste must match BEFORE the "_s" row ("pad_waste_frac" is a
     # fraction, not seconds): the planner's DP is deterministic, but the
     # job mix itself varies with bench env knobs — a 2-point move is noise.
@@ -298,6 +303,13 @@ _BENCH_NUMERIC_KEYS = (
     "stream_qps", "stream_p50_ms", "stream_p99_ms",
     "evictions_per_query", "readmission_ms",
     "stream_blocking_transfers_per_query",
+    # Wide-k state-axis sweep (bench.kscale): rank-r lowrank speedup vs
+    # the exact info scan per sweep point (higher-is-better; k=50 is the
+    # headline contract), the 90%-band coverage error of the rank-r
+    # smoother ("calib_err" marker/floor rows above), and the wall of the
+    # MF m~25 fit the exact path cannot compile on axon ("_s" floor).
+    "kscale_speedup_k10", "kscale_speedup_k25", "kscale_speedup_k50",
+    "kscale_speedup_k100", "kscale_calib_err", "kscale_mf_m25_wall_s",
 )
 
 
@@ -354,20 +366,36 @@ def record_from_bench_all_entry(name: str, res: Dict[str, Any], *,
                        t_unix=t_unix, root=root)
 
 
+def _backfill_kind(src: str) -> str:
+    """RunRecord kind for a ``BENCH_*.json`` artifact, inferred from its
+    filename: per-bench artifacts (``BENCH_stream.json``,
+    ``BENCH_longt2.json``, ...) map to their bench family's kind so
+    ``obs.regress`` compares them against live runs of the same CLI;
+    everything else (round artifacts ``BENCH_r5.json`` etc.) is the
+    headline ``bench.py`` format."""
+    stem = src[len("BENCH_"):].split(".")[0].rstrip("0123456789_")
+    family = {"stream": "bench_stream", "longt": "bench_longt",
+              "kscale": "bench_kscale", "serve": "bench_serve",
+              "mixed": "bench_mixed", "fleet": "bench_fleet"}
+    return family.get(stem, "bench")
+
+
 def backfill(root: str = ".", store: Optional[RunStore] = None,
              runs: Optional[str] = None) -> int:
-    """Import ``BENCH_r*.json`` + ``BENCH_stream*.json`` +
-    ``BENCH_ALL.json`` under ``root`` into the registry.  Idempotent:
-    records whose ``source`` is already present are skipped.  Returns the
-    number of records appended."""
+    """Import every ``BENCH_*.json`` under ``root`` (kind inferred per
+    file — see ``_backfill_kind``; ``BENCH_ALL.json`` keeps its own
+    per-config format) into the registry.  Idempotent: records whose
+    ``source`` is already present are skipped.  Returns the number of
+    records appended."""
     store = store or RunStore(runs or runs_dir() or DEFAULT_DIR)
     existing = store.sources()
     n = 0
-    # Round artifacts plus per-bench artifacts that share their format
-    # (e.g. BENCH_stream.json from bench.stream — ISSUE 14).
-    paths = sorted(
-        set(glob.glob(os.path.join(root, "BENCH_r*.json")))
-        | set(glob.glob(os.path.join(root, "BENCH_stream*.json"))))
+    # Round artifacts plus any per-bench artifact that shares the
+    # one-JSON-line-in-"parsed" format (BENCH_stream.json, BENCH_longt.json,
+    # BENCH_kscale.json, ...); BENCH_ALL.json is a different shape and is
+    # handled below.
+    paths = sorted(set(glob.glob(os.path.join(root, "BENCH_*.json")))
+                   - {os.path.join(root, "BENCH_ALL.json")})
     for path in paths:
         src = os.path.basename(path)
         if src in existing:
@@ -381,12 +409,10 @@ def backfill(root: str = ".", store: Optional[RunStore] = None,
         parsed = data.get("parsed") or {}
         if _num(parsed.get("value")) is None:
             continue
-        kind = ("bench_stream" if src.startswith("BENCH_stream")
-                else "bench")
         rec = record_from_bench_json(
             parsed, device=_device_from_tail(data.get("tail", "")),
             source=src, t_unix=os.path.getmtime(path), root=root,
-            kind=kind)
+            kind=_backfill_kind(src))
         store.append(rec)
         n += 1
     path = os.path.join(root, "BENCH_ALL.json")
@@ -422,7 +448,8 @@ def main(argv=None) -> int:
     sub = ap.add_subparsers(dest="cmd", required=True)
     bf = sub.add_parser(
         "backfill",
-        help="import BENCH_r*.json + BENCH_stream*.json + BENCH_ALL.json")
+        help="import every BENCH_*.json (kind inferred per file) "
+             "+ BENCH_ALL.json")
     bf.add_argument("--root", default=".")
     bf.add_argument("--runs", default=None)
     ls = sub.add_parser("list", help="list recorded runs")

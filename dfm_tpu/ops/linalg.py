@@ -13,7 +13,8 @@ from jax.scipy.linalg import solve_triangular
 
 __all__ = ["sym", "psd_cholesky", "chol_solve", "chol_logdet",
            "solve_psd", "default_jitter", "chol_unrolled",
-           "chol_solve_unrolled", "matmul_vpu", "matvec_vpu",
+           "chol_solve_unrolled", "chol_small", "chol_solve_small",
+           "matmul_vpu", "matvec_vpu",
            "UNROLL_K_MAX", "QR_UNROLL_K_MAX", "tria_unrolled", "tria",
            "tri_solve_unrolled", "tri_solve", "psd_factor_unrolled",
            "psd_factor"]
@@ -150,6 +151,36 @@ def chol_solve_unrolled(L: jax.Array, B: jax.Array) -> jax.Array:
         cols.append(jnp.stack(x, axis=-1))
     X = jnp.stack(cols, axis=-1)
     return X[..., 0] if vec else X
+
+
+def _unroll_small() -> bool:
+    # The unrolled forms exist for the axon toolchain's pathological
+    # small-linalg lowerings (CLAUDE.md; PERF.md item 6a).  On the CPU
+    # backend LAPACK beats them badly (~2.5x on the whole lowrank scan at
+    # r = 8: the ~k^2 fused scalar ops form one serial dependency chain),
+    # so the gate is platform-aware.  Trace-time Python branch — resolved
+    # once per compile, never inside the program.
+    return jax.default_backend() == "tpu"
+
+
+def chol_small(M: jax.Array, jitter: float = 0.0) -> jax.Array:
+    """``chol_unrolled`` for k <= UNROLL_K_MAX on TPU, jitter-preserving
+    ``jnp.linalg.cholesky`` otherwise — the standard gate for r x r
+    factorizations inside scan bodies (the low-rank engine's
+    S/Gamma/Sigma systems carry their own additive regularization, so the
+    fallback must not add a second one)."""
+    if M.shape[-1] <= UNROLL_K_MAX and _unroll_small():
+        return chol_unrolled(M, jitter)
+    k = M.shape[-1]
+    return jnp.linalg.cholesky(M + jitter * jnp.eye(k, dtype=M.dtype))
+
+
+def chol_solve_small(L: jax.Array, B: jax.Array) -> jax.Array:
+    """``chol_solve_unrolled`` for small k on TPU, generic ``chol_solve``
+    otherwise (same platform gate as ``chol_small``)."""
+    if L.shape[-1] <= UNROLL_K_MAX and _unroll_small():
+        return chol_solve_unrolled(L, B)
+    return chol_solve(L, B)
 
 
 def tria_unrolled(X: jax.Array) -> jax.Array:

@@ -7,6 +7,7 @@ pin the augmentation algebra:
   - EM loglik is monotone under masks + augmentation (whole-pipeline oracle).
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -190,6 +191,55 @@ def test_mf_loglik_eval_mask_none():
         ll_ones = mf_loglik_eval(Y, W, p, spec, precise=precise)
         assert np.isfinite(ll_none)
         np.testing.assert_allclose(ll_none, ll_ones, rtol=1e-12)
+
+
+def test_mf_loglik_eval_fast_path_routes_through_fit_program(monkeypatch):
+    """Regression (CLAUDE.md axon SIGABRT): the fast compute-dtype
+    ``mf_loglik_eval`` must evaluate through the fit's OWN E-step program
+    (``mf_em_step``), never the standalone loglik-only ``info_scan``
+    program — the f32 masked variant of THAT program at the m~25
+    augmented shape crashes the axon TPU compiler (fusion-merge check
+    failure, 2026-07).  Pin the routing by making the standalone kernel
+    explode: the fast path must sail through untouched while the precise
+    path (which legitimately uses it) trips the mine."""
+    from dfm_tpu.models.mixed_freq import mf_loglik_eval
+    from dfm_tpu.ssm import info_filter as info_mod
+
+    rng = np.random.default_rng(53)
+    Y, mask, _, _ = dgp.simulate_mixed_freq(30, 8, 48, 5, rng)
+    spec = MixedFreqSpec(n_monthly=30, n_quarterly=8, n_factors=5)
+    assert spec.state_dim == 25            # the documented crash shape
+    W = np.where(np.isfinite(Y), mask, 0.0)
+    p = mf_pca_init(np.nan_to_num(Y), W, spec)
+
+    def boom(*a, **k):          # stands in for the SIGABRT'ing program
+        raise AssertionError("standalone loglik-only program invoked")
+
+    monkeypatch.setattr(info_mod, "_loglik_eval_impl", boom)
+    ll_fast = mf_loglik_eval(Y, W, p, spec, precise=False)
+    assert np.isfinite(ll_fast)
+    # ... and it is exactly the fit's in-loop figure.
+    Yj = jnp.asarray(Y)
+    _, ll_ref = mf_em_step(Yj, jnp.asarray(W, Yj.dtype),
+                           p.astype(Yj.dtype), spec)
+    np.testing.assert_allclose(ll_fast, float(ll_ref), rtol=1e-12)
+    # The mine is live: the precise path does reach the standalone kernel.
+    with pytest.raises(AssertionError, match="standalone"):
+        mf_loglik_eval(Y, W, p, spec, precise=True)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="axon-only: exercises the real TPU compiler at "
+                           "the m~25 shape the exact masked loglik-only "
+                           "program SIGABRTs on")
+def test_mf_loglik_eval_fast_path_compiles_at_m25_on_axon():
+    from dfm_tpu.models.mixed_freq import mf_loglik_eval
+    rng = np.random.default_rng(54)
+    Y, mask, _, _ = dgp.simulate_mixed_freq(30, 8, 48, 5, rng)
+    spec = MixedFreqSpec(n_monthly=30, n_quarterly=8, n_factors=5)
+    W = np.where(np.isfinite(Y), mask, 0.0)
+    p = mf_pca_init(np.nan_to_num(Y), W, spec)
+    assert np.isfinite(mf_loglik_eval(Y, W, p, spec, precise=False))
 
 
 def test_mf_fit_attaches_health(mf_panel):

@@ -129,6 +129,30 @@ def test_backfill_checked_in_artifacts(tmp_path):
     assert len(store.load()) == len(recs)
 
 
+def test_backfill_glob_infers_kind_per_file(tmp_path):
+    """The importer sweeps EVERY ``BENCH_*.json`` (not a hand-kept list):
+    a new bench CLI's checked-in artifact seeds history the moment it
+    lands, with its family kind inferred from the filename so
+    ``obs.regress`` fingerprints match the live CLI's records."""
+    root = tmp_path / "repo"
+    root.mkdir()
+    line = {"metric": "m", "value": 1.0, "shape": [2, 2]}
+    for name, run in (("BENCH_longt.json", "lt1"),
+                      ("BENCH_kscale.json", "ks1"),
+                      ("BENCH_r07.json", "r7"),
+                      ("BENCH_novel2.json", "nv1")):
+        (root / name).write_text(json.dumps(
+            {"parsed": dict(line, run_id=run), "tail": ""}))
+    store = obs_store.RunStore(str(tmp_path / "runs"))
+    assert obs_store.backfill(str(root), store=store) == 4
+    kinds = {r["run_id"]: r["kind"] for r in store.load()}
+    assert kinds == {"lt1": "bench_longt", "ks1": "bench_kscale",
+                     "r7": "bench",        # BENCH_r<round> stays plain
+                     "nv1": "bench"}       # unknown families default
+    # idempotent across the glob too
+    assert obs_store.backfill(str(root), store=store) == 0
+
+
 def test_store_cli_backfill_and_list(tmp_path):
     env = dict(os.environ, DFM_RUNS=str(tmp_path))
     out = subprocess.run(

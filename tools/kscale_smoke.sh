@@ -1,0 +1,148 @@
+#!/usr/bin/env bash
+# One-command wide-k check: rank-r lowrank filter/smoother parity vs the
+# NumPy f64 oracle AND (at r = k) vs the exact info-form path -> a
+# smoke-size bench.kscale sweep (rank-r must not lose to exact at the
+# widest smoke k, calibration error must be finite, and the MF m~25
+# augmented shape must complete a rank-r fit) -> a seeded-registry
+# advisor selection (fit(auto=True) applies the lowrank plan and matches
+# the explicit filter= knob bit for bit).  The quick answer to "does
+# rank-r still win at wide k, are its bands honest, and does the advisor
+# know".
+#
+# Usage (from the repo root):
+#   tools/kscale_smoke.sh
+#
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "--- lowrank parity (oracle + r=k exactness) ---" >&2
+JAX_PLATFORMS=cpu python - <<'PY'
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.ssm.info_filter import info_filter
+from dfm_tpu.ssm.kalman import rts_smoother
+from dfm_tpu.ssm.lowrank_filter import lowrank_filter_smoother
+from dfm_tpu.ssm.params import SSMParams as JP
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(3)
+p = dgp.dfm_params(25, 6, rng)
+Y, _ = dgp.simulate(p, 80, rng)
+mask = dgp.random_mask(*Y.shape, rng, 0.25)
+pj = JP.from_numpy(p, jnp.float64)
+Yj = jnp.asarray(Y)
+
+kf_j, sm_j = lowrank_filter_smoother(Yj, pj, mask=jnp.asarray(mask), rank=3)
+kf_n = cpu_ref.kalman_filter_lowrank(Y, p, mask=mask, rank=3)
+sm_n = cpu_ref.rts_smoother_lowrank(kf_n, p, rank=3)
+dll = abs(float(kf_j.loglik) - kf_n.loglik)
+dx = float(jnp.abs(sm_j.x_sm - sm_n.x_sm).max())
+assert dll < 1e-8 and dx < 1e-10, \
+    f"kscale smoke FAILED: oracle drift dll={dll} dx_sm={dx}"
+print(f"rank-3 vs NumPy oracle: dll {dll:.1e}, dx_sm {dx:.1e}")
+
+kf_e = info_filter(Yj, pj)
+sm_e = rts_smoother(kf_e, pj)
+kf_f, sm_f = lowrank_filter_smoother(Yj, pj, rank=6)
+dll = abs(float(kf_f.loglik - kf_e.loglik) / float(kf_e.loglik))
+dx = float(jnp.abs(sm_f.x_sm - sm_e.x_sm).max())
+assert dll < 1e-9 and dx < 1e-8, \
+    f"kscale smoke FAILED: r=k drift dll={dll} dx_sm={dx}"
+print(f"r=k vs exact info path: dll {dll:.1e}, dx_sm {dx:.1e}")
+print("parity OK")
+PY
+
+echo "--- bench.kscale smoke sweep ---" >&2
+OUT=$(JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" \
+      DFM_BENCH_N="${DFM_BENCH_N:-80}" \
+      DFM_BENCH_T="${DFM_BENCH_T:-120}" \
+      DFM_BENCH_KSWEEP="${DFM_BENCH_KSWEEP:-12,50}" \
+      DFM_BENCH_ITERS="${DFM_BENCH_ITERS:-6}" \
+      DFM_BENCH_REPS="${DFM_BENCH_REPS:-2}" \
+      DFM_BENCH_MF_T="${DFM_BENCH_MF_T:-30}" \
+      DFM_RUNS= python -m bench.kscale)
+echo "$OUT"
+printf '%s' "$OUT" | python -c '
+import json, math, sys
+d = json.loads(sys.stdin.readline())
+spd = d["value"]
+err = d["kscale_calib_err"]
+assert spd >= 1.0, (
+    f"kscale smoke FAILED: lowrank {spd}x exact at the widest smoke k")
+assert math.isfinite(err) and err <= 0.10, (
+    f"kscale smoke FAILED: calibration error {err}")
+mf_wall = d.get("kscale_mf_m25_wall_s")
+assert mf_wall is not None, (
+    "kscale smoke FAILED: MF m~25 rank-r leg missing")
+m = d["kscale_mf_state_dim"]
+print(f"kscale smoke OK: lowrank {spd}x exact, calib err {err}, "
+      f"MF m={m} fit {mf_wall}s")'
+
+echo "--- advisor picks lowrank from a profiled wide-k registry ---" >&2
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" python - <<'PY'
+import os
+import tempfile
+
+import numpy as np
+
+with tempfile.TemporaryDirectory() as d:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from dfm_tpu.api import DynamicFactorModel, TPUBackend, fit
+    from dfm_tpu.obs.advise import advise
+    from dfm_tpu.obs.profile import profile_shape
+    from dfm_tpu.obs.store import RunStore
+
+    N, T, K, ITERS = 80, 120, 50, 8
+    recs, _ = profile_shape(N, T, K, iters=ITERS, repeats=3,
+                            variants=("chunked", "lowrank"),
+                            capture_costs=False)
+    store = RunStore(d)
+    for r in recs:
+        store.append(r)
+    res = advise(N, T, K, max_iters=ITERS, runs=d)
+    top = res["plans"][0]
+    print(f"top plan at k={K}: {top['engine']}+{top['filter']} "
+          f"(anchored={top['anchored']}, "
+          f"{top['predicted_wall_s']:.3f}s predicted)")
+    assert top["filter"] == "lowrank", (
+        f"kscale smoke FAILED: advisor kept {top} at the profiled "
+        f"wide-k shape")
+
+    rng = np.random.default_rng(0)
+    from dfm_tpu.utils import dgp
+    p_true = dgp.dfm_params(N, K, rng)
+    Y, _ = dgp.simulate(p_true, T, rng)
+    os.environ["DFM_RUNS"] = d
+    r_auto = fit(DynamicFactorModel(n_factors=K), Y,
+                 backend=TPUBackend(), max_iters=ITERS, tol=0.0,
+                 auto=True)
+    del os.environ["DFM_RUNS"]
+    assert r_auto.filter == "lowrank", r_auto.filter
+    # Re-run with the plan's knobs passed explicitly: must be bit-equal.
+    a = r_auto.advice
+    kw = {}
+    if a["engine"] == "fused":
+        kw["fused"] = True
+    elif int(a.get("depth") or 1) > 1 or a.get("bucket"):
+        from dfm_tpu.pipeline import PipelineConfig
+        kw["pipeline"] = PipelineConfig(depth=int(a["depth"]),
+                                        bucket=bool(a.get("bucket")))
+    r_exp = fit(DynamicFactorModel(n_factors=K), Y,
+                backend=TPUBackend(filter="lowrank",
+                                   fused_chunk=int(a["fused_chunk"])),
+                max_iters=ITERS, tol=0.0, **kw)
+    assert np.array_equal(np.asarray(r_auto.logliks),
+                          np.asarray(r_exp.logliks)), \
+        "kscale smoke FAILED: auto fit != explicit filter=lowrank fit"
+    print("fit(auto=True) applied lowrank, bit-identical to the knob")
+PY
+
+echo "kscale smoke OK"

@@ -10,7 +10,7 @@
 #   DFM_BENCH_N=500 ... tools/perf_gate.sh  # different smoke shape
 #
 # The registry lives in .dfm_runs/ (override with DFM_RUNS).  History is
-# seeded from the checked-in BENCH_r*.json + BENCH_ALL.json on first use;
+# seeded from the checked-in BENCH_*.json artifacts on first use;
 # note the gate only compares runs with the SAME config fingerprint (shape,
 # metric, device class), so the smoke-size gate accumulates its own smoke
 # history — the first smoke run records a baseline, later ones are gated.
@@ -168,6 +168,27 @@ assert store.noise_floor("readmission_ms") > 0, \
     "perf_gate: readmission_ms lost its ms noise floor"
 assert store.noise_floor("evictions_per_query") > 0, \
     "perf_gate: evictions_per_query lost its noise floor"'
+
+# The wide-k state-axis metrics (bench.kscale / tools/kscale_smoke.sh)
+# must stay registered: the per-k rank-r speedups gate higher-is-better
+# (k=50 is the headline contract); the 90%-band coverage error and the
+# MF m~25 fit wall gate lower-is-better with their own noise floors.
+python -c '
+from dfm_tpu.obs import store
+need = ("kscale_speedup_k10", "kscale_speedup_k25", "kscale_speedup_k50",
+        "kscale_speedup_k100", "kscale_calib_err", "kscale_mf_m25_wall_s")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+for k in need[:4]:
+    assert not store.lower_is_better(k), \
+        f"perf_gate: {k} must gate higher-is-better"
+for k in ("kscale_calib_err", "kscale_mf_m25_wall_s"):
+    assert store.lower_is_better(k), \
+        f"perf_gate: {k} lost its lower-is-better marker"
+assert store.noise_floor("kscale_calib_err") > 0, \
+    "perf_gate: kscale_calib_err lost its noise floor"
+assert store.noise_floor("kscale_mf_m25_wall_s") > 0, \
+    "perf_gate: kscale_mf_m25_wall_s lost its wall noise floor"'
 
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Run every one-command smoke check in tools/ (plus the perf gate) and
+# print a pass/fail summary — the single entry point for "is every
+# subsystem still healthy" before a commit or after an environment
+# change.  Each check runs in sequence (nproc == 1: parallel runs would
+# contaminate each other's timing legs — CLAUDE.md) with its own log
+# under ${SMOKE_LOG_DIR:-/tmp/dfm_smoke_logs}; the summary names each
+# failing check and its log so no scrollback archaeology is needed.
+#
+# Usage (from the repo root):
+#   tools/smoke_all.sh              # every *_smoke.sh + perf_gate.sh
+#   tools/smoke_all.sh serve fleet  # just tools/serve_smoke.sh + tools/fleet_smoke.sh
+#
+# Exit 0 when everything passes, 1 otherwise.  Individual checks keep
+# their own env knobs (DFM_BENCH_*, JAX_PLATFORMS, ...).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+LOG_DIR="${SMOKE_LOG_DIR:-/tmp/dfm_smoke_logs}"
+mkdir -p "$LOG_DIR"
+
+if [ "$#" -gt 0 ]; then
+    checks=()
+    for name in "$@"; do
+        checks+=("tools/${name%_smoke.sh}_smoke.sh")
+    done
+else
+    checks=(tools/*_smoke.sh)
+    checks+=(tools/perf_gate.sh)
+fi
+
+pass=() fail=()
+for check in "${checks[@]}"; do
+    name=$(basename "$check" .sh)
+    log="$LOG_DIR/$name.log"
+    printf '=== %-18s ' "$name"
+    t0=$SECONDS
+    if bash "$check" >"$log" 2>&1; then
+        printf 'PASS  (%3ds)\n' "$((SECONDS - t0))"
+        pass+=("$name")
+    else
+        printf 'FAIL  (%3ds)  log: %s\n' "$((SECONDS - t0))" "$log"
+        fail+=("$name")
+    fi
+done
+
+echo
+echo "--- smoke summary: ${#pass[@]} passed, ${#fail[@]} failed ---"
+if [ "${#fail[@]}" -gt 0 ]; then
+    for name in "${fail[@]}"; do
+        echo "FAILED: $name  ($LOG_DIR/$name.log; last lines below)"
+        tail -5 "$LOG_DIR/$name.log" | sed 's/^/    /'
+    done
+    exit 1
+fi
+echo "all smoke checks OK"
