@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Serving-daemon soak: socket-level throughput/latency of the
+``dfm_tpu.daemon`` front door over a restored fleet, plus the two
+robustness contracts the daemon exists for — overload protection (the
+SLO-burn shed path actually sheds, deterministically, and records it)
+and zero-downtime handoff (a mid-soak blue/green swap drops ZERO
+queries).  Prints exactly ONE JSON line to stdout:
+
+    {"metric": ..., "value": N, "unit": "queries/sec",
+     "daemon_qps": N, "daemon_p99_ms": N, "daemon_shed_rate": N,
+     "daemon_handoff_gap_ms": N, "daemon_dropped_queries": 0, ...}
+
+``value`` is warm client-observed queries/sec through the socket
+(connect + JSON round-trip + fused fleet tick + d2h, per query).  The
+overload leg arms a deliberately-unmeetable SLO so the burn signal
+fires, then bursts a low-priority tenant: ``daemon_shed_rate`` is the
+fraction of the burst shed (the leg MEANS to shed; zero would be the
+bug).  The handoff leg runs a same-process blue/green swap while a
+client hammers submits: ``daemon_handoff_gap_ms`` is the successor-ready
+gap and ``daemon_dropped_queries`` counts client requests that got no
+answer (the zero-downtime contract: always 0).
+
+Run on the real chip: ``python -m bench.daemon``.  Smoke-size via
+DFM_BENCH_DAEMON_MIX ("N,T,KxC;...", default 2 shapes x 2 = 4 tenants),
+DFM_BENCH_QUERIES (load-leg queries, default 24), DFM_BENCH_ROWS
+(rows/query, default 2), DFM_BENCH_SERVE_ITERS (EM iters/query, default
+5), DFM_BENCH_ITERS (cold-fit budget, default 30),
+DFM_BENCH_DAEMON_BURST (overload burst size, default 12).
+Diagnostics on stderr.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from bench._common import log, parse_mix, pct as _pct, record_run
+
+
+def main():
+    mix = os.environ.get("DFM_BENCH_DAEMON_MIX", "12,48,2x2;16,56,2x2")
+    n_queries = int(os.environ.get("DFM_BENCH_QUERIES", 24))
+    r_max = int(os.environ.get("DFM_BENCH_ROWS", 2))
+    serve_iters = int(os.environ.get("DFM_BENCH_SERVE_ITERS", 5))
+    cold_iters = int(os.environ.get("DFM_BENCH_ITERS", 30))
+    burst = int(os.environ.get("DFM_BENCH_DAEMON_BURST", 12))
+    shapes = parse_mix(mix)
+    B = len(shapes)
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+    from dfm_tpu import DynamicFactorModel, TPUBackend, fit, open_fleet
+    from dfm_tpu.daemon import (DaemonClient, DaemonConfig, DFMDaemon,
+                                make_listener)
+    from dfm_tpu.obs.live import set_slo
+    from dfm_tpu.obs.slo import SLOConfig
+    from dfm_tpu.utils import dgp
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}); {B} tenants "
+        f"[{mix}], {n_queries} load queries, {burst} overload burst, "
+        f"{serve_iters} EM iters/query")
+
+    work = tempfile.mkdtemp(prefix="dfm_bench_daemon_")
+    snap = os.path.join(work, "snap")
+    journal = os.path.join(work, "journal.jsonl")
+    addr = os.path.join(work, "daemon.sock")
+
+    be = TPUBackend(filter="info")
+    # Per-tenant fitted models + held-out rows for the query stream.
+    total = n_queries + burst + 64
+    ress, Ys, streams = [], [], []
+    with jax.default_matmul_precision("highest"):
+        for i, (N, T, k) in enumerate(shapes):
+            rngi = np.random.default_rng(5000 + i)
+            p_true = dgp.dfm_params(N, k, rngi)
+            Y_all, _ = dgp.simulate(p_true, T + total * r_max, rngi)
+            ress.append(fit(DynamicFactorModel(n_factors=k), Y_all[:T],
+                            max_iters=cold_iters, backend=be,
+                            telemetry=False))
+            Ys.append(Y_all[:T])
+            streams.append(Y_all[T:])
+
+    # Bootstrap: snapshot a fresh fleet, then run the daemon from the
+    # RECOVERED state — the bench soaks the restore path too.
+    caps = [Ys[i].shape[0] + (total + 2) * r_max for i in range(B)]
+    with jax.default_matmul_precision("highest"):
+        boot = open_fleet(ress, Ys, capacity=caps, max_update_rows=r_max,
+                          max_iters=serve_iters, tol=0.0, backend=be)
+        names = list(boot.tenants)
+        boot.snapshot_all(snap)
+        boot.close()
+
+        # Tenant 0 is high-priority; everyone else is the shed class.
+        cfg = DaemonConfig(queue_max=max(16, 2 * B),
+                           priority={names[0]: 1})
+        daemon = DFMDaemon.recover(snap, journal, backend=be, config=cfg)
+        listener = make_listener(addr)
+        th = threading.Thread(target=daemon.serve_forever,
+                              args=(listener,), daemon=True)
+        th.start()
+
+        cli = DaemonClient(addr, timeout=600.0)
+        cursor = [0] * B
+
+        def rows_for(i):
+            r = streams[i][cursor[i]:cursor[i] + r_max]
+            cursor[i] += r_max
+            return r
+
+        # Warmup: one query per tenant compiles each bucket's executable.
+        for i, t in enumerate(names):
+            r = cli.submit(t, rows_for(i), wait=True)
+            assert r.get("ok"), r
+
+        # -- load leg: warm socket-level throughput + latency ----------
+        lat = []
+        t0 = time.perf_counter()
+        for q in range(n_queries):
+            i = q % B
+            tq = time.perf_counter()
+            r = cli.submit(names[i], rows_for(i), wait=True)
+            lat.append(time.perf_counter() - tq)
+            assert r.get("ok"), r
+        wall = time.perf_counter() - t0
+        qps = n_queries / wall
+        p50_ms = 1e3 * _pct(lat, 50)
+        p99_ms = 1e3 * _pct(lat, 99)
+        log(f"load: {n_queries} queries in {wall:.3f} s ({qps:.1f} q/s); "
+            f"p50 {p50_ms:.1f} ms p99 {p99_ms:.1f} ms")
+
+        # -- overload leg: burn the SLO, burst the shed class ----------
+        # An unmeetable latency objective makes every served query a
+        # budget violation; after min_events the burn fires and the
+        # daemon sheds the low-priority class deterministically.
+        set_slo(SLOConfig(p99_ms=1e-6, min_events=5, window=3600.0))
+        for _ in range(6):           # feed the monitor until it fires
+            cli.submit(names[0], rows_for(0), wait=True)
+        n_shed = 0
+        for q in range(burst):
+            i = 1 % B                # lowest-priority tenant
+            r = cli.submit(names[i], rows_for(i))
+            if r.get("shed"):
+                n_shed += 1
+        shed_rate = n_shed / burst if burst else 0.0
+        set_slo(None)                # disarm: clears the breach latch
+        log(f"overload: {n_shed}/{burst} burst queries shed "
+            f"(rate {shed_rate:.2f}) under forced SLO burn")
+
+        # -- handoff leg: blue/green swap under live load --------------
+        stop = threading.Event()
+        served_during = [0]
+        dropped_box = [0]
+
+        def hammer():
+            hc = DaemonClient(addr, timeout=600.0)
+            while not stop.is_set():
+                try:
+                    r = hc.submit(names[0], None, wait=True)
+                    if r.get("ok"):
+                        served_during[0] += 1
+                except ConnectionError:
+                    dropped_box[0] += 1
+                time.sleep(0.02)
+
+        hth = threading.Thread(target=hammer, daemon=True)
+        hth.start()
+        succ, lst2, gap_ms = DFMDaemon.takeover(
+            addr, snap, journal, backend=be, config=cfg)
+        th.join(timeout=60)
+        th2 = threading.Thread(target=succ.serve_forever, args=(lst2,),
+                               daemon=True)
+        th2.start()
+        # A few post-swap queries prove the successor serves.
+        for i, t in enumerate(names):
+            r = cli.submit(t, rows_for(i), wait=True)
+            assert r.get("ok"), r
+        stop.set()
+        hth.join(timeout=60)
+        dropped = dropped_box[0]
+        log(f"handoff: gap {gap_ms:.1f} ms, {served_during[0]} queries "
+            f"served during swap, {dropped} dropped")
+
+        st = succ.status()
+        cli.shutdown()
+        th2.join(timeout=60)
+        daemon.close()
+        succ.close()
+
+    shutil.rmtree(work, ignore_errors=True)
+
+    from dfm_tpu.obs.store import new_run_id
+    payload = {
+        "metric": f"daemon_qps_{B}tenants",
+        "value": round(qps, 2),
+        "unit": "queries/sec",
+        "value_definition": ("warm client-observed daemon throughput: "
+                             "queries/sec through the socket front door "
+                             "(connect + JSON round-trip + fused fleet "
+                             "tick + d2h per query)"),
+        "daemon_qps": round(qps, 2),
+        "daemon_p99_ms": round(p99_ms, 2),
+        "daemon_p50_ms": round(p50_ms, 2),
+        "daemon_shed_rate": round(shed_rate, 3),
+        "daemon_handoff_gap_ms": round(gap_ms, 2),
+        "daemon_dropped_queries": int(dropped),
+        "daemon_queries_during_handoff": int(served_during[0]),
+        "n_tenants": B,
+        "n_queries": n_queries,
+        "overload_burst": burst,
+        "n_backpressure": int(st["n_backpressure"]),
+        "n_snapshots": int(st["n_snapshots"]),
+        "journal_seq": int(st["journal_seq"]),
+        "serve_iters": serve_iters,
+        "mix": mix,
+        "run_id": new_run_id(),
+    }
+    print(json.dumps(payload))
+    record_run(payload, dev, "bench_daemon")
+
+
+if __name__ == "__main__":
+    main()

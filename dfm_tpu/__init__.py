@@ -18,7 +18,7 @@ from .estim.evaluate import oos_evaluate
 from .estim.batched import DFMBatchSpec, BatchFitResult, fit_many
 from .sched import Job, JobResult
 from .serve import NowcastSession, SessionUpdate, open_session
-from .fleet import SessionFleet, open_fleet
+from .fleet import SessionFleet, open_fleet, restore_fleet
 
 __version__ = "0.1.0"
 
@@ -31,6 +31,6 @@ __all__ = [
     "DFMBatchSpec", "BatchFitResult", "fit_many",
     "fit_jobs", "Job", "JobResult",
     "NowcastSession", "SessionUpdate", "open_session",
-    "SessionFleet", "open_fleet",
+    "SessionFleet", "open_fleet", "restore_fleet",
     "__version__",
 ]

@@ -1,0 +1,27 @@
+"""Serving daemon: a robust socket front door over one fleet.
+
+``python -m dfm_tpu.daemon --listen ADDR --snapshot-dir D --journal J``
+runs the daemon; ``DaemonClient(ADDR)`` talks to it (jax-free).  Three
+robustness layers — bounded-queue backpressure + SLO-burn load-shedding,
+journal + snapshot crash durability (restart replays to bit-equal
+answers), and blue/green zero-downtime handoff (``--takeover``).  See
+``daemon.server`` for the architecture and ``daemon.protocol`` for the
+wire format.
+
+Jax-free in the ``obs`` sense: ``DaemonClient``, ``Journal`` and the
+protocol/lifecycle helpers never touch a device or compile anything —
+clients and tooling pay no jax runtime cost (the fleet the daemon
+serves is the only jax surface, and it loads with the fleet).
+"""
+
+from .journal import Journal
+from .lifecycle import (recv_listener, replay_entries,
+                        restore_daemon_state, send_listener)
+from .protocol import (DaemonClient, connect, make_listener, parse_addr,
+                       recv_json, send_json)
+from .server import DaemonConfig, DFMDaemon
+
+__all__ = ["DFMDaemon", "DaemonConfig", "DaemonClient", "Journal",
+           "restore_daemon_state", "replay_entries", "send_listener",
+           "recv_listener", "make_listener", "connect", "parse_addr",
+           "send_json", "recv_json"]

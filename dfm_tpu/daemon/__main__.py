@@ -1,0 +1,92 @@
+"""CLI: run (or take over) the serving daemon.
+
+Cold start / crash restart (always restores from the snapshot dir, then
+replays the journal tail — a fresh dir + empty journal is a fresh
+daemon only if a snapshot exists; bootstrap one with
+``SessionFleet.snapshot_all`` or the ``snapshot`` protocol op):
+
+    python -m dfm_tpu.daemon --listen /tmp/dfm.sock \
+        --snapshot-dir /tmp/dfm-snap --journal /tmp/dfm.journal \
+        [--snapshot-every 32] [--priority news=1,fast=0] [--queue-max 64]
+
+Blue/green handoff (successor; predecessor keeps serving until we are
+warm, then passes its listener fd and exits — zero dropped queries):
+
+    python -m dfm_tpu.daemon --takeover /tmp/dfm.sock \
+        --snapshot-dir /tmp/dfm-snap --journal /tmp/dfm.journal
+
+``DFM_COMPILE_CACHE`` defaults to ``.dfm_cache/`` here (like bench/
+``__graft_entry__``) so restart + takeover warm executables from disk.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _parse_priority(s):
+    out = {}
+    for part in filter(None, (s or "").split(",")):
+        name, _, v = part.partition("=")
+        out[name] = int(v)
+    return out or None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dfm_tpu.daemon",
+        description="robust serving daemon over a restored fleet")
+    ap.add_argument("--listen", help="address to bind (unix path or "
+                                     "host:port); required unless "
+                                     "--takeover")
+    ap.add_argument("--takeover", metavar="ADDR",
+                    help="blue/green: take the listener over from the "
+                         "daemon at ADDR instead of binding")
+    ap.add_argument("--snapshot-dir", required=True)
+    ap.add_argument("--journal", required=True)
+    ap.add_argument("--snapshot-every", type=int, default=32)
+    ap.add_argument("--queue-max", type=int, default=64)
+    ap.add_argument("--tick-requests", type=int, default=8)
+    ap.add_argument("--priority", default="",
+                    help="tenant=prio[,tenant=prio...]; higher sheds "
+                         "later under SLO burn")
+    ap.add_argument("--resident", type=int, default=None,
+                    help="cap on hot fleet lanes (tiering)")
+    ap.add_argument("--runs", default=None,
+                    help="RunStore dir for the admission cost model")
+    args = ap.parse_args(argv)
+    if not args.listen and not args.takeover:
+        ap.error("need --listen ADDR or --takeover ADDR")
+
+    # Warm executables from the persistent compile cache, like the other
+    # long-lived CLIs (bench, __graft_entry__).
+    os.environ.setdefault("DFM_COMPILE_CACHE", ".dfm_cache")
+
+    from . import DaemonConfig, DFMDaemon, make_listener
+    cfg = DaemonConfig(queue_max=args.queue_max,
+                       tick_requests=args.tick_requests,
+                       snapshot_every=args.snapshot_every,
+                       priority=_parse_priority(args.priority))
+    kw = dict(config=cfg, resident=args.resident, runs=args.runs)
+    if args.takeover:
+        daemon, listener, gap_ms = DFMDaemon.takeover(
+            args.takeover, args.snapshot_dir, args.journal, **kw)
+        print(f"dfm-daemon: took over {args.takeover!r} "
+              f"(gap {gap_ms:.1f} ms)", file=sys.stderr, flush=True)
+    else:
+        daemon = DFMDaemon.recover(args.snapshot_dir, args.journal, **kw)
+        listener = make_listener(args.listen)
+        print(f"dfm-daemon: serving on {args.listen!r} "
+              f"({len(daemon.status()['tenants'])} tenants)",
+              file=sys.stderr, flush=True)
+    try:
+        daemon.serve_forever(listener)
+    finally:
+        daemon.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,156 @@
+"""Daemon lifecycle: crash recovery and zero-downtime handoff.
+
+Crash recovery (``restore_daemon_state``): restore the latest fleet
+snapshot (``fleet.restore_fleet`` — fingerprint-verified, schema-
+checked), then replay the request journal tail after the snapshot's
+``journal_seq`` watermark through ``submit``/``drain``.  Replay is
+deterministic and per-tenant answers are pinned to lone sessions, so
+the recovered device state is bit-equal to the uninterrupted daemon's —
+and the replay itself compiles (or warms from ``DFM_COMPILE_CACHE``)
+the exact serving executables the first live query needs.
+
+Zero-downtime handoff (blue/green): the listening socket is passed
+between processes over a unix control socket with ``SCM_RIGHTS``
+(``socket.send_fds``/``recv_fds``), so it NEVER closes — connections
+arriving during the swap wait in the kernel backlog instead of being
+refused.  Choreography:
+
+1. successor restores the current snapshot + journal tail (warm),
+2. successor listens on a throwaway ``reply_to`` unix socket and sends
+   ``{"op": "handoff", "reply_to": ...}`` to the predecessor,
+3. predecessor stops accepting, drains every in-flight ticket, takes a
+   final snapshot, stamps ``t_stop`` and sends the listener fd + meta
+   (``last_seq``) to ``reply_to``, then exits,
+4. successor replays the journal delta ``(replayed, last_seq]``, adopts
+   the fd and serves.  ``handoff_gap_ms`` = successor-ready minus
+   predecessor ``t_stop`` — the only window where queries queue.
+"""
+
+from __future__ import annotations
+
+import array
+import json
+import os
+import socket
+import time
+from typing import Optional, Tuple
+
+from .journal import Journal
+
+__all__ = ["send_listener", "recv_listener", "restore_daemon_state",
+           "replay_entries"]
+
+_META_MAX = 1 << 20
+
+
+def send_listener(reply_to: str, listener: socket.socket,
+                  meta: dict) -> None:
+    """Predecessor side: hand the listening socket's fd + a JSON meta
+    blob to the successor waiting on the ``reply_to`` unix socket."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.connect(reply_to)
+        payload = json.dumps(meta).encode("utf-8")
+        if hasattr(socket, "send_fds"):
+            socket.send_fds(s, [payload], [listener.fileno()])
+        else:                            # pragma: no cover - py<3.9
+            fds = array.array("i", [listener.fileno()])
+            s.sendmsg([payload], [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+                                   fds.tobytes())])
+
+
+def recv_listener(reply_sock: socket.socket,
+                  timeout: Optional[float] = None
+                  ) -> Tuple[socket.socket, dict]:
+    """Successor side: accept one connection on the ``reply_to`` listener
+    and receive (listening socket, meta).  The rebuilt socket owns the
+    received fd."""
+    if timeout is not None:
+        reply_sock.settimeout(timeout)
+    conn, _ = reply_sock.accept()
+    try:
+        if hasattr(socket, "recv_fds"):
+            payload, fds, _, _ = socket.recv_fds(conn, _META_MAX, 1)
+        else:                            # pragma: no cover - py<3.9
+            payload, anc, _, _ = conn.recvmsg(
+                _META_MAX, socket.CMSG_LEN(array.array("i", [0]).itemsize))
+            fds = array.array("i")
+            for level, tp, data in anc:
+                if level == socket.SOL_SOCKET and tp == socket.SCM_RIGHTS:
+                    fds.frombytes(data)
+        if not fds:
+            raise RuntimeError("handoff peer sent no listener fd")
+        meta = json.loads(payload.decode("utf-8"))
+        listener = socket.socket(fileno=fds[0])
+        return listener, meta
+    finally:
+        conn.close()
+
+
+def replay_entries(fleet, entries) -> int:
+    """Apply journaled submits to a fleet (answers discarded — replay
+    rebuilds STATE; the original answers went to the original clients).
+    Returns the highest seq applied.
+
+    The live pump validates before journaling, so every entry SHOULD
+    replay cleanly — but a journal written by an older build (or a
+    tenant evicted since) must not brick recovery: an entry the fleet
+    rejects is skipped with a warning, exactly like a torn line."""
+    import numpy as np
+    hi = 0
+    n_bad = 0
+    for e in entries:
+        rows = e.get("rows")
+        mask = e.get("mask")
+        try:
+            fleet.submit(
+                e["tenant"],
+                None if rows is None else np.asarray(rows, np.float64),
+                mask=None if mask is None else np.asarray(mask))
+        except (KeyError, ValueError, TypeError) as err:
+            n_bad += 1
+            import warnings
+            warnings.warn(f"journal replay: skipping entry "
+                          f"seq={e.get('seq')} the fleet rejects ({err})")
+            continue
+        hi = max(hi, int(e["seq"]))
+    if hi:
+        fleet.drain()
+    return hi
+
+
+def restore_daemon_state(snapshot_dir: str, journal_path: str, *,
+                         backend=None, robust=None,
+                         resident: Optional[int] = None,
+                         max_classes: int = 3,
+                         runs: Optional[str] = None):
+    """Crash-recovery entry: (fleet, watermark, n_replayed).
+
+    Restores the snapshot under ``snapshot_dir`` and replays the journal
+    tail past its ``journal_seq`` watermark.  The returned watermark is
+    the highest seq now reflected in the fleet — the daemon resumes
+    journaling after it."""
+    from ..fleet.driver import read_manifest, restore_fleet
+    manifest = read_manifest(snapshot_dir)
+    kw = {"max_classes": max_classes}
+    if backend is not None:
+        kw["backend"] = backend
+    if robust is not None:
+        kw["robust"] = robust
+    if resident is not None:
+        kw["resident"] = resident
+    if runs is not None:
+        kw["runs"] = runs
+    fleet = restore_fleet(snapshot_dir, **kw)
+    wm = int(manifest.get("journal_seq") or 0)
+    entries = Journal.read(journal_path, after=wm)
+    hi = replay_entries(fleet, entries)
+    ev = dict(session=fleet.fleet_id, action="replay",
+              n_entries=len(entries), watermark=wm)
+    from ..obs.trace import current_tracer
+    tr = current_tracer()
+    if tr is not None:
+        tr.emit("daemon", **ev)
+    else:
+        from ..obs.live import observe
+        observe({"t": time.perf_counter(), "kind": "daemon", **ev})
+    return fleet, max(wm, hi), len(entries)

@@ -1,0 +1,201 @@
+"""Wire protocol for the serving daemon (jax-free).
+
+JSON-lines over a stream socket, one request per connection: the client
+connects, writes ONE JSON object on one line, reads ONE JSON-line
+response, and closes.  Connection-per-request keeps the client trivially
+correct across blue/green handoffs — a request that lands during the
+swap simply waits in the listener backlog for the successor (the
+listening socket itself never closes; see ``lifecycle``).
+
+Values use Python's JSON dialect (``NaN`` literals mark missing panel
+entries); both ends are Python, and the journal shares the encoding.
+
+Requests (``op`` selects):
+
+- ``{"op": "submit", "tenant": t, "rows": [[...]]|null, "mask": ...,
+  "id": "..."}`` — enqueue one update (``rows=null`` = pure
+  re-forecast).  ``id`` is the client's idempotency token: retrying a
+  request with the same id after a crash/handoff never double-appends
+  (the daemon answers a duplicate with a pure re-forecast, flagged
+  ``"duplicate": true``).
+- ``{"op": "ping"}`` / ``{"op": "status"}`` — liveness / introspection.
+- ``{"op": "snapshot"}`` — force a fleet snapshot + journal compaction.
+- ``{"op": "handoff", "reply_to": path}`` — blue/green: drain, snapshot,
+  pass the listener fd to the successor waiting on ``reply_to``.
+- ``{"op": "shutdown"}`` — drain and exit.
+
+Responses: ``{"ok": true, ...}`` with per-op payload, or ``{"ok":
+false, "error": ...}`` with ``"backpressure": true, "retry_after_s": s``
+(bounded queue: slow down and retry) or ``"shed": true`` (overload
+load-shed under SLO burn: this tenant's priority class is being
+sacrificed; retry later or escalate priority).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from typing import Optional, Tuple, Union
+
+__all__ = ["DaemonClient", "send_json", "recv_json", "make_listener",
+           "connect", "parse_addr"]
+
+Addr = Union[str, Tuple[str, int]]
+
+_MAX_LINE = 64 * 1024 * 1024       # 64 MB: a (rows, mask) block is tiny
+
+
+def parse_addr(addr: Addr) -> Tuple[int, Addr]:
+    """Resolve an address to (family, sockaddr).  A string with a path
+    separator (or .sock suffix) is a unix socket path; ``host:port``
+    strings and (host, port) tuples are TCP."""
+    if isinstance(addr, tuple):
+        return socket.AF_INET, (str(addr[0]), int(addr[1]))
+    a = str(addr)
+    if os.sep in a or a.endswith(".sock"):
+        return socket.AF_UNIX, a
+    if ":" in a:
+        host, port = a.rsplit(":", 1)
+        return socket.AF_INET, (host or "127.0.0.1", int(port))
+    raise ValueError(f"cannot parse daemon address {addr!r}: want a unix "
+                     "socket path (contains / or ends in .sock) or "
+                     "host:port")
+
+
+def make_listener(addr: Addr, backlog: int = 128) -> socket.socket:
+    """Bind + listen.  The backlog is the zero-downtime buffer: during a
+    handoff the kernel parks incoming connections here until the
+    successor accepts, so no client sees a refused connection."""
+    fam, sa = parse_addr(addr)
+    sock = socket.socket(fam, socket.SOCK_STREAM)
+    if fam == socket.AF_UNIX:
+        if os.path.exists(sa):
+            os.unlink(sa)
+    else:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(sa)
+    sock.listen(backlog)
+    return sock
+
+
+def connect(addr: Addr, timeout: Optional[float] = None) -> socket.socket:
+    fam, sa = parse_addr(addr)
+    sock = socket.socket(fam, socket.SOCK_STREAM)
+    if timeout is not None:
+        sock.settimeout(timeout)
+    sock.connect(sa)
+    return sock
+
+
+def send_json(sock: socket.socket, obj: dict) -> None:
+    sock.sendall((json.dumps(obj) + "\n").encode("utf-8"))
+
+
+def recv_json(sock: socket.socket) -> Optional[dict]:
+    """Read one newline-terminated JSON object (None on clean EOF)."""
+    buf = bytearray()
+    while True:
+        chunk = sock.recv(65536)
+        if not chunk:
+            if not buf.strip():
+                return None
+            break
+        buf.extend(chunk)
+        if b"\n" in chunk:
+            break
+        if len(buf) > _MAX_LINE:
+            raise ValueError("daemon protocol line exceeds 64 MB")
+    line = bytes(buf).split(b"\n", 1)[0]
+    return json.loads(line)
+
+
+class DaemonClient:
+    """Blocking client for one daemon address.
+
+    ``request`` opens a fresh connection per call and retries
+    connection-level failures (refused / reset / timeout) with bounded
+    deterministic backoff — combined with per-request ``id`` dedup on
+    the server this gives exactly-once effect from at-least-once
+    delivery, across daemon restarts AND handoffs.  Backpressure
+    responses are surfaced to the caller by default; ``submit(...,
+    wait=True)`` sleeps the advertised ``retry_after_s`` and retries
+    until accepted.
+    """
+
+    def __init__(self, addr: Addr, timeout: float = 60.0,
+                 connect_retries: int = 40,
+                 connect_backoff_s: float = 0.25):
+        self.addr = addr
+        self.timeout = float(timeout)
+        self.connect_retries = int(connect_retries)
+        self.connect_backoff_s = float(connect_backoff_s)
+        self._ids = 0
+
+    def request(self, obj: dict) -> dict:
+        last: Exception = RuntimeError("unreachable")
+        for attempt in range(self.connect_retries + 1):
+            try:
+                sock = connect(self.addr, timeout=self.timeout)
+                try:
+                    send_json(sock, obj)
+                    resp = recv_json(sock)
+                finally:
+                    sock.close()
+                if resp is None:       # peer died mid-request: retry
+                    raise ConnectionError("daemon closed the connection "
+                                          "without answering")
+                return resp
+            except (ConnectionError, socket.timeout, TimeoutError,
+                    FileNotFoundError, OSError) as e:
+                last = e
+                if attempt < self.connect_retries:
+                    time.sleep(self.connect_backoff_s)
+        raise ConnectionError(
+            f"daemon at {self.addr!r} unreachable after "
+            f"{self.connect_retries + 1} attempts: {last}")
+
+    def _next_id(self) -> str:
+        self._ids += 1
+        return f"c{os.getpid()}-{id(self)}-{self._ids}"
+
+    # -- ops -----------------------------------------------------------
+    def submit(self, tenant: str, rows=None, mask=None,
+               req_id: Optional[str] = None, wait: bool = False) -> dict:
+        """One tenant update.  ``rows`` is a nested list (or numpy-like
+        with ``.tolist()``); NaN = missing.  ``wait=True`` honors
+        backpressure responses by sleeping ``retry_after_s`` and
+        retrying (same id — idempotent) until accepted or shed."""
+        for name in ("tolist",):
+            f = getattr(rows, name, None)
+            if f is not None:
+                rows = f()
+            f = getattr(mask, name, None)
+            if f is not None:
+                mask = f()
+        req = {"op": "submit", "tenant": str(tenant), "rows": rows,
+               "id": req_id or self._next_id()}
+        if mask is not None:
+            req["mask"] = mask
+        while True:
+            resp = self.request(req)
+            if wait and resp.get("backpressure"):
+                time.sleep(float(resp.get("retry_after_s", 0.1)))
+                continue
+            return resp
+
+    def ping(self) -> dict:
+        return self.request({"op": "ping"})
+
+    def status(self) -> dict:
+        return self.request({"op": "status"})
+
+    def snapshot(self) -> dict:
+        return self.request({"op": "snapshot"})
+
+    def shutdown(self) -> dict:
+        return self.request({"op": "shutdown"})
+
+    def handoff(self, reply_to: str) -> dict:
+        return self.request({"op": "handoff", "reply_to": str(reply_to)})

@@ -1,0 +1,654 @@
+"""The serving daemon: a robust front door over one ``SessionFleet``.
+
+``DFMDaemon`` owns a fleet and serves the JSON-lines protocol
+(``daemon.protocol``) from a BOUNDED request queue, with robustness as
+the product at three layers:
+
+1. **Overload protection** — admission happens at enqueue, priced per
+   tenant by the calibrated cost model (``obs.cost``): a full queue (by
+   count or by estimated queued seconds) answers deterministic
+   backpressure (``retry_after_s`` = the predicted time to drain what is
+   already queued), and when the PR 12 ``SLOMonitor`` burn signal fires
+   the daemon load-sheds the LOWEST-priority tenants first — every shed
+   is a ``HealthEvent(kind="shed")`` + ledger row, observable in
+   ``obs.report``/``obs.live``, never silent.
+2. **Crash durability** — every accepted submit is fsync'd into the
+   request journal BEFORE it touches the fleet; every ``snapshot_every``
+   served requests the daemon writes a fingerprint-verified fleet
+   snapshot (``SessionFleet.snapshot_all``) and compacts the journal to
+   its watermark.  ``DFMDaemon.recover`` restores + replays to device
+   state bit-equal to an uninterrupted run.
+3. **Zero-downtime handoff** — ``DFMDaemon.takeover`` implements the
+   successor side of the blue/green swap (``daemon.lifecycle``): warm
+   from snapshot + journal, receive the listening socket fd from the
+   draining predecessor, replay the delta, serve.  No connection is ever
+   refused; ``handoff_gap_ms`` is recorded and gated.
+
+Jax enters only through the fleet the daemon is handed (CLI:
+``python -m dfm_tpu.daemon``); the front door itself — queue,
+admission, journal, protocol — never touches a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..obs.trace import current_tracer
+from ..robust.health import FitHealth, HealthEvent
+from .journal import Journal
+from .lifecycle import recv_listener, restore_daemon_state, send_listener
+from .protocol import DaemonClient, make_listener, recv_json, send_json
+
+__all__ = ["DaemonConfig", "DFMDaemon"]
+
+
+def _live_observe(ev: dict) -> None:
+    from ..obs.live import observe
+    observe(ev)
+
+
+def _slo_breached() -> bool:
+    from ..obs.live import plane
+    return bool(plane().slo.breached)
+
+
+@dataclasses.dataclass(frozen=True)
+class DaemonConfig:
+    """Front-door knobs (validated at construction, like RobustPolicy)."""
+
+    queue_max: int = 64             # bounded queue: requests
+    work_max_s: Optional[float] = None   # and/or estimated queued seconds
+    tick_requests: int = 8          # max requests folded into one pump
+    snapshot_every: int = 0         # snapshot + compact cadence (0 = off)
+    retry_after_floor_s: float = 0.05
+    # tenant -> priority (higher = shed later); unlisted tenants get 0.
+    priority: Optional[Dict[str, int]] = None
+    accept_poll_s: float = 0.1      # listener poll (handoff fencing)
+    request_timeout_s: float = 600.0
+
+    def __post_init__(self):
+        def bad(field, want):
+            raise ValueError(f"DaemonConfig.{field} {want}; got "
+                             f"{getattr(self, field)!r}")
+        if int(self.queue_max) < 1:
+            bad("queue_max", "must be >= 1")
+        if self.work_max_s is not None and not self.work_max_s > 0:
+            bad("work_max_s", "must be None (no work cap) or > 0 seconds")
+        if int(self.tick_requests) < 1:
+            bad("tick_requests", "must be >= 1")
+        if int(self.snapshot_every) < 0:
+            bad("snapshot_every", "must be >= 0 (0 disables)")
+        if not self.retry_after_floor_s > 0:
+            bad("retry_after_floor_s", "must be > 0")
+        if not self.accept_poll_s > 0:
+            bad("accept_poll_s", "must be > 0")
+        if not self.request_timeout_s > 0:
+            bad("request_timeout_s", "must be > 0")
+
+
+class _Ticket:
+    __slots__ = ("req", "seq", "resp", "done", "t_enq")
+
+    def __init__(self, req: dict):
+        self.req = req
+        self.seq = 0
+        self.resp: Optional[dict] = None
+        self.done = threading.Event()
+        self.t_enq = time.perf_counter()
+
+
+class DFMDaemon:
+    """See module docstring.  Construct over an open fleet + journal, or
+    via :meth:`recover` (crash restart) / :meth:`takeover` (blue/green
+    successor)."""
+
+    def __init__(self, fleet, journal: Journal, *,
+                 config: Optional[DaemonConfig] = None,
+                 snapshot_dir: Optional[str] = None,
+                 served_ids=()):
+        self._fleet = fleet
+        self._journal = journal
+        self.config = config or DaemonConfig()
+        self.snapshot_dir = snapshot_dir
+        self.health = FitHealth(engine="daemon")
+        self._lock = threading.Lock()          # queue + counters
+        self._fleet_lock = threading.Lock()    # serializes fleet access
+        self._queue: List[_Ticket] = []
+        self._have_work = threading.Condition(self._lock)
+        self._served_ids = set(served_ids)
+        self._last_answer: Dict[str, dict] = {}
+        self._listener: Optional[socket.socket] = None
+        self._accepting = False
+        self._stopping = False
+        self._handlers = 0
+        self._fence_ack = threading.Event()   # accept loop saw the fence
+        self._serve_thread: Optional[threading.Thread] = None
+        self.n_requests = 0
+        self.n_served = 0
+        self.n_backpressure = 0
+        self.n_shed = 0
+        self.n_snapshots = 0
+        self.n_handoffs = 0
+        self._since_snapshot = 0
+        # Per-tenant admission price from the calibrated cost model: one
+        # query = one dispatch floor + max_iters warm-EM iterations at
+        # the tenant's padded class shape.  Deterministic given the
+        # profile registry; used for work-bounded queues and the
+        # deterministic retry_after_s quote.
+        from ..fleet.admission import _load_model
+        m = _load_model(None, None)
+        self._est_s: Dict[str, float] = {}
+        for name, (bucket, slot) in fleet._slot_of.items():
+            T_cap, N_max, k_max = bucket.dims
+            self._est_s[name] = float(
+                m.dispatch_floor_s
+                + slot.max_iters * m.iter_s(N_max, T_cap, k_max, "seq"))
+        if self.config.priority:
+            unknown = set(self.config.priority) - set(self._est_s)
+            if unknown:
+                raise ValueError(
+                    f"DaemonConfig.priority names unknown tenants "
+                    f"{sorted(unknown)} (fleet has "
+                    f"{sorted(self._est_s)})")
+
+    # -- constructors --------------------------------------------------
+    @classmethod
+    def recover(cls, snapshot_dir: str, journal_path: str, *,
+                backend=None, robust=None, resident: Optional[int] = None,
+                max_classes: int = 3, runs: Optional[str] = None,
+                config: Optional[DaemonConfig] = None) -> "DFMDaemon":
+        """Crash restart: restore the snapshot, replay the journal tail,
+        resume journaling after the recovered watermark.  The recovered
+        daemon's answers are bit-equal to an uninterrupted twin's."""
+        fleet, wm, _ = restore_daemon_state(
+            snapshot_dir, journal_path, backend=backend, robust=robust,
+            resident=resident, max_classes=max_classes, runs=runs)
+        ids = [e["id"] for e in Journal.read(journal_path) if "id" in e]
+        journal = Journal(journal_path)
+        return cls(fleet, journal, config=config,
+                   snapshot_dir=snapshot_dir, served_ids=ids)
+
+    @classmethod
+    def takeover(cls, addr, snapshot_dir: str, journal_path: str, *,
+                 backend=None, robust=None,
+                 resident: Optional[int] = None, max_classes: int = 3,
+                 runs: Optional[str] = None,
+                 config: Optional[DaemonConfig] = None,
+                 reply_to: Optional[str] = None):
+        """Blue/green successor: warm up, take the listener from the
+        predecessor at ``addr``, replay the delta.  Returns
+        ``(daemon, listener, gap_ms)`` — call ``serve_forever(listener)``
+        next.  Zero queries are dropped: the listener fd moves between
+        processes without closing, so the kernel backlog bridges the
+        gap."""
+        # 1. Warm: restore + replay what the predecessor has snapshotted
+        #    and journaled so far (compiles the serving executables).
+        fleet, wm, _ = restore_daemon_state(
+            snapshot_dir, journal_path, backend=backend, robust=robust,
+            resident=resident, max_classes=max_classes, runs=runs)
+        # 2. Ask the predecessor to drain and hand over its listener.
+        reply_to = reply_to or os.path.join(
+            os.path.dirname(os.path.abspath(journal_path)),
+            f"handoff-{os.getpid()}.sock")
+        reply_sock = make_listener(reply_to, backlog=1)
+        try:
+            # Single-shot on purpose: after the predecessor fences its
+            # accept loop, a RETRIED handoff request would sit in the
+            # listener backlog forever — any failure must surface, not
+            # silently spin.
+            DaemonClient(addr, timeout=600.0,
+                         connect_retries=0).handoff(reply_to)
+            listener, meta = recv_listener(reply_sock, timeout=600.0)
+        finally:
+            reply_sock.close()
+            if os.path.exists(reply_to):
+                os.unlink(reply_to)
+        # 3. Replay the delta the predecessor served while we warmed.
+        from .lifecycle import replay_entries
+        tail = Journal.read(journal_path, after=wm,
+                            upto=int(meta["last_seq"]))
+        replay_entries(fleet, tail)
+        gap_ms = max(0.0, (time.clock_gettime(time.CLOCK_MONOTONIC)
+                           - float(meta["t_stop"])) * 1e3)
+        ids = [e["id"] for e in Journal.read(journal_path) if "id" in e]
+        journal = Journal(journal_path)
+        self = cls(fleet, journal, config=config,
+                   snapshot_dir=snapshot_dir, served_ids=ids)
+        self.n_handoffs += 1
+        self.health.record(HealthEvent(
+            chunk=-1, iteration=int(meta["last_seq"]), kind="handoff",
+            action="adopted", session=fleet.fleet_id,
+            detail=(f"took listener from predecessor; gap "
+                    f"{gap_ms:.1f} ms, replayed {len(tail)} entries")))
+        self._emit(action="handoff", role="successor", gap_ms=gap_ms,
+                   n_replayed=len(tail), last_seq=int(meta["last_seq"]))
+        return self, listener, gap_ms
+
+    # -- observability -------------------------------------------------
+    def _emit(self, **ev) -> None:
+        ev = dict(session=self._fleet.fleet_id, **ev)
+        tr = current_tracer()
+        if tr is not None:
+            tr.emit("daemon", **ev)
+        else:
+            _live_observe({"t": time.perf_counter(), "kind": "daemon",
+                           **ev})
+
+    # -- admission -----------------------------------------------------
+    def _priority(self, tenant: str) -> int:
+        return int((self.config.priority or {}).get(tenant, 0))
+
+    def _shed_floor(self) -> Optional[int]:
+        """Priority class currently being sacrificed, or None.
+
+        When the SLO burn signal is FIRING, requests from the lowest
+        priority class are shed.  With a single class (nobody marked
+        out as less important) shedding everything would be a full
+        outage, so the single-class fleet sheds only when the queue is
+        ALSO at least half full — backpressure remains the first line.
+        Deterministic given (burn state, queue depth)."""
+        if not _slo_breached():
+            return None
+        prios = {self._priority(t) for t in self._est_s}
+        lo = min(prios)
+        if len(prios) == 1 and len(self._queue) < (self.config.queue_max
+                                                   + 1) // 2:
+            return None
+        return lo
+
+    def _queued_work_s(self) -> float:
+        return sum(self._est_s.get(tk.req.get("tenant"), 0.0)
+                   for tk in self._queue)
+
+    def _admit(self, req: dict):
+        """Admission under the queue lock: a response dict (rejection /
+        duplicate short-circuit) or an enqueued ticket."""
+        tenant = req.get("tenant")
+        if tenant not in self._est_s:
+            return {"ok": False,
+                    "error": f"unknown tenant {tenant!r} (fleet has "
+                             f"{sorted(self._est_s)})"}
+        rid = req.get("id")
+        self.n_requests += 1
+        if rid is not None and rid in self._served_ids:
+            # Idempotent retry (client reconnected after a crash or
+            # handoff): the state change already happened — answer the
+            # tenant's latest served result WITHOUT touching the fleet.
+            resp = dict(self._last_answer.get(
+                tenant, {"ok": True, "note": "already applied"}))
+            resp["duplicate"] = True
+            return resp
+        floor = self._shed_floor()
+        if floor is not None and self._priority(tenant) <= floor:
+            self.n_shed += 1
+            self.health.record(HealthEvent(
+                chunk=-1, iteration=self._journal.last_seq, kind="shed",
+                action="rejected", tenant=str(tenant),
+                session=self._fleet.fleet_id,
+                detail=(f"SLO burn firing; shed priority class "
+                        f"<= {floor} (queue depth "
+                        f"{len(self._queue)})")))
+            return {"ok": False, "shed": True, "tenant": tenant,
+                    "error": "overload: SLO burn firing and this "
+                             "tenant's priority class is being shed"}
+        depth = len(self._queue)
+        work = self._queued_work_s()
+        over_depth = depth >= self.config.queue_max
+        over_work = (self.config.work_max_s is not None
+                     and work + self._est_s[tenant]
+                     > self.config.work_max_s)
+        if over_depth or over_work:
+            retry = max(self.config.retry_after_floor_s, work)
+            self.n_backpressure += 1
+            self._emit(action="backpressure", tenant=tenant, depth=depth,
+                       queued_work_s=round(work, 6),
+                       retry_after_s=round(retry, 6))
+            return {"ok": False, "backpressure": True,
+                    "retry_after_s": retry, "depth": depth,
+                    "error": "queue full"
+                             if over_depth else "queued work over budget"}
+        tk = _Ticket(req)
+        self._queue.append(tk)
+        self._emit(action="request", tenant=tenant, op="submit",
+                   depth=len(self._queue))
+        self._have_work.notify_all()
+        return tk
+
+    # -- the pump ------------------------------------------------------
+    def _pump(self) -> int:
+        """Serve one batch: journal -> submit -> drain -> answer.
+        Returns the number of tickets answered.  Runs on whatever
+        thread calls it, always under ``_fleet_lock``."""
+        with self._lock:
+            batch = self._queue[:self.config.tick_requests]
+            del self._queue[:len(batch)]
+        if not batch:
+            return 0
+        with self._fleet_lock:
+            import numpy as np
+            # Validate + enqueue FIRST: a request the fleet rejects
+            # (bad row shape, capacity overrun) is answered as an error
+            # and never journaled — a journaled entry must replay
+            # cleanly on every future restart, so validation gates the
+            # journal, not the other way around.
+            accepted = []
+            for tk in batch:
+                rows = tk.req.get("rows")
+                mask = tk.req.get("mask")
+                try:
+                    self._fleet.submit(
+                        tk.req["tenant"],
+                        None if rows is None
+                        else np.asarray(rows, np.float64),
+                        mask=None if mask is None else np.asarray(mask))
+                except (ValueError, TypeError) as e:
+                    tk.resp = {"ok": False, "tenant": tk.req["tenant"],
+                               "error": f"rejected: {e}"}
+                    tk.done.set()
+                    continue
+                accepted.append(tk)
+            for tk in accepted:
+                # Durability before the state change: once journaled, a
+                # crash replays it; enqueued-but-unjournaled submits die
+                # with the process UNACKED (client retries, dedup holds).
+                tk.seq = self._journal.append(
+                    {k: tk.req.get(k) for k in ("id", "tenant", "rows",
+                                                "mask")})
+            if not accepted:
+                return len(batch)
+            try:
+                outs = self._fleet.drain()
+            except Exception as e:
+                # Fail-stop: a tick the guarded fleet could not serve
+                # leaves device state unknowable — answer everyone,
+                # stop, and let the supervisor restart us into a clean
+                # snapshot+journal replay (which DOES include this
+                # batch: it was journaled and will be applied).
+                self.health.record(HealthEvent(
+                    chunk=-1, iteration=self._journal.last_seq,
+                    kind="dispatch_error", action="fatal",
+                    session=self._fleet.fleet_id,
+                    detail=f"fleet tick failed: {e!r}; daemon stopping"))
+                for tk in accepted:
+                    tk.resp = {"ok": False,
+                               "error": f"fleet tick failed: {e!r}; "
+                                        "daemon restarting"}
+                    tk.done.set()
+                self._stopping = True
+                with self._lock:
+                    self._have_work.notify_all()
+                raise
+            by_tenant: Dict[str, list] = {t: list(u)
+                                          for t, u in outs.items()}
+            for tk in accepted:
+                upd = by_tenant[tk.req["tenant"]].pop(0)
+                resp = {
+                    "ok": True, "tenant": tk.req["tenant"],
+                    "t": int(upd.t), "n_iters": int(upd.n_iters),
+                    "converged": bool(upd.converged),
+                    "diverged": bool(upd.diverged),
+                    "nowcast": np.asarray(upd.nowcast).tolist(),
+                    "forecast_y": np.asarray(
+                        upd.forecasts["y"]).tolist(),
+                }
+                with self._lock:
+                    if tk.req.get("id") is not None:
+                        self._served_ids.add(tk.req["id"])
+                    self._last_answer[tk.req["tenant"]] = dict(resp)
+                    self.n_served += 1
+                    self._since_snapshot += 1
+                tk.resp = resp
+                tk.done.set()
+            if (self.config.snapshot_every
+                    and self.snapshot_dir
+                    and self._since_snapshot >= self.config.snapshot_every):
+                self._snapshot_locked()
+        return len(batch)
+
+    def _snapshot_locked(self, compact: bool = True) -> str:
+        """Snapshot (+ journal compaction) — caller holds ``_fleet_lock``.
+
+        ``compact=False`` is for the handoff's final snapshot: the
+        successor warmed from an OLDER snapshot and still needs the
+        journal entries between its warm watermark and ``last_seq`` to
+        replay the delta — compacting here would destroy exactly those.
+        The successor compacts at its own next snapshot cadence."""
+        wm = self._journal.last_seq
+        path = self._fleet.snapshot_all(self.snapshot_dir, journal_seq=wm)
+        if compact:
+            self._journal.compact(wm)
+        with self._lock:
+            self.n_snapshots += 1
+            self._since_snapshot = 0
+        return path
+
+    # -- request dispatch ----------------------------------------------
+    def handle(self, req: dict) -> dict:
+        """Process one protocol request to a response dict.  The socket
+        loop calls this per connection; tests call it directly (no
+        sockets) — identical code path either way."""
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": True,
+                    "fleet": self._fleet.fleet_id}
+        if op == "status":
+            return self.status()
+        if op == "submit":
+            with self._lock:
+                got = self._admit(req)
+            if isinstance(got, dict):
+                return got
+            if self._serve_thread is None:
+                self._pump()           # no pump thread: serve inline
+            if not got.done.wait(self.config.request_timeout_s):
+                return {"ok": False, "error": "request timed out in "
+                                              "queue"}
+            return got.resp
+        if op == "snapshot":
+            if not self.snapshot_dir:
+                return {"ok": False,
+                        "error": "daemon has no snapshot_dir"}
+            self._drain_queue()
+            with self._fleet_lock:
+                path = self._snapshot_locked()
+            return {"ok": True, "manifest": path,
+                    "journal_seq": self._journal.last_seq}
+        if op == "shutdown":
+            self._begin_drain()
+            self._drain_queue(wait_handlers=True)
+            if self.snapshot_dir:
+                with self._fleet_lock:
+                    self._snapshot_locked()
+            self._stopping = True
+            with self._lock:
+                self._have_work.notify_all()
+            return {"ok": True, "stopped": True,
+                    "last_seq": self._journal.last_seq}
+        if op == "handoff":
+            return self._handoff(req)
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _begin_drain(self):
+        self._fence_ack.clear()
+        self._accepting = False
+
+    def _drain_queue(self, wait_handlers: bool = False):
+        """Serve until the queue is empty (pump inline if no thread).
+
+        ``wait_handlers=True`` (handoff/shutdown) additionally waits for
+        every OTHER in-flight connection handler to finish: a request
+        that connected before the accept fence but has not enqueued yet
+        must be answered before the drain is complete.  First it waits
+        for the accept loop to ACKNOWLEDGE the fence — a connection it
+        accepted a microsecond before parking is counted in
+        ``_handlers`` before the acknowledgment, so the barrier below
+        cannot miss it."""
+        if wait_handlers and self._listener is not None:
+            self._fence_ack.wait(
+                timeout=10.0 * self.config.accept_poll_s + 5.0)
+        while True:
+            with self._lock:
+                busy = bool(self._queue) or (wait_handlers
+                                             and self._handlers > 1)
+            if not busy:
+                # Wait for an in-flight pump batch to finish answering.
+                with self._fleet_lock:
+                    pass
+                with self._lock:
+                    if not self._queue and not (wait_handlers
+                                                and self._handlers > 1):
+                        return
+                continue
+            if self._serve_thread is None:
+                self._pump()
+            else:
+                time.sleep(0.01)
+
+    def _handoff(self, req: dict) -> dict:
+        """Predecessor side of the blue/green swap: fence the accept
+        loop, drain every in-flight ticket, final snapshot, pass the
+        listener fd, stop."""
+        reply_to = req.get("reply_to")
+        if not reply_to:
+            return {"ok": False, "error": "handoff needs reply_to"}
+        if self._listener is None:
+            return {"ok": False, "error": "daemon has no listener to "
+                                          "hand off (not serving?)"}
+        if not self.snapshot_dir:
+            return {"ok": False, "error": "daemon has no snapshot_dir"}
+        self._begin_drain()
+        self._drain_queue(wait_handlers=True)
+        with self._fleet_lock:
+            self._snapshot_locked(compact=False)
+            # CLOCK_MONOTONIC is system-wide on one host: the successor
+            # (another process) subtracts it from its own reading to get
+            # the handoff gap.  perf_counter's epoch is per-process and
+            # time.time() steps under NTP — both would lie here.
+            t_stop = time.clock_gettime(time.CLOCK_MONOTONIC)
+            meta = {"last_seq": self._journal.last_seq, "t_stop": t_stop,
+                    "snapshot_dir": self.snapshot_dir}
+            try:
+                send_listener(reply_to, self._listener, meta)
+            except OSError as e:
+                self._accepting = True   # successor gone: keep serving
+                return {"ok": False,
+                        "error": f"handoff fd transfer to {reply_to!r} "
+                                 f"failed: {e!r}"}
+        self.n_handoffs += 1
+        self.health.record(HealthEvent(
+            chunk=-1, iteration=self._journal.last_seq, kind="handoff",
+            action="released", session=self._fleet.fleet_id,
+            detail=f"listener passed to {reply_to!r}; drained + "
+                   "snapshotted"))
+        self._emit(action="handoff", role="predecessor",
+                   last_seq=self._journal.last_seq)
+        self._stopping = True
+        with self._lock:
+            self._have_work.notify_all()
+        return {"ok": True, "last_seq": self._journal.last_seq,
+                "t_stop": t_stop}
+
+    def status(self) -> dict:
+        from ..obs.live import plane
+        with self._lock:
+            depth = len(self._queue)
+            work = self._queued_work_s()
+        return {
+            "ok": True, "fleet": self._fleet.fleet_id,
+            "tenants": sorted(self._est_s),
+            "tiers": {t: self._fleet.tier(t) for t in self._est_s},
+            "queue_depth": depth, "queued_work_s": work,
+            "queue_max": self.config.queue_max,
+            "n_requests": self.n_requests, "n_served": self.n_served,
+            "n_backpressure": self.n_backpressure,
+            "n_shed": self.n_shed, "n_snapshots": self.n_snapshots,
+            "n_handoffs": self.n_handoffs,
+            "journal_seq": self._journal.last_seq,
+            "slo": plane().slo.status(),
+        }
+
+    # -- socket serving -------------------------------------------------
+    def _serve_loop(self):
+        while not self._stopping:
+            with self._lock:
+                if not self._queue:
+                    self._have_work.wait(timeout=0.2)
+                has = bool(self._queue)
+            if has:
+                self._pump()
+
+    def _handle_conn(self, conn: socket.socket):
+        # NB: self._handlers was incremented by the ACCEPT loop before
+        # this thread was spawned — counting here instead would leave a
+        # window where a just-accepted connection is invisible to the
+        # handoff/shutdown drain barrier (which waits on _handlers),
+        # letting the predecessor close the fleet under our feet.
+        try:
+            conn.settimeout(self.config.request_timeout_s)
+            req = recv_json(conn)
+            if req is not None:
+                try:
+                    resp = self.handle(req)
+                except Exception as e:   # answer, don't drop the conn
+                    resp = {"ok": False, "error": f"internal: {e!r}"}
+                send_json(conn, resp)
+        except (OSError, ValueError):
+            pass                      # client went away mid-request
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                self._handlers -= 1
+
+    def serve_forever(self, listener: socket.socket) -> None:
+        """Serve until ``shutdown`` or a completed handoff.  Owns the
+        accept loop; the pump runs on a dedicated thread so a slow
+        fleet tick never blocks accepting (admission keeps rejecting
+        above the bounded queue)."""
+        self._listener = listener
+        self._accepting = True
+        self._stopping = False
+        self._serve_thread = threading.Thread(
+            target=self._serve_loop, name="dfm-daemon-pump", daemon=True)
+        self._serve_thread.start()
+        listener.settimeout(self.config.accept_poll_s)
+        try:
+            while not self._stopping:
+                if not self._accepting:
+                    self._fence_ack.set()
+                    time.sleep(self.config.accept_poll_s)
+                    continue
+                try:
+                    conn, _ = listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                with self._lock:
+                    self._handlers += 1
+                threading.Thread(target=self._handle_conn,
+                                 args=(conn,), daemon=True).start()
+        finally:
+            self._stopping = True
+            self._fence_ack.set()
+            with self._lock:
+                self._have_work.notify_all()
+            self._serve_thread.join(timeout=5.0)
+            self._serve_thread = None
+            try:
+                listener.close()     # fd was dup'd to a successor on
+            except OSError:          # handoff; closing ours is safe
+                pass
+            self._listener = None
+
+    def close(self):
+        self._stopping = True
+        self._journal.close()
+        self._fleet.close()

@@ -15,7 +15,8 @@ evicted to a lone guarded session without stalling its bucket-mates.
 
 from .admission import ClassAssignment, fleet_pad_waste, plan_admission
 from .buffers import FleetBucket, TenantSlot
-from .driver import SessionFleet, open_fleet
+from .driver import SessionFleet, open_fleet, read_manifest, restore_fleet
 
-__all__ = ["SessionFleet", "open_fleet", "FleetBucket", "TenantSlot",
-           "ClassAssignment", "plan_admission", "fleet_pad_waste"]
+__all__ = ["SessionFleet", "open_fleet", "restore_fleet", "read_manifest",
+           "FleetBucket", "TenantSlot", "ClassAssignment",
+           "plan_admission", "fleet_pad_waste"]

@@ -39,9 +39,12 @@ quarantines the whole bucket the same way, from last-good shadows.
 from __future__ import annotations
 
 import itertools
+import json
+import os
+import tempfile
 import time
 import warnings
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -60,7 +63,11 @@ from .admission import (fleet_pad_waste, plan_admission, plan_residency,
                         readmission_cost_s)
 from .buffers import FleetBucket
 
-__all__ = ["SessionFleet", "open_fleet"]
+__all__ = ["SessionFleet", "open_fleet", "restore_fleet"]
+
+# On-disk fleet snapshot layout (manifest.json + one npz per tenant).
+# Bump on incompatible change; restore refuses FUTURE versions loudly.
+FLEET_SNAPSHOT_FORMAT = 1
 
 _FLEET_IDS = itertools.count(1)
 
@@ -507,12 +514,18 @@ class SessionFleet:
         else:
             live_observe({"t": time.perf_counter(), "kind": "page", **ev})
 
-    def drain(self) -> Dict[str, List[SessionUpdate]]:
+    def drain(self, *, on_tick: Optional[Callable] = None
+              ) -> Dict[str, List[SessionUpdate]]:
         """Serve the whole queue: repeated TICKS (one fused dispatch per
         bucket with work, each answering every member's next query) until
         empty.  Returns per-tenant ``SessionUpdate`` lists in submit
         order.  Quarantined tenants' queries route to their lone evicted
-        sessions (guarded there)."""
+        sessions (guarded there).
+
+        ``on_tick``: host-side hook called with the fleet after each tick
+        ROUND (every bucket that had work this round has answered) — the
+        daemon's seam for mid-drain snapshots/journal watermarks on long
+        queues.  Runs between dispatches, never inside one."""
         self._check_open()
         out: Dict[str, List[SessionUpdate]] = {}
         while self._pending:
@@ -544,6 +557,8 @@ class SessionFleet:
                 served.extend(lane_q.values())
             self._pending = [q for q in self._pending
                              if q not in served]
+            if on_tick is not None:
+                on_tick(self)
         return out
 
     # -- the tick ------------------------------------------------------
@@ -850,6 +865,110 @@ class SessionFleet:
             slot.evict_orig(slot.t - slot.capacity)
         return upd
 
+    # -- durability ----------------------------------------------------
+    def _slot_params_np(self, bucket, slot):
+        """Current params of one tenant, sliced to its true (N, k) —
+        wherever the tenant lives (hot lane d2h, parked warm shadow,
+        cold npz, or its lone quarantine session)."""
+        from ..backends.cpu_ref import SSMParams
+        from ..utils.checkpoint import _FIELDS
+        if slot.quarantined:
+            return slot.evicted.params()
+        if slot.tier == "hot":
+            p_pad = bucket.params_host()[slot.lane]
+        elif slot.tier == "warm":
+            p_pad = slot.warm_p
+        else:                           # cold: read without thawing
+            with np.load(slot.cold_path) as z:
+                p_pad = SSMParams(*(np.asarray(z[f], np.float64)
+                                    for f in _FIELDS))
+        return slice_params_to_n(slice_params_to_k(p_pad, slot.k), slot.N)
+
+    def snapshot_all(self, dir_path: str,
+                     journal_seq: Optional[int] = None) -> str:
+        """Fleet-wide durable snapshot: one atomic fingerprint-stamped
+        npz per tenant (params + original-units live panel + budgets,
+        via ``utils.checkpoint.save_checkpoint`` — tmp + fsync + rename)
+        plus an atomic ``manifest.json`` naming every file, its content
+        fingerprint and the fleet-level config.  ``journal_seq`` is the
+        daemon's request-journal watermark: a restart restores the
+        snapshot then replays only entries after it.  Restore with
+        :func:`restore_fleet`; restored answers are bit-equal to the
+        uninterrupted fleet's (pinned by tests/test_daemon.py).  Pending
+        queries are NOT snapshotted — drain first (the daemon journals
+        requests before submitting, so nothing is lost)."""
+        from ..utils.checkpoint import (SNAPSHOT_SCHEMA_VERSION, fsync_dir,
+                                        panel_fingerprint, save_checkpoint)
+        self._check_open()
+        if self._pending:
+            raise RuntimeError(
+                f"{len(self._pending)} queries still pending; drain() "
+                "before snapshot_all (the snapshot holds served state "
+                "only)")
+        os.makedirs(dir_path, exist_ok=True)
+        tenants = []
+        for name, (bucket, slot) in self._slot_of.items():
+            p = self._slot_params_np(bucket, slot)
+            fp = panel_fingerprint(slot.Y_orig, slot.W_orig)
+            fname = f"tenant-{name}.npz"
+            m = slot.model
+            save_checkpoint(
+                os.path.join(dir_path, fname), p, it=slot.t, logliks=[],
+                fingerprint=fp, converged=False,
+                extra={
+                    "fleet_tenant_format": 1,
+                    "Y_orig": slot.Y_orig, "W_orig": slot.W_orig,
+                    "std_mean": (slot.std.mean if slot.std is not None
+                                 else np.zeros(0)),
+                    "std_scale": (slot.std.scale if slot.std is not None
+                                  else np.zeros(0)),
+                    "model_n_factors": m.n_factors,
+                    "model_dynamics": m.dynamics,
+                    "model_standardize": m.standardize,
+                    "model_estimate_init": m.estimate_init,
+                })
+            tenants.append({
+                "name": name, "file": fname, "fingerprint": fp,
+                "capacity": int(slot.capacity),
+                "max_iters": int(slot.max_iters), "tol": float(slot.tol),
+                "t": int(slot.t), "t_total": int(slot.t_total),
+                "n_queries": int(slot.n_queries),
+                "was_quarantined": bool(slot.quarantined),
+            })
+        manifest = {
+            "fleet_snapshot_format": FLEET_SNAPSHOT_FORMAT,
+            "schema_version": SNAPSHOT_SCHEMA_VERSION,
+            "fleet_id": self._fid,
+            "tenants": tenants,
+            "horizon": int(self._opts.horizon), "di": bool(self._opts.di),
+            "ring": bool(self._ring), "max_update_rows": int(self._r_max),
+            "journal_seq": (None if journal_seq is None
+                            else int(journal_seq)),
+        }
+        mpath = os.path.join(dir_path, "manifest.json")
+        fd, tmp = tempfile.mkstemp(dir=dir_path, suffix=".json.tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(manifest, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, mpath)
+            fsync_dir(dir_path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        ev = dict(session=self._fid, action="snapshot", dir=dir_path,
+                  n_tenants=len(tenants),
+                  **({} if journal_seq is None
+                     else {"journal_seq": int(journal_seq)}))
+        tr = current_tracer()
+        if tr is not None:
+            tr.emit("daemon", **ev)
+        else:
+            live_observe({"t": time.perf_counter(), "kind": "daemon", **ev})
+        return mpath
+
     # -- lifecycle -----------------------------------------------------
     def close(self):
         """Release the device buffers; further submits/drains raise."""
@@ -907,3 +1026,94 @@ def open_fleet(results, panels, masks=None, **kwargs) -> SessionFleet:
                       (default: ambient ``DFM_RUNS`` / ``.dfm_runs``).
     """
     return SessionFleet(results, panels, masks, **kwargs)
+
+
+def read_manifest(dir_path: str) -> dict:
+    """Load + validate a ``snapshot_all`` manifest (schema-checked)."""
+    mpath = os.path.join(dir_path, "manifest.json")
+    with open(mpath, "r", encoding="utf-8") as f:
+        manifest = json.load(f)
+    if "fleet_snapshot_format" not in manifest:
+        raise ValueError(f"{mpath!r} is not a fleet snapshot manifest")
+    from ..utils.checkpoint import check_schema_version
+    check_schema_version(manifest, mpath)
+    if int(manifest["fleet_snapshot_format"]) > FLEET_SNAPSHOT_FORMAT:
+        raise ValueError(
+            f"fleet snapshot {dir_path!r} carries fleet_snapshot_format="
+            f"{manifest['fleet_snapshot_format']}, this build reads "
+            f"<= {FLEET_SNAPSHOT_FORMAT}")
+    return manifest
+
+
+def restore_fleet(dir_path: str, **kwargs) -> SessionFleet:
+    """Rebuild a warm fleet from ``SessionFleet.snapshot_all(dir_path)``.
+
+    Every tenant npz is verified against its manifest content
+    fingerprint before use (a corrupt or hand-edited snapshot fails
+    loudly, naming the tenant); the restored per-tenant device state is
+    the padded image of the exact saved f64 params + original-units
+    panels, so answers are bit-equal to the uninterrupted fleet's.
+    Tenants that were quarantined at snapshot time re-admit onto fresh
+    lanes (their saved params came from the lone session, so their
+    trajectory continues exactly; the manifest records
+    ``was_quarantined`` for the forensic trail).
+
+    ``kwargs`` pass through to :func:`open_fleet` (``backend=``,
+    ``robust=``, ``resident=``, ``max_classes=``, ``runs=``); fleet
+    geometry (capacity / budgets / horizon / ring / max_update_rows)
+    always comes from the manifest."""
+    from ..api import DynamicFactorModel, FitResult
+    from ..backends.cpu_ref import SSMParams
+    from ..utils.checkpoint import (_FIELDS, check_schema_version,
+                                    panel_fingerprint)
+    from ..utils.data import Standardizer
+    manifest = read_manifest(dir_path)
+    results, panels, masks, names = [], [], [], []
+    caps, m_its, tols = [], [], []
+    for ten in manifest["tenants"]:
+        path = os.path.join(dir_path, ten["file"])
+        with np.load(path) as z:
+            check_schema_version(z, path)
+            if "fleet_tenant_format" not in z.files:
+                raise ValueError(
+                    f"{path!r} is not a fleet tenant snapshot")
+            p = SSMParams(*(np.asarray(z[f], np.float64) for f in _FIELDS))
+            Y = np.asarray(z["Y_orig"], np.float64)
+            W = np.asarray(z["W_orig"], np.float64)
+            mean = np.asarray(z["std_mean"], np.float64)
+            scale = np.asarray(z["std_scale"], np.float64)
+            model = DynamicFactorModel(
+                n_factors=int(z["model_n_factors"][()]),
+                dynamics=str(z["model_dynamics"]),
+                standardize=bool(z["model_standardize"][()]),
+                estimate_init=bool(z["model_estimate_init"][()]))
+        if panel_fingerprint(Y, W) != ten["fingerprint"]:
+            raise ValueError(
+                f"fleet snapshot tenant {ten['name']!r} is corrupt: the "
+                f"stored panel in {path!r} does not match the manifest "
+                "content fingerprint")
+        std = (Standardizer(mean=mean, scale=scale) if mean.size
+               else None)
+        results.append(FitResult(
+            params=p, logliks=np.zeros(0),
+            factors=np.zeros((0, p.A.shape[0])),
+            factor_cov=np.zeros((0, p.A.shape[0], p.A.shape[0])),
+            converged=False, n_iters=0, standardizer=std, model=model,
+            backend="tpu", history=[]))
+        panels.append(Y)
+        masks.append(W)
+        names.append(ten["name"])
+        caps.append(int(ten["capacity"]))
+        m_its.append(int(ten["max_iters"]))
+        tols.append(float(ten["tol"]))
+    fleet = open_fleet(
+        results, panels, masks, tenants=names, capacity=caps,
+        max_iters=m_its, tol=tols, horizon=int(manifest["horizon"]),
+        di=bool(manifest["di"]), ring=bool(manifest["ring"]),
+        max_update_rows=int(manifest["max_update_rows"]), **kwargs)
+    # Stream-position ledger (ring eviction counts) survives the restart.
+    for ten in manifest["tenants"]:
+        _, slot = fleet._slot_of[ten["name"]]
+        slot.t_total = int(ten["t_total"])
+        slot.n_queries = int(ten["n_queries"])
+    return fleet

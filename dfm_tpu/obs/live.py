@@ -80,6 +80,8 @@ class LivePlane:
         self.snapshot_path = snapshot_path
         self.snapshot_interval_s = float(snapshot_interval_s)
         self.errors = 0
+        self._flight_warned = False      # warn-once on unwritable dir
+        self._flight_broken = False      # auto-dumps disabled after OSError
         self._dump_seq = 0
         self._last_dump_t: Optional[float] = None
         self._last_snap_t: Optional[float] = None
@@ -189,20 +191,41 @@ class LivePlane:
 
     def dump_flight(self, path: Optional[str] = None) -> Optional[str]:
         """Write the ring to an ``obs.report``-compatible JSONL; returns
-        the path (None when no destination is configured)."""
-        if path is None:
-            if not self.flight_dir:
+        the path (None when no destination is configured or the
+        destination is unwritable).  A missing/unwritable ``flight_dir``
+        warns ONCE per plane and disables further auto-dumps — a breach
+        forensics failure must never raise into (or block) the serving
+        path that triggered it."""
+        auto = path is None
+        if auto:
+            if not self.flight_dir or self._flight_broken:
                 return None
-            os.makedirs(self.flight_dir, exist_ok=True)
-            self._dump_seq += 1
-            path = os.path.join(
-                self.flight_dir,
-                f"flight-{os.getpid()}-{self._dump_seq}.jsonl")
         with self._lock:
             events = list(self.ring)
-        with open(path, "w", encoding="utf-8") as fh:
-            for ev in events:
-                fh.write(json.dumps(ev, default=_json_default) + "\n")
+        try:
+            if auto:
+                os.makedirs(self.flight_dir, exist_ok=True)
+                self._dump_seq += 1
+                path = os.path.join(
+                    self.flight_dir,
+                    f"flight-{os.getpid()}-{self._dump_seq}.jsonl")
+            with open(path, "w", encoding="utf-8") as fh:
+                for ev in events:
+                    fh.write(json.dumps(ev, default=_json_default) + "\n")
+        except OSError as e:
+            self.errors += 1
+            if auto:
+                self._flight_broken = True
+            if not self._flight_warned:
+                self._flight_warned = True
+                import warnings
+                warnings.warn(
+                    f"flight-recorder dump to {path or self.flight_dir!r} "
+                    f"failed ({e}); serving continues, "
+                    + ("further auto-dumps are disabled for this process"
+                       if auto else "this dump was skipped"),
+                    RuntimeWarning, stacklevel=2)
+            return None
         self.flight_dumps += 1
         return path
 

@@ -272,7 +272,7 @@ def _unflat(flat: str) -> LabelKey:
 # -- per-tenant accounting ledger ----------------------------------------
 
 LEDGER_FIELDS = ("queries", "jobs", "device_ms", "em_iters", "est_flops",
-                 "retries", "degraded", "quarantined",
+                 "retries", "degraded", "quarantined", "shed",
                  "pad_waste_sum", "pad_waste_n")
 
 
@@ -470,10 +470,42 @@ def record_event(registry: MetricsRegistry, ledger: Optional[Ledger],
                 row["retries"] += 1
             if event == "quarantine":
                 row["quarantined"] += 1
+            if event == "shed":
+                row["shed"] += 1
         if event == "dispatch_error" and ev.get("action") == "retried":
             registry.counter("dispatch_retries_total").inc()
         if event == "quarantine":
             registry.counter("quarantines_total").inc()
+        if event == "shed":
+            registry.counter("sheds_total",
+                             tenant=str(ten or "-")).inc()
+    elif kind == "daemon":
+        # The serving daemon's front door (dfm_tpu/daemon/): admission,
+        # durability and handoff events share one kind with an
+        # ``action`` discriminator.
+        fid = str(ev.get("session", "-"))
+        action = str(ev.get("action", "?"))
+        registry.counter("daemon_events_total", fleet=fid,
+                         action=action).inc()
+        depth = _num(ev.get("depth"))
+        if depth is not None and action in ("request", "backpressure"):
+            registry.histogram("daemon_queue_depth", fleet=fid).observe(
+                depth)
+        if action == "backpressure":
+            ra = _num(ev.get("retry_after_s"))
+            if ra is not None:
+                registry.histogram("daemon_retry_after_ms",
+                                   fleet=fid).observe(ra * 1e3)
+        if action == "handoff":
+            gap = _num(ev.get("gap_ms"))
+            if gap is not None:
+                registry.histogram("daemon_handoff_gap_ms",
+                                   fleet=fid).observe(gap)
+        if action == "replay":
+            n = _num(ev.get("n_entries"))
+            if n:
+                registry.counter("daemon_replayed_total",
+                                 fleet=fid).inc(n)
     elif kind == "page":
         fid = str(ev.get("session", "-"))
         action = str(ev.get("action", "?"))

@@ -29,7 +29,8 @@ duration lists needed for exact nearest-rank percentiles are kept).
 
 The JSON summary schema is versioned (top-level ``schema_version``) and
 the ``tenants`` / ``tenant_fairness`` / ``queries`` / ``fleet`` /
-``robustness`` / ``metrics`` sections are always present with stable keys,
+``daemon`` / ``robustness`` / ``metrics`` sections are always present
+with stable keys,
 empty or not.
 
 ``--chrome out.json`` additionally exports the raw event stream to
@@ -176,6 +177,13 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
     n_evicting_q = 0
     page_counts: dict = {}
     admit_walls: List[float] = []
+    # serving daemon (dfm_tpu/daemon/ front door)
+    dm_counts: dict = {}
+    dm_depths: List[float] = []
+    dm_gaps: List[float] = []
+    dm_replayed = 0
+    dm_tenant: dict = {}
+    n_shed = 0
 
     for e in _event_stream(events_or_path):
         n_events += 1
@@ -297,6 +305,23 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
             page_counts[act] = page_counts.get(act, 0) + 1
             if act == "admit" and isinstance(e.get("wall"), (int, float)):
                 admit_walls.append(float(e["wall"]))
+        elif kind == "daemon":
+            act = str(e.get("action", "?"))
+            dm_counts[act] = dm_counts.get(act, 0) + 1
+            if (act in ("request", "backpressure")
+                    and isinstance(e.get("depth"), (int, float))):
+                dm_depths.append(float(e["depth"]))
+            if act == "handoff" and isinstance(e.get("gap_ms"),
+                                               (int, float)):
+                dm_gaps.append(float(e["gap_ms"]))
+            if act == "replay":
+                dm_replayed += int(e.get("n_entries") or 0)
+            ten = e.get("tenant")
+            if ten is not None and act in ("request", "backpressure"):
+                pt = dm_tenant.setdefault(str(ten), {
+                    "requests": 0, "backpressure": 0, "shed": 0})
+                pt["requests" if act == "request"
+                   else "backpressure"] += 1
         elif kind == "health":
             n_health += 1
             health_kinds.add(e.get("event", e.get("name", "?")))
@@ -307,6 +332,11 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
             n_quar += e.get("event") == "quarantine"
             n_recovered += (e.get("event") == "divergence"
                             and e.get("action") in ("restored", "repaired"))
+            if e.get("event") == "shed":
+                n_shed += 1
+                pt = dm_tenant.setdefault(str(e.get("tenant", "?")), {
+                    "requests": 0, "backpressure": 0, "shed": 0})
+                pt["shed"] += 1
             ten = e.get("tenant")
             if ten:
                 pt = rb_tenant.setdefault(str(ten), {
@@ -509,6 +539,23 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
             "readmission_s": _stats(admit_walls),
         },
     }
+    # Serving daemon (dfm_tpu/daemon/): the front door's admission and
+    # lifecycle trail — accepted requests with queue depth at enqueue,
+    # deterministic backpressure, SLO-burn load-sheds (HealthEvents, so
+    # they also land in the robustness section), snapshots, journal
+    # replays, and blue/green handoffs with the gap each one cost.
+    out["daemon"] = {
+        "n_requests": dm_counts.get("request", 0),
+        "n_backpressure": dm_counts.get("backpressure", 0),
+        "n_shed": n_shed,
+        "n_snapshots": dm_counts.get("snapshot", 0),
+        "n_replays": dm_counts.get("replay", 0),
+        "n_replayed_entries": dm_replayed,
+        "n_handoffs": dm_counts.get("handoff", 0),
+        "queue_depth": _stats(dm_depths),
+        "handoff_gap_ms": _stats(dm_gaps),
+        "per_tenant": dm_tenant,
+    }
     # Serving-grade fault tolerance (robust.dispatch / sched quarantine /
     # self-healing sessions): the guard's forensic trail aggregated next
     # to the fairness/queries tables — retries + backoff paid, tenants
@@ -623,6 +670,32 @@ def _print_text(s: dict) -> None:
     if "health_events" in s:
         print(f"health: {s['health_events']} events "
               f"({', '.join(s['health_kinds'])})")
+    dm = s.get("daemon")
+    if dm and (dm["n_requests"] or dm["n_backpressure"] or dm["n_shed"]
+               or dm["n_handoffs"] or dm["n_replays"]):
+        line = (f"daemon: {dm['n_requests']} requests, "
+                f"{dm['n_backpressure']} backpressure, "
+                f"{dm['n_shed']} shed, {dm['n_snapshots']} snapshots")
+        qd = dm.get("queue_depth") or {}
+        if qd:
+            line += (f"; queue depth p50 {qd['p50']:.0f} / "
+                     f"p99 {qd['p99']:.0f}")
+        print(line)
+        if dm["n_handoffs"] or dm["n_replays"]:
+            line = (f"  lifecycle: {dm['n_handoffs']} "
+                    f"handoff{'s' if dm['n_handoffs'] != 1 else ''}, "
+                    f"{dm['n_replays']} "
+                    f"replay{'s' if dm['n_replays'] != 1 else ''} "
+                    f"({dm['n_replayed_entries']} entries)")
+            hg = dm.get("handoff_gap_ms") or {}
+            if hg:
+                line += f"; handoff gap p99 {hg['p99']:.1f} ms"
+            print(line)
+        for tid, pt in dm.get("per_tenant", {}).items():
+            if pt["backpressure"] or pt["shed"]:
+                print(f"  {tid:12s} {pt['requests']} accepted, "
+                      f"{pt['backpressure']} backpressure, "
+                      f"{pt['shed']} shed")
     rb = s.get("robustness")
     if rb and (rb["dispatch_retries"] or rb["quarantines"]
                or rb["recovered_divergences"] or rb["degraded_queries"]

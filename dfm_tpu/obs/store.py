@@ -47,7 +47,7 @@ _LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
                          "rel_err", "calib_err", "blocking_transfers",
                          "dispatches_per_fit", "pad_waste", "degraded",
                          "slo_burn_rate", "flight_dumps", "noise_ratio",
-                         "evictions_per")
+                         "evictions_per", "shed_rate", "dropped_queries")
 
 
 def lower_is_better(metric: str) -> bool:
@@ -84,6 +84,15 @@ _NOISE_FLOORS = (
     # Ring-buffer evictions per query (bench.stream) track the workload
     # (rows/query), not a perf quality — only a whole-row move is signal.
     ("evictions_per", 0.5),
+    # Daemon overload shed fraction (bench.daemon): the overload leg
+    # MEANS to shed — the rate tracks thread-timing of the synthetic
+    # burst, so only a several-point move is a policy-level signal.
+    ("shed_rate", 0.05),
+    # Dropped queries are the zero-downtime contract itself: any drop is
+    # signal (floor 0 by omission — the 0.5 integer-count convention
+    # would forgive exactly the single dropped query the gate exists to
+    # catch).
+    ("dropped_queries", 0.0),
     ("ms", 2.0),           # milliseconds: ms_per, _ms, dispatch_ms_...
     ("_s", 0.05),          # seconds: wall_s, dispatch_s, compile_s, time_s
     ("secs", 0.05),
@@ -310,6 +319,13 @@ _BENCH_NUMERIC_KEYS = (
     # MF m~25 fit the exact path cannot compile on axon ("_s" floor).
     "kscale_speedup_k10", "kscale_speedup_k25", "kscale_speedup_k50",
     "kscale_speedup_k100", "kscale_calib_err", "kscale_mf_m25_wall_s",
+    # Serving daemon (bench.daemon): socket-level throughput/latency are
+    # the headline (qps higher-is-better; p99 rides the "ms" rows), the
+    # overload leg's shed fraction has its own marker/floor rows, the
+    # blue/green swap gap rides "ms", and dropped_queries is the
+    # zero-downtime contract (any drop regresses).
+    "daemon_qps", "daemon_p99_ms", "daemon_shed_rate",
+    "daemon_handoff_gap_ms", "daemon_dropped_queries",
 )
 
 
@@ -376,7 +392,8 @@ def _backfill_kind(src: str) -> str:
     stem = src[len("BENCH_"):].split(".")[0].rstrip("0123456789_")
     family = {"stream": "bench_stream", "longt": "bench_longt",
               "kscale": "bench_kscale", "serve": "bench_serve",
-              "mixed": "bench_mixed", "fleet": "bench_fleet"}
+              "mixed": "bench_mixed", "fleet": "bench_fleet",
+              "daemon": "bench_daemon"}
     return family.get(stem, "bench")
 
 

@@ -92,6 +92,38 @@ class RobustPolicy:
     # program, session update — FaultInjector.wrap_call lives here).
     wrap_dispatch: Optional[Callable] = None
 
+    def __post_init__(self):
+        # Fail at construction, naming the field — a nonsensical knob
+        # otherwise only surfaces deep inside guarded_dispatch, mid-fit.
+        def bad(field, want):
+            raise ValueError(f"RobustPolicy.{field} {want}; got "
+                             f"{getattr(self, field)!r}")
+        for field in ("dispatch_retries", "chunk_retries", "iter_offset"):
+            if int(getattr(self, field)) < 0:
+                bad(field, "must be >= 0")
+        if self.backoff_base < 0:
+            bad("backoff_base", "is a delay in seconds and must be >= 0")
+        if self.backoff_factor < 1.0:
+            bad("backoff_factor", "must be >= 1.0 (backoff never shrinks)")
+        if self.dispatch_deadline_s is not None \
+                and not self.dispatch_deadline_s > 0:
+            bad("dispatch_deadline_s", "must be None (no watchdog) or > 0 "
+                "seconds")
+        if int(self.stall_chunks) < 1:
+            bad("stall_chunks", "must be >= 1")
+        if not self.freeze_threshold > 0:
+            bad("freeze_threshold", "must be > 0")
+        if self.psd_tol < 0 or self.r_floor < 0:
+            bad("psd_tol" if self.psd_tol < 0 else "r_floor",
+                "must be >= 0")
+        allowed = {"freeze_action": ("auto", "remeasure_tau",
+                                     "fallback_info", "warn"),
+                   "check_params": ("on_event", "always", "never"),
+                   "on_failure": ("raise", "cpu")}
+        for field, opts in allowed.items():
+            if getattr(self, field) not in opts:
+                bad(field, f"must be one of {opts}")
+
 
 class GuardControls:
     """Backend hooks the guard escalates through.

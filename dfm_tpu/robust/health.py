@@ -24,6 +24,13 @@ __all__ = ["HealthEvent", "FitHealth", "health_from_trace"]
 #   r_floor         R entries pinned at the EM floor
 #   nonfinite_params  NaN/inf in the parameter pytree itself
 #   dispatch_error  device dispatch raised (tunnel error / timeout)
+# The live plane (obs/live.py) adds:
+#   slo_burn        SLO error-budget burn crossed fire/clear hysteresis
+#   latency_anomaly p99 spike vs the rolling baseline
+# The serving daemon (dfm_tpu/daemon/) adds:
+#   shed            overload load-shed: a request rejected while the SLO
+#                   burn signal fired (lowest-priority tenants first)
+#   handoff         blue/green listener handoff (detail carries gap_ms)
 
 
 @dataclasses.dataclass

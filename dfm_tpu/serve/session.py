@@ -679,12 +679,14 @@ class NowcastSession:
         from ..api import (CPUBackend, DynamicFactorModel, _resolve_policy,
                            get_backend)
         from ..backends.cpu_ref import SSMParams
-        from ..utils.checkpoint import _FIELDS, panel_fingerprint
+        from ..utils.checkpoint import (_FIELDS, check_schema_version,
+                                        panel_fingerprint)
         meta_keys = ("capacity", "max_update_rows", "max_iters", "tol",
                      "horizon", "di", "n_queries", "model_n_factors",
                      "model_dynamics", "model_standardize",
                      "model_estimate_init")
         with np.load(path) as z:
+            check_schema_version(z, path)
             if "session_format" not in z.files:
                 raise ValueError(
                     f"{path!r} is not a session snapshot (no "

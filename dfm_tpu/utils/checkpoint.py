@@ -27,9 +27,52 @@ import numpy as np
 from ..backends.cpu_ref import SSMParams
 
 __all__ = ["save_checkpoint", "load_checkpoint", "data_fingerprint",
-           "warm_fingerprint", "panel_fingerprint", "panel_mismatch"]
+           "warm_fingerprint", "panel_fingerprint", "panel_mismatch",
+           "SNAPSHOT_SCHEMA_VERSION", "check_schema_version",
+           "fsync_dir"]
 
 _FIELDS = ("Lam", "A", "Q", "R", "mu0", "P0")
+
+# Stamped into every npz this module writes.  Bump when the on-disk
+# layout changes incompatibly; readers refuse FUTURE versions loudly
+# (check_schema_version) instead of surfacing a format drift as an
+# opaque KeyError deep in restore.
+SNAPSHOT_SCHEMA_VERSION = 1
+
+
+def check_schema_version(z, path: str) -> None:
+    """Refuse snapshots written by a future schema, naming both versions.
+
+    ``z`` is an open ``np.load`` handle (or any mapping with ``in`` /
+    ``__getitem__``).  Files WITHOUT a stamp (pre-versioning) are
+    accepted — they predate the scheme and their layout is version 1.
+    Raises ``ValueError`` so callers that normally swallow corrupt files
+    must re-raise it explicitly (a version refusal is actionable, a torn
+    file is not)."""
+    if "schema_version" not in z:
+        return
+    found = int(np.asarray(z["schema_version"]))
+    if found > SNAPSHOT_SCHEMA_VERSION:
+        raise ValueError(
+            f"snapshot {path!r} carries schema_version={found}, but this "
+            f"build reads schema_version<={SNAPSHOT_SCHEMA_VERSION}; it was "
+            "written by a newer dfm_tpu — upgrade this process (or re-write "
+            "the snapshot with the older build) instead of guessing at the "
+            "layout")
+
+
+def fsync_dir(d: str) -> None:
+    """Best-effort fsync of a directory entry (makes a rename durable)."""
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def data_fingerprint(Y: np.ndarray, mask, model) -> str:
@@ -102,12 +145,17 @@ def save_checkpoint(path: str, params, it: int, logliks,
                     fingerprint: Optional[str] = None,
                     converged: bool = False,
                     extra: Optional[dict] = None) -> None:
-    """Atomic write (tmp + rename) of EM state.
+    """Atomic durable write (tmp + fsync + rename) of EM state.
 
     ``extra``: additional arrays merged into the npz under their own keys
     (the serve-session snapshot stores its live panel + config here);
     ``load_checkpoint`` reads only the EM fields and ignores extras, so
-    a session snapshot is ALSO a valid warm-start checkpoint."""
+    a session snapshot is ALSO a valid warm-start checkpoint.
+
+    The tmp file is fsync'd before the rename and the directory entry
+    after it, so a crash at ANY point leaves either the old snapshot or
+    the new one — never a truncated npz.  Every file is stamped with
+    ``schema_version`` (see ``check_schema_version``)."""
     arrays = {f: np.asarray(getattr(params, f), np.float64) for f in _FIELDS}
     arrays["iter"] = np.asarray(it)
     arrays["logliks"] = np.asarray(logliks, np.float64)
@@ -119,13 +167,17 @@ def save_checkpoint(path: str, params, it: int, logliks,
             raise ValueError(f"extra key {k!r} collides with an EM "
                              f"checkpoint field")
         arrays[k] = np.asarray(v)
+    arrays.setdefault("schema_version", np.asarray(SNAPSHOT_SCHEMA_VERSION))
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        fsync_dir(d)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -153,6 +205,7 @@ def load_checkpoint(path: str, fingerprint: Optional[str] = None,
         return None
     try:
         with np.load(path) as z:
+            check_schema_version(z, path)   # future-version refusal: loud
             matches = (fingerprint is None
                        or ("fingerprint" in z
                            and str(z["fingerprint"]) == fingerprint))
@@ -163,6 +216,8 @@ def load_checkpoint(path: str, fingerprint: Optional[str] = None,
                        converged)
             else:
                 out = None
+    except ValueError:
+        raise              # schema_version from the future — actionable
     except Exception:
         return None        # unreadable/corrupt file: caller starts fresh
     if out is None and on_mismatch == "raise":

@@ -1,0 +1,427 @@
+"""Serving daemon (dfm_tpu/daemon/ — ISSUE 16).
+
+The operative contracts of the socket front door, verified without real
+processes (tools/daemon_smoke.sh covers SIGKILL + cross-process
+blue/green with real signals):
+
+- DURABILITY: the request journal is fsync'd append-only JSONL with
+  monotone seqs that survive reopen; torn tails and mid-file corruption
+  are skipped by count, never raised; ``compact`` atomically drops only
+  snapshot-covered entries.  Fleet/EM snapshots are tmp+fsync+rename
+  atomic (a torn write leaves the OLD snapshot readable) and carry
+  ``schema_version`` — a future version is refused with a ValueError
+  naming both versions.
+- REPLAY PARITY: a daemon answering via ``handle()`` is bit-equal to a
+  lone fleet; a crash-simulated restart (abandon without close, recover
+  from snapshot + journal) continues bit-equal; duplicate request ids
+  answer from cache without touching the fleet.
+- OVERLOAD: the bounded queue answers deterministic backpressure with a
+  ``retry_after_s`` quoted from the calibrated cost model; under a
+  forced SLO burn the lowest-priority class is shed, every shed recorded
+  as ``HealthEvent(kind="shed")`` — never silent.
+- HANDOFF: a same-process blue/green ``takeover`` moves the listening
+  socket without closing it; answers across the swap stay bit-equal and
+  the successor records the handoff (gap_ms) for ``obs.report``.
+- VALIDATION: ``DaemonConfig`` and ``RobustPolicy`` reject nonsense at
+  construction, naming the offending field; flight-recorder dumps to a
+  missing/unwritable DFM_FLIGHT_DIR warn ONCE and never raise.
+"""
+
+import json
+import os
+import threading
+import warnings
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dfm_tpu import DynamicFactorModel, fit, open_fleet
+from dfm_tpu.api import TPUBackend
+from dfm_tpu.backends.cpu_ref import SSMParams
+from dfm_tpu.daemon import (DaemonClient, DaemonConfig, DFMDaemon, Journal,
+                            make_listener)
+from dfm_tpu.daemon.server import _Ticket
+from dfm_tpu.obs.live import LivePlane, plane, set_slo
+from dfm_tpu.obs.report import summarize
+from dfm_tpu.obs.slo import SLOConfig
+from dfm_tpu.robust import RobustPolicy
+from dfm_tpu.utils import checkpoint as ckpt
+from dfm_tpu.utils import dgp
+
+BE = TPUBackend(filter="info")
+R = 2                                    # rows per query
+
+
+# ---------------------------------------------------------------------------
+# journal: durability unit tests (no jax)
+# ---------------------------------------------------------------------------
+
+def test_journal_seq_roundtrip_and_reopen(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    with Journal(p) as j:
+        assert j.append({"id": "a", "tenant": "t0"}) == 1
+        assert j.append({"id": "b", "tenant": "t1"}) == 2
+    # seq resumes across reopen (crash recovery scans the file).
+    with Journal(p) as j:
+        assert j.last_seq == 2
+        assert j.append({"id": "c", "tenant": "t0"}) == 3
+    entries = Journal.read(p)
+    assert [e["id"] for e in entries] == ["a", "b", "c"]
+    assert Journal.read(p, after=1, upto=2) == [entries[1]]
+
+
+def test_journal_torn_tail_and_corruption_skipped(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    with Journal(p) as j:
+        for i in range(3):
+            j.append({"id": f"q{i}", "tenant": "t0"})
+    with open(p, "ab") as f:                 # crash mid-append: torn tail
+        f.write(b'{"seq": 4, "id": "torn')
+    assert [e["id"] for e in Journal.read(p)] == ["q0", "q1", "q2"]
+    # mid-file damage loses ONE entry, not the journal
+    lines = open(p, "rb").read().split(b"\n")
+    lines[1] = b"\x00garbage\x00"
+    open(p, "wb").write(b"\n".join(lines))
+    assert [e["id"] for e in Journal.read(p)] == ["q0", "q2"]
+    with Journal(p) as j:                    # seq still resumes past damage
+        assert j.append({"id": "q3", "tenant": "t0"}) == 4
+
+
+def test_journal_compact_drops_only_covered_entries(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    with Journal(p) as j:
+        for i in range(5):
+            j.append({"id": f"q{i}", "tenant": "t0"})
+        assert j.compact(3) == 2             # keeps seq 4, 5
+        assert [e["seq"] for e in j.replay()] == [4, 5]
+        assert j.append({"id": "q5", "tenant": "t0"}) == 6  # seq monotone
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# snapshots: atomicity + schema versioning (satellites a, b)
+# ---------------------------------------------------------------------------
+
+def _params(k=2, n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return SSMParams(rng.standard_normal((n, k)), np.eye(k) * 0.5,
+                     np.eye(k), np.eye(n), np.zeros(k), np.eye(k))
+
+
+def test_checkpoint_atomic_under_torn_write(tmp_path, monkeypatch):
+    path = str(tmp_path / "state.npz")
+    ckpt.save_checkpoint(path, _params(seed=1), 3, [0.0, 1.0])
+    before = ckpt.load_checkpoint(path)
+    assert before is not None and before[1] == 3
+
+    def torn(src, dst):
+        raise OSError("simulated crash before rename")
+    monkeypatch.setattr(ckpt.os, "replace", torn)
+    with pytest.raises(OSError):
+        ckpt.save_checkpoint(path, _params(seed=2), 9, [2.0])
+    monkeypatch.undo()
+    # The interrupted write left the OLD snapshot intact and no tmp junk.
+    after = ckpt.load_checkpoint(path)
+    assert after is not None and after[1] == 3
+    np.testing.assert_array_equal(after[0].Lam, before[0].Lam)
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+def test_snapshot_schema_future_version_refused(tmp_path):
+    # Unit level: the checker names BOTH versions in the error.
+    bad = {"schema_version": np.asarray(ckpt.SNAPSHOT_SCHEMA_VERSION + 41)}
+    with pytest.raises(ValueError) as ei:
+        ckpt.check_schema_version(bad, "x.npz")
+    msg = str(ei.value)
+    assert f"schema_version={ckpt.SNAPSHOT_SCHEMA_VERSION + 41}" in msg
+    assert f"schema_version<={ckpt.SNAPSHOT_SCHEMA_VERSION}" in msg
+    # File level: a future-version npz refuses through load_checkpoint
+    # (which swallows mere corruption — the refusal must NOT be eaten).
+    path = str(tmp_path / "future.npz")
+    ckpt.save_checkpoint(path, _params(), 1, [0.0])
+    with np.load(path) as z:
+        arrays = dict(z)
+    arrays["schema_version"] = np.asarray(ckpt.SNAPSHOT_SCHEMA_VERSION + 1)
+    np.savez(path, **arrays)
+    with pytest.raises(ValueError, match="schema_version"):
+        ckpt.load_checkpoint(path)
+    # Pre-versioning files (no stamp) stay accepted.
+    arrays.pop("schema_version")
+    np.savez(path, **arrays)
+    assert ckpt.load_checkpoint(path) is not None
+
+
+# ---------------------------------------------------------------------------
+# construction-time validation (satellite c) + flight dumps (satellite d)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw,field", [
+    (dict(dispatch_retries=-1), "dispatch_retries"),
+    (dict(backoff_factor=0.5), "backoff_factor"),
+    (dict(dispatch_deadline_s=0.0), "dispatch_deadline_s"),
+    (dict(on_failure="explode"), "on_failure"),
+])
+def test_robust_policy_validation_names_field(kw, field):
+    with pytest.raises(ValueError, match=f"RobustPolicy.{field}"):
+        RobustPolicy(**kw)
+
+
+@pytest.mark.parametrize("kw,field", [
+    (dict(queue_max=0), "queue_max"),
+    (dict(work_max_s=0.0), "work_max_s"),
+    (dict(tick_requests=0), "tick_requests"),
+    (dict(snapshot_every=-1), "snapshot_every"),
+    (dict(retry_after_floor_s=0.0), "retry_after_floor_s"),
+    (dict(request_timeout_s=0.0), "request_timeout_s"),
+])
+def test_daemon_config_validation_names_field(kw, field):
+    with pytest.raises(ValueError, match=f"DaemonConfig.{field}"):
+        DaemonConfig(**kw)
+
+
+def test_flight_dump_unwritable_dir_warns_once_never_raises(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("plain file")
+    lp = LivePlane(flight_dir=str(blocker / "sub"))   # makedirs must fail
+    lp.ring.append({"kind": "query", "wall": 0.001})
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert lp.dump_flight() is None               # no raise
+        assert lp.dump_flight() is None               # warn ONCE
+    assert len(w) == 1
+    assert "flight-recorder" in str(w[0].message)
+    assert lp.flight_dumps == 0 and lp.errors >= 1
+
+
+# ---------------------------------------------------------------------------
+# observability: the obs.report daemon section (no jax state needed)
+# ---------------------------------------------------------------------------
+
+def test_report_daemon_section(tmp_path):
+    tr = str(tmp_path / "trace.jsonl")
+    evs = [
+        {"kind": "daemon", "action": "request", "tenant": "t0", "depth": 1},
+        {"kind": "daemon", "action": "request", "tenant": "t0", "depth": 3},
+        {"kind": "daemon", "action": "backpressure", "tenant": "t1",
+         "depth": 8, "retry_after_s": 0.4},
+        {"kind": "daemon", "action": "snapshot", "journal_seq": 7},
+        {"kind": "daemon", "action": "replay", "n_entries": 5},
+        {"kind": "daemon", "action": "handoff", "role": "successor",
+         "gap_ms": 12.5},
+        {"kind": "health", "event": "shed", "action": "rejected",
+         "tenant": "t1", "chunk": -1, "iteration": 0, "detail": "",
+         "engine": "daemon"},
+    ]
+    with open(tr, "w") as f:
+        for i, e in enumerate(evs):
+            f.write(json.dumps(dict(t=float(i), **e)) + "\n")
+    dm = summarize(tr)["daemon"]
+    assert dm["n_requests"] == 2
+    assert dm["n_backpressure"] == 1
+    assert dm["n_shed"] == 1
+    assert dm["n_snapshots"] == 1
+    assert dm["n_replays"] == 1 and dm["n_replayed_entries"] == 5
+    assert dm["n_handoffs"] == 1
+    assert dm["handoff_gap_ms"]["p99"] == pytest.approx(12.5)
+    assert dm["queue_depth"]["p50"] == pytest.approx(3.0)
+    assert dm["per_tenant"]["t1"]["backpressure"] == 1
+    assert dm["per_tenant"]["t1"]["shed"] == 1
+    # Empty traces keep the section with stable keys (dashboards).
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    dm0 = summarize(empty)["daemon"]
+    assert dm0["n_requests"] == 0 and dm0["n_handoffs"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the daemon over a real (tiny) fleet
+# ---------------------------------------------------------------------------
+
+def _mk_tenant(N, T, k, seed, extra):
+    rng = np.random.default_rng(seed)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y, _ = dgp.simulate(p_true, T + extra, rng)
+    res = fit(DynamicFactorModel(n_factors=k), Y[:T], max_iters=6,
+              backend=BE, telemetry=False)
+    return res, Y[:T], Y[T:]
+
+
+@pytest.fixture(scope="module")
+def denv(tmp_path_factory):
+    """Two tenants, a bootstrap snapshot, and an uninterrupted twin fleet.
+
+    Tests recover their OWN daemon from (snapshot, journal) — every
+    served submit is journaled, so a fresh recover always lands on
+    exactly the state the shared twin has, independent of test order."""
+    work = tmp_path_factory.mktemp("daemon")
+    tens = [_mk_tenant(8, 30, 2, 301, 40 * R), _mk_tenant(10, 34, 2, 302,
+                                                          40 * R)]
+    caps = [t[1].shape[0] + 42 * R for t in tens]
+    twin = open_fleet([t[0] for t in tens], [t[1] for t in tens],
+                      capacity=caps, max_update_rows=R, max_iters=4,
+                      tol=0.0, backend=BE)
+    names = list(twin.tenants)
+    boot = open_fleet([t[0] for t in tens], [t[1] for t in tens],
+                      tenants=names, capacity=caps, max_update_rows=R,
+                      max_iters=4, tol=0.0, backend=BE)
+    snap = str(work / "snap")
+    boot.snapshot_all(snap)
+    boot.close()
+    env = SimpleNamespace(work=work, tens=tens, twin=twin, names=names,
+                          snap=snap, journal=str(work / "journal.jsonl"),
+                          cursor=[0] * len(tens), nreq=[0])
+    yield env
+    twin.close()
+
+
+def _recover(env, **cfg_kw):
+    return DFMDaemon.recover(env.snap, env.journal, backend=BE,
+                             config=DaemonConfig(**cfg_kw) if cfg_kw
+                             else None)
+
+
+def _roundtrip(env, daemon, i, where):
+    rows = env.tens[i][2][env.cursor[i]:env.cursor[i] + R]
+    env.cursor[i] += R
+    env.nreq[0] += 1
+    rid = f"{where}-{env.nreq[0]}"
+    resp = daemon.handle({"op": "submit", "tenant": env.names[i],
+                          "rows": rows.tolist(), "id": rid})
+    assert resp.get("ok"), (where, resp)
+    env.twin.submit(env.names[i], rows)
+    upd = env.twin.drain()[env.names[i]][0]
+    np.testing.assert_array_equal(np.asarray(resp["nowcast"]),
+                                  np.asarray(upd.nowcast), err_msg=where)
+    np.testing.assert_array_equal(np.asarray(resp["forecast_y"]),
+                                  np.asarray(upd.forecasts["y"]),
+                                  err_msg=where)
+    return rid, resp
+
+
+def test_daemon_parity_dedup_and_crash_replay(denv):
+    d1 = _recover(denv)
+    try:
+        rid = None
+        for q in range(3):
+            i = q % 2
+            rid, _ = _roundtrip(denv, d1, i, f"par{q}")
+        # Duplicate id: answered from cache, state-neutral (the fleet is
+        # NOT re-ticked — the next fresh query still matches the twin).
+        dup = d1.handle({"op": "submit", "tenant": denv.names[0],
+                         "rows": denv.tens[0][2][:R].tolist(), "id": rid})
+        assert dup.get("duplicate") is True
+        _roundtrip(denv, d1, 0, "post-dup")
+        # Unknown tenants are rejected at admission, not at the fleet.
+        bad = d1.handle({"op": "submit", "tenant": "nobody", "rows": None})
+        assert not bad["ok"] and "unknown tenant" in bad["error"]
+        st = d1.status()
+        assert st["n_served"] == 4 and st["journal_seq"] == 4
+    finally:
+        d1._journal.close()     # crash-sim: abandon WITHOUT fleet close
+    # Recover from (bootstrap snapshot, journal): replays all 4 served
+    # submits and continues bit-equal to the uninterrupted twin — and
+    # the served-id set survives, so dedup works across the "crash".
+    d2 = _recover(denv)
+    try:
+        dup = d2.handle({"op": "submit", "tenant": denv.names[0],
+                         "rows": None, "id": "par0-1"})
+        assert dup.get("duplicate") is True
+        for q in range(2):
+            _roundtrip(denv, d2, q % 2, f"postcrash{q}")
+    finally:
+        d2.close()
+
+
+def test_backpressure_deterministic_and_shed_recorded(denv):
+    d = _recover(denv, queue_max=2, retry_after_floor_s=0.05,
+                 priority={denv.names[1]: 1})
+    try:
+        # Fill the bounded queue below the pump (white-box: admission
+        # only), then verify the deterministic rejection quote.
+        with d._lock:
+            for _ in range(2):
+                got = d._admit({"op": "submit", "tenant": denv.names[0],
+                                "rows": None})
+                assert isinstance(got, _Ticket)
+            work = d._queued_work_s()
+            rej = d._admit({"op": "submit", "tenant": denv.names[0],
+                            "rows": None})
+        assert rej["backpressure"] is True
+        assert rej["retry_after_s"] == pytest.approx(max(0.05, work))
+        assert d.n_backpressure == 1
+        # SLO burn firing -> the lowest-priority class sheds; the
+        # higher class is still admitted.  Every shed is a HealthEvent.
+        set_slo(SLOConfig(p99_ms=1e-6, min_events=3, window=3600.0))
+        for t in range(4):
+            plane().slo.observe(float(t), wall_ms=5.0)
+        assert plane().slo.breached
+        with d._lock:
+            d._queue.clear()
+            shed = d._admit({"op": "submit", "tenant": denv.names[0],
+                             "rows": None})
+            kept = d._admit({"op": "submit", "tenant": denv.names[1],
+                             "rows": None})
+            d._queue.clear()
+        assert shed.get("shed") is True and d.n_shed == 1
+        assert isinstance(kept, _Ticket)
+        evs = [e for e in d.health.events if e.kind == "shed"]
+        assert len(evs) == 1 and evs[0].tenant == denv.names[0]
+        assert evs[0].action == "rejected"
+    finally:
+        set_slo(None)
+        assert not plane().slo.breached      # disarm clears the latch
+        d.close()
+
+
+def test_handoff_same_process_bit_equal(denv):
+    pred = _recover(denv)
+    addr = str(denv.work / "d.sock")
+    listener = make_listener(addr)
+    th = threading.Thread(target=pred.serve_forever, args=(listener,),
+                          daemon=True)
+    th.start()
+    cli = DaemonClient(addr, timeout=120.0)
+    assert cli.ping()["pong"]
+    # Socket path answers == handle() path == lone fleet.
+    for q in range(2):
+        i = q % 2
+        rows = denv.tens[i][2][denv.cursor[i]:denv.cursor[i] + R]
+        denv.cursor[i] += R
+        resp = cli.submit(denv.names[i], rows, req_id=f"ho-pre{q}",
+                          wait=True)
+        assert resp.get("ok"), resp
+        denv.twin.submit(denv.names[i], rows)
+        upd = denv.twin.drain()[denv.names[i]][0]
+        np.testing.assert_array_equal(np.asarray(resp["nowcast"]),
+                                      upd.nowcast)
+    succ, lst2, gap_ms = DFMDaemon.takeover(addr, denv.snap, denv.journal,
+                                            backend=BE)
+    th.join(timeout=60)
+    assert not th.is_alive(), "predecessor kept serving after handoff"
+    assert gap_ms >= 0.0 and succ.n_handoffs == 1
+    assert [e.kind for e in succ.health.events] == ["handoff"]
+    th2 = threading.Thread(target=succ.serve_forever, args=(lst2,),
+                           daemon=True)
+    th2.start()
+    try:
+        # Same client, same address: the successor's answers continue
+        # bit-equal to the uninterrupted twin (delta replay worked).
+        for q in range(2):
+            i = q % 2
+            rows = denv.tens[i][2][denv.cursor[i]:denv.cursor[i] + R]
+            denv.cursor[i] += R
+            resp = cli.submit(denv.names[i], rows, req_id=f"ho-post{q}",
+                              wait=True)
+            assert resp.get("ok"), resp
+            denv.twin.submit(denv.names[i], rows)
+            upd = denv.twin.drain()[denv.names[i]][0]
+            np.testing.assert_array_equal(np.asarray(resp["nowcast"]),
+                                          upd.nowcast)
+            np.testing.assert_array_equal(np.asarray(resp["forecast_y"]),
+                                          upd.forecasts["y"])
+    finally:
+        cli.shutdown()
+        th2.join(timeout=60)
+        succ.close()
+        pred._journal.close()

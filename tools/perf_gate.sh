@@ -190,5 +190,30 @@ assert store.noise_floor("kscale_calib_err") > 0, \
 assert store.noise_floor("kscale_mf_m25_wall_s") > 0, \
     "perf_gate: kscale_mf_m25_wall_s lost its wall noise floor"'
 
+# The serving-daemon metrics (bench.daemon / tools/daemon_smoke.sh) must
+# stay registered: socket throughput gates higher-is-better; the p99
+# query wall and handoff gap ride the ms noise floor; shed_rate has its
+# own fraction floor; dropped_queries gates EXACTLY at zero — any client
+# request that got no answer breaks the zero-downtime contract.
+python -c '
+from dfm_tpu.obs import store
+need = ("daemon_qps", "daemon_p99_ms", "daemon_shed_rate",
+        "daemon_handoff_gap_ms", "daemon_dropped_queries")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+assert not store.lower_is_better("daemon_qps"), \
+    "perf_gate: daemon_qps must gate higher-is-better"
+for k in need[1:]:
+    assert store.lower_is_better(k), \
+        f"perf_gate: {k} lost its lower-is-better marker"
+assert store.noise_floor("daemon_p99_ms") > 0, \
+    "perf_gate: daemon_p99_ms lost its ms noise floor"
+assert store.noise_floor("daemon_handoff_gap_ms") > 0, \
+    "perf_gate: daemon_handoff_gap_ms lost its ms noise floor"
+assert store.noise_floor("daemon_shed_rate") > 0, \
+    "perf_gate: daemon_shed_rate lost its noise floor"
+assert store.noise_floor("daemon_dropped_queries") == 0, \
+    "perf_gate: daemon_dropped_queries must gate exactly (zero-downtime)"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"
