@@ -20,11 +20,16 @@ line to stdout:
 d2h barrier included).  ``recompiles_after_warmup`` must stay 0 — the
 traced eviction count rides the SAME executable as a non-ring session.
 
-Run on the real chip: ``python -m bench.stream``.  Smoke-size via
+Leg 1b races a ``filter="pit_qr"`` ring session against a forced-info
+twin on the same long trailing window (``stream_pit_speedup`` — the
+engine win must survive the full serving path).  Run on the real chip:
+``python -m bench.stream``.  Smoke-size via
 DFM_BENCH_N/K, DFM_BENCH_STREAM_CAPACITY (ring window, default 160),
 DFM_BENCH_QUERIES (warm queries, default 50), DFM_BENCH_ROWS (rows per
 query, default 2), DFM_BENCH_SERVE_ITERS (EM iters/update, default 5),
 DFM_BENCH_ITERS (cold-fit budget, default 50),
+DFM_BENCH_STREAM_PIT_CAPACITY / DFM_BENCH_STREAM_PIT_QUERIES (pit_qr
+leg window, default 600 / half the warm queries),
 DFM_BENCH_STREAM_TENANTS / DFM_BENCH_STREAM_RESIDENT (fleet tiering
 leg, default 8 tenants on 2 lanes).  Diagnostics on stderr.
 """
@@ -124,6 +129,46 @@ def main():
         f"{recomp} recompiles after warmup; p99 {p99_ms / fixed_p99:.2f}x "
         "the fixed-capacity session's")
 
+    # -- leg 1b: long-window ring, pit_qr vs forced-info twin -----------
+    # Engine-complete serving: the SAME ring executable budget, but the
+    # in-update EM/smooth runs the square-root parallel-in-time engine.
+    # At long trailing windows the sequential scan dominates the query
+    # wall, so the pit_qr session's win must SURVIVE the serving path
+    # (ragged append + warm EM + forecasts, d2h included).
+    pit_cap = int(os.environ.get("DFM_BENCH_STREAM_PIT_CAPACITY", 600))
+    pit_queries = int(os.environ.get("DFM_BENCH_STREAM_PIT_QUERIES",
+                                     max(6, n_queries // 2)))
+    from dfm_tpu import TPUBackend
+    rng_p = np.random.default_rng(179)
+    pp = dgp.dfm_params(N, k, rng_p)
+    n_pstream = (pit_queries + 1) * rows
+    Yp_all, _ = dgp.simulate(pp, pit_cap + n_pstream, rng_p)
+    Yp0, Yp_stream = Yp_all[:pit_cap], Yp_all[pit_cap:]
+    bq = TPUBackend(filter="pit_qr")
+    with jax.default_matmul_precision("highest"):
+        res_p = fit(DynamicFactorModel(n_factors=k), Yp0, backend=bq,
+                    max_iters=max(8, cold_iters // 4), fused=True,
+                    telemetry=False)
+        eng_walls = {}
+        for eng in ("info", "pit_qr"):
+            s = open_session(res_p, Yp0, backend=bq, capacity=pit_cap,
+                             max_update_rows=rows, max_iters=serve_iters,
+                             tol=0.0, ring=True, filter=eng)
+            s.update(Yp_stream[:rows])      # compile + warm
+            ws = []
+            for i in range(1, pit_queries + 1):
+                t0 = time.perf_counter()
+                s.update(Yp_stream[i * rows:(i + 1) * rows])
+                ws.append(time.perf_counter() - t0)
+            s.close()
+            eng_walls[eng] = ws
+    pit_p50 = 1e3 * _pct(eng_walls["pit_qr"], 50)
+    info_p50 = 1e3 * _pct(eng_walls["info"], 50)
+    pit_speedup = (sum(eng_walls["info"]) / sum(eng_walls["pit_qr"])
+                   if sum(eng_walls["pit_qr"]) > 0 else 0.0)
+    log(f"pit_qr ring leg (window {pit_cap}): p50 {pit_p50:.1f} ms vs "
+        f"info twin {info_p50:.1f} ms — {pit_speedup:.2f}x")
+
     # -- leg 2: fleet tiering (more tenants than lanes) -----------------
     n_t0 = 40
     rng2 = np.random.default_rng(178)
@@ -180,6 +225,11 @@ def main():
         "stream_fixed_p99_ms": round(fixed_p99, 2),
         "evictions_per_query": round(evictions_per_query, 3),
         "readmission_ms": round(readmission_ms, 2),
+        "stream_pit_speedup": round(pit_speedup, 3),
+        "stream_pit_p50_ms": round(pit_p50, 2),
+        "stream_pit_info_p50_ms": round(info_p50, 2),
+        "stream_pit_capacity": pit_cap,
+        "stream_pit_queries": pit_queries,
         "stream_blocking_transfers_per_query": round(per_query, 3),
         "recompiles_after_warmup": int(recomp),
         "rows_evicted": int(n_evicted),

@@ -84,6 +84,22 @@ class EMConfig:
         return {"pit": pit_smoother,
                 "pit_qr": pit_qr_smoother}.get(self.filter, rts_smoother)
 
+    def report_pair(self):
+        """Filter/smoother pair for the reporting smooth at the FITTED
+        params (the fused drivers' and serving cores' final pass).
+
+        The engines whose smoothed moments ARE their contract route
+        through themselves — pit_qr (RTS-equivalent at f32-stable
+        square-root combines) and lowrank (the conservative rank-r
+        bands the serving layer promotes to outputs).  Everything else
+        keeps the historical pairs bit-for-bit: dense keeps the N x N
+        oracle filter, and info/ss/pit report through the exact
+        info-form scan, matching ``api.smooth()``."""
+        if self.filter in ("pit_qr", "lowrank"):
+            return self.filter_fn(), self.smoother_fn()
+        ff = kalman_filter if self.filter == "dense" else info_filter
+        return ff, rts_smoother
+
     def e_step(self, Y, mask, p, sumsq=None):
         """Filter + smoother under the configured implementation.
 

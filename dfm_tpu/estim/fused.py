@@ -41,8 +41,6 @@ from jax import lax
 
 from ..obs.trace import current_tracer, shape_key
 from ..ops.precision import accum_dtype
-from ..ssm.info_filter import info_filter
-from ..ssm.kalman import kalman_filter, rts_smoother
 from .em import _em_chunk_body, _panel_consts
 
 __all__ = ["FusedOptions", "FusedRun", "resolve_fused", "run_fused"]
@@ -264,11 +262,13 @@ def _fused_fit_core(Y, mask, p0, tol, noise_floor, cfg, has_mask, max_iters, chu
                        opts, sumsq=sumsq, Ysq=Ysq)
     p_fit = f["p"]
 
-    # Smooth + forecast at the fitted params, same program.  ss/pit
-    # configs route through the info filter, matching api.smooth().
-    ff = kalman_filter if cfg.filter == "dense" else info_filter
+    # Smooth + forecast at the fitted params, same program — routed by
+    # engine (EMConfig.report_pair: pit_qr/lowrank report through their
+    # own smoothers; dense/info/ss/pit keep the historical pairs
+    # bit-for-bit, matching api.smooth()).
+    ff, sf = cfg.report_pair()
     kf = ff(Y, p_fit, mask=m)
-    sm = rts_smoother(kf, p_fit)
+    sm = sf(kf, p_fit)
     x_T, P_T = sm.x_sm[-1], sm.P_sm[-1]
     nowcast = p_fit.Lam @ x_T
 

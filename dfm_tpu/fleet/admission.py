@@ -22,8 +22,8 @@ from typing import List, Optional, Sequence, Tuple
 from ..obs.cost import em_iter_work, fit_cost_model
 from ..sched.buckets import lane_rent_bytes, plan_capacity_classes
 
-__all__ = ["ClassAssignment", "plan_admission", "fleet_pad_waste",
-           "plan_residency", "readmission_cost_s"]
+__all__ = ["ClassAssignment", "choose_engine", "plan_admission",
+           "fleet_pad_waste", "plan_residency", "readmission_cost_s"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,6 +105,34 @@ def plan_admission(shapes: Sequence[Tuple[int, int, int]],
                 dims=b.dims,
                 members=tuple(members[j] for j in b.jobs)))
     return out
+
+
+def choose_engine(dims: Tuple[int, int, int], iters: int, *,
+                  rank: int = 0, model=None, runs: Optional[str] = None,
+                  device: Optional[str] = None) -> str:
+    """Pick the serving engine for one capacity class (``filter="auto"``).
+
+    Compares the calibrated per-iteration cost of the info-form scan
+    against ``pit_qr`` and ``lowrank`` at the class's padded dims, under
+    the PR 15 evidence gate: an engine whose residual scale was never
+    measured (``pit_qr_calibrated``/``lowrank_calibrated`` False) is NOT
+    a candidate — raw structural priors never make an "auto" fleet
+    compile an engine nobody timed.  With an empty registry every gate is
+    closed and the choice is "info" (the pre-routing fleet).
+    Deterministic given a fixed profile registry; ties keep "info".
+    """
+    m = model if model is not None else _load_model(runs, device)
+    T, N, k = int(dims[0]), int(dims[1]), int(dims[2])
+    best, best_s = "info", m.iter_s(N, T, k, "seq")
+    if getattr(m, "pit_qr_calibrated", False):
+        s = m.iter_s(N, T, k, "pit_qr")
+        if s < best_s:
+            best, best_s = "pit_qr", s
+    if getattr(m, "lowrank_calibrated", False) and k > max(1, int(rank)):
+        s = m.iter_s(N, T, k, "lowrank")
+        if s < best_s:
+            best, best_s = "lowrank", s
+    return best
 
 
 def fleet_pad_waste(shapes: Sequence[Tuple[int, int, int]],

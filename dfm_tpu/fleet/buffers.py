@@ -71,6 +71,7 @@ class TenantSlot:
     warm_p: Optional[object] = None       # padded cpu_ref params (f64)
     cold_path: Optional[str] = None
     last_used: int = 0         # LRU stamp (fleet submit sequence)
+    last_band: Optional[tuple] = None  # (y_fore, y_sd) of previous query
 
     @property
     def n_evicted(self) -> int:
@@ -113,7 +114,8 @@ class FleetBucket:
     """
 
     def __init__(self, entries, dims, *, r_max: int, backend, opts,
-                 pad_lanes: int = 0, lanes: Optional[int] = None):
+                 pad_lanes: int = 0, lanes: Optional[int] = None,
+                 filter: str = "info", rank: int = 0):
         T_cap, N_max, k_max = dims
         self.dims = dims
         self.r_max = int(r_max)
@@ -173,14 +175,22 @@ class FleetBucket:
         # One static iteration cap per bucket (the scan length — per-lane
         # budgets ride the traced iter_cap vector below it).
         self.max_iters = max(s.max_iters for s in self.slots)
+        # Engine routing (PR 17): the bucket's whole serving program —
+        # warm EM, final smooth, bands — runs this filter; rank rides
+        # only with lowrank so info buckets' EMConfig (and executable
+        # cache keys) equal the pre-routing ones bit-for-bit.
+        rank = int(rank) if filter == "lowrank" else 0
         self.cfg = EMConfig(estimate_A=est[0], estimate_Q=est[1],
-                            estimate_init=est[2], filter="info", debug=False)
+                            estimate_init=est[2], filter=str(filter),
+                            rank=rank, debug=False)
         with backend._precision_ctx():
             self.Ybuf = jnp.asarray(self.Yhost, self.dt)
             self.Wbuf = jnp.asarray(self.Whost, self.dt)
             self.p = stack_params(self.p_host, dtype=self.dt)
-        self.key = shape_key(self.Ybuf, "info", f"rows{self.r_max}",
-                             f"max{self.max_iters}", f"fleetB{self.B}")
+        self.key = shape_key(
+            self.Ybuf, self.cfg.filter,
+            *((f"rank{rank}",) if self.cfg.filter == "lowrank" else ()),
+            f"rows{self.r_max}", f"max{self.max_iters}", f"fleetB{self.B}")
         self.n_ticks = 0
 
     # -- per-tick traced vectors ---------------------------------------

@@ -57,11 +57,17 @@ from ..robust.dispatch import guarded_dispatch
 from ..robust.health import FitHealth, HealthEvent
 from ..serve.batched import (FleetOptions, _fleet_impl, _fleet_impl_donated,
                              fleet_impl_sharded)
-from ..serve.session import NowcastSession, SessionUpdate
+from ..serve.session import _Z90, NowcastSession, SessionUpdate
 from ..utils.data import build_mask
-from .admission import (fleet_pad_waste, plan_admission, plan_residency,
-                        readmission_cost_s)
+from .admission import (choose_engine, fleet_pad_waste, plan_admission,
+                        plan_residency, readmission_cost_s)
 from .buffers import FleetBucket
+
+# Engines a fleet bucket can route (the batched serving core runs the
+# info-form twins or vmaps the lone pit_qr/lowrank pair per lane);
+# "auto" defers the choice to the calibrated cost model per capacity
+# class (evidence-gated: unprofiled engine switches are never chosen).
+_FLEET_FILTERS = ("info", "pit_qr", "lowrank")
 
 __all__ = ["SessionFleet", "open_fleet", "restore_fleet"]
 
@@ -123,6 +129,7 @@ class SessionFleet:
                  capacity=None, max_update_rows: int = 8, max_iters=5,
                  tol=1e-6, horizon: Optional[int] = None,
                  di: Optional[bool] = None, ring: bool = False,
+                 filter=None, rank=None,
                  resident: Optional[int] = None, backend=None,
                  robust=None, max_classes: int = 3,
                  runs: Optional[str] = None):
@@ -155,7 +162,9 @@ class SessionFleet:
         caps = _per_tenant(capacity, B, "capacity", int)
         m_its = _per_tenant(max_iters, B, "max_iters", int)
         tols = _per_tenant(tol, B, "tol", float)
-        shapes, cfg_keys, entries = [], [], []
+        filts = _per_tenant(filter, B, "filter", str)
+        ranks = _per_tenant(rank, B, "rank", int)
+        shapes, cfg_keys, entries, engines = [], [], [], []
         for i, (res, Y) in enumerate(zip(results, panels)):
             if not isinstance(res, FitResult):
                 raise TypeError(
@@ -197,7 +206,27 @@ class SessionFleet:
             k = Lam.shape[1]
             shapes.append((cap, N, k))
             m = res.model
-            cfg_keys.append((m.estimate_A, m.estimate_Q, m.estimate_init))
+            # Per-tenant engine: an explicit filter= wins ("auto" defers
+            # to the cost model per capacity class); the default inherits
+            # the fit's resolved engine when the batched core routes it
+            # (pit_qr/lowrank), mapping everything else to the info-form
+            # twins — exactly the pre-routing fleet, bit-for-bit.
+            f_i = filts[i]
+            if f_i is None:
+                rf = getattr(res, "filter", None)
+                f_i = rf if rf in ("pit_qr", "lowrank") else "info"
+            elif f_i not in _FLEET_FILTERS + ("auto",):
+                raise ValueError(
+                    f"tenant {names[i]!r}: unknown fleet filter {f_i!r}; "
+                    f"buckets route {_FLEET_FILTERS} (or 'auto' for the "
+                    "calibrated cost-model choice per class)")
+            r_i = int(0 if ranks[i] is None else ranks[i])
+            r_i = r_i if f_i in ("lowrank", "auto") else 0
+            engines.append((f_i, r_i))
+            # The engine joins the admission key: buckets are engine-
+            # homogeneous, so ONE executable per (bucket-shape, engine).
+            cfg_keys.append((m.estimate_A, m.estimate_Q, m.estimate_init,
+                             f_i, r_i))
             entries.append((names[i], res, Y, masks[i], cap, m_it, tl))
         self._iters = [e[5] for e in entries]
         classes = plan_admission(shapes, self._iters, cfg_keys,
@@ -223,10 +252,18 @@ class SessionFleet:
         self._slot_of = {}           # tenant -> (bucket, slot)
         for ca, n_lanes in zip(classes, lane_plan):
             ents = [entries[i] for i in ca.members]
+            # Engine-homogeneous by the admission key; "auto" resolves
+            # HERE, per class, through the calibrated cost model with the
+            # PR 15 evidence gate (an unprofiled engine is never chosen).
+            eng, rk = engines[ca.members[0]]
+            if eng == "auto":
+                its = [self._iters[i] for i in ca.members]
+                eng = choose_engine(ca.dims, max(its), rank=rk, runs=runs)
             n_hot = min(len(ents), max(1, n_lanes))
             pad = (-n_hot) % mesh_d
             bk = FleetBucket(ents, ca.dims, r_max=self._r_max, backend=b,
-                             opts=self._opts, pad_lanes=pad, lanes=n_hot)
+                             opts=self._opts, pad_lanes=pad, lanes=n_hot,
+                             filter=eng, rank=rk)
             self._buckets.append(bk)
             for s in bk.slots:
                 self._slot_of[s.name] = (bk, s)
@@ -258,6 +295,7 @@ class SessionFleet:
         """The admission plan: padded dims + members per capacity class."""
         return [{"dims": {"T": bk.dims[0], "N": bk.dims[1],
                           "k": bk.dims[2]},
+                 "filter": bk.cfg.filter, "rank": bk.cfg.rank,
                  "tenants": [s.name for s in bk.slots]}
                 for bk in self._buckets]
 
@@ -709,7 +747,21 @@ class SessionFleet:
             slot.evict_orig(e)
             slot.n_queries += 1
             self._n_queries += 1
+            # Live coverage: this query's observed new rows vs the
+            # PREVIOUS query's 90% band (original units, host-only —
+            # the fleet twin of the lone session's tracking).
+            cov = None
+            if q.n_new and slot.last_band is not None:
+                pf, ps = slot.last_band
+                n_cmp = min(q.n_new, pf.shape[0])
+                obs = q.W_rows[:n_cmp] > 0
+                if obs.any():
+                    hit = (np.abs(q.rows[:n_cmp] - pf[:n_cmp])
+                           <= _Z90 * ps[:n_cmp])
+                    cov = float(np.mean(hit[obs]))
             upd = self._lane_update(bucket, host, slot, t_new, wall)
+            upd.coverage = cov
+            slot.last_band = (upd.forecasts["y"], upd.forecast_sd)
             diverged = int(host["status"][lane]) == DIVERGED
             if diverged:
                 slot.div_run += 1
@@ -744,9 +796,11 @@ class SessionFleet:
                        queue_wait=max(0.0, t0 - q.t_submit),
                        n_iters=int(host["n_iters"][lane]),
                        N=int(slot.N), k=int(slot.k),
+                       engine=bucket.cfg.filter,
                        converged=bool(int(host["status"][lane])
                                       == CONVERGED),
                        diverged=diverged,
+                       **({"coverage": cov} if cov is not None else {}),
                        **({"n_evicted": int(e)} if e else {}),
                        **({"degraded": True} if degraded else {}))
             if tr is not None:
@@ -777,8 +831,10 @@ class SessionFleet:
             "good_it": np.asarray(out["good_it"], np.int32),
             "lls": np.asarray(out["lls"], np.float64),
             "nowcast": np.asarray(out["nowcast"], np.float64),
+            "nowcast_sd": np.asarray(out["nowcast_sd"], np.float64),
             "f_fore": np.asarray(out["f_fore"], np.float64),
             "y_fore": np.asarray(out["y_fore"], np.float64),
+            "y_sd": np.asarray(out["y_sd"], np.float64),
             "di": (np.asarray(out["di"], np.float64)
                    if out["di"] is not None else None),
             "x_sm": np.asarray(out["x_sm"], np.float64),
@@ -794,6 +850,9 @@ class SessionFleet:
         destandardize — the fleet's ``SessionUpdate`` for this tenant."""
         ln, N, k = slot.lane, slot.N, slot.k
         inv = (slot.std.inverse if slot.std is not None else (lambda a: a))
+        # Bands destandardize by the scale alone (the shift cancels).
+        sd_inv = ((lambda s: s * slot.std.scale)
+                  if slot.std is not None else (lambda s: s))
         n = min(int(host["n_iters"][ln]), slot.max_iters)
         di = host["di"]
         return SessionUpdate(
@@ -810,7 +869,9 @@ class SessionFleet:
             factors=host["x_sm"][ln][:t_new, :k],
             factor_cov=host["P_sm"][ln][:t_new, :k, :k],
             t=t_new,
-            wall_s=wall)
+            wall_s=wall,
+            nowcast_sd=np.asarray(sd_inv(host["nowcast_sd"][ln][:N])),
+            forecast_sd=np.asarray(sd_inv(host["y_sd"][ln][:, :N])))
 
     # -- quarantine / eviction -----------------------------------------
     def _quarantine(self, bucket, slot, reason: str, p_pad=None):
@@ -837,7 +898,9 @@ class SessionFleet:
             capacity=slot.capacity, max_update_rows=self._r_max,
             max_iters=slot.max_iters, tol=slot.tol,
             horizon=self._opts.horizon, di=self._opts.di,
-            ring=self._ring, backend=self._backend, robust=self._policy)
+            ring=self._ring, filter=bucket.cfg.filter,
+            rank=bucket.cfg.rank, backend=self._backend,
+            robust=self._policy)
         slot.evicted = sess
         slot.quarantined = True
         slot.div_run = 0
@@ -933,6 +996,8 @@ class SessionFleet:
                 "max_iters": int(slot.max_iters), "tol": float(slot.tol),
                 "t": int(slot.t), "t_total": int(slot.t_total),
                 "n_queries": int(slot.n_queries),
+                "filter": bucket.cfg.filter,
+                "rank": int(bucket.cfg.rank),
                 "was_quarantined": bool(slot.quarantined),
             })
         manifest = {
@@ -1009,6 +1074,15 @@ def open_fleet(results, panels, masks=None, **kwargs) -> SessionFleet:
                       of raising: unbounded streams at constant memory,
                       zero recompiles, each tenant pinned to a lone
                       ring session over the same trailing window.
+    filter / rank   : per-tenant serving engine ("info", "pit_qr",
+                      "lowrank" + rank, or "auto" for the calibrated
+                      cost-model choice per capacity class — evidence-
+                      gated, so an unprofiled engine is never chosen);
+                      scalar or one per tenant.  Default inherits each
+                      fit's resolved ``FitResult.filter`` when the
+                      batched core routes it (pit_qr/lowrank), else the
+                      info-form twins.  Buckets are engine-homogeneous:
+                      ONE executable per (bucket-shape, engine).
     resident        : fleet-wide hot-lane budget (default: every tenant
                       resident).  With fewer lanes than tenants the
                       overflow starts WARM (host shadows parked, no HBM
@@ -1069,7 +1143,7 @@ def restore_fleet(dir_path: str, **kwargs) -> SessionFleet:
     from ..utils.data import Standardizer
     manifest = read_manifest(dir_path)
     results, panels, masks, names = [], [], [], []
-    caps, m_its, tols = [], [], []
+    caps, m_its, tols, filts, ranks = [], [], [], [], []
     for ten in manifest["tenants"]:
         path = os.path.join(dir_path, ten["file"])
         with np.load(path) as z:
@@ -1106,10 +1180,15 @@ def restore_fleet(dir_path: str, **kwargs) -> SessionFleet:
         caps.append(int(ten["capacity"]))
         m_its.append(int(ten["max_iters"]))
         tols.append(float(ten["tol"]))
+        # Engine round-trip (PR 17); pre-engine manifests restore as the
+        # info-form fleet they were.
+        filts.append(str(ten.get("filter", "info")))
+        ranks.append(int(ten.get("rank", 0)))
     fleet = open_fleet(
         results, panels, masks, tenants=names, capacity=caps,
         max_iters=m_its, tol=tols, horizon=int(manifest["horizon"]),
         di=bool(manifest["di"]), ring=bool(manifest["ring"]),
+        filter=filts, rank=ranks,
         max_update_rows=int(manifest["max_update_rows"]), **kwargs)
     # Stream-position ledger (ring eviction counts) survives the restart.
     for ten in manifest["tenants"]:

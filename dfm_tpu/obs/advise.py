@@ -125,7 +125,10 @@ def advise_jobs(shapes, *, max_iters: int = 50, chunk: int = 8,
     count, run the cost-model DP and predict the mix's aggregate wall.
     ``shapes`` is a list of (N, T, k) triples, one per job.  Deterministic
     given a fixed profile registry: ties prefer fewer executables, then
-    the smaller bucket-dims tuple."""
+    the smaller bucket-dims tuple.  Each bucket carries the evidence-gated
+    ``filter`` engine ``fleet.admission.choose_engine`` would route it to
+    (always "info" on an uncalibrated registry)."""
+    from ..fleet.admission import choose_engine
     from ..sched.buckets import plan_buckets
     from .cost import fit_cost_model
     from .store import RunStore, runs_dir
@@ -151,7 +154,9 @@ def advise_jobs(shapes, *, max_iters: int = 50, chunk: int = 8,
             "max_buckets": mb, "n_buckets": len(plan.buckets),
             "buckets": [{"dims": {"T": b.dims[0], "N": b.dims[1],
                                   "k": b.dims[2]},
-                         "jobs": list(b.jobs), "cap": b.cap}
+                         "jobs": list(b.jobs), "cap": b.cap,
+                         "filter": choose_engine(b.dims, int(max_iters),
+                                                 model=model)}
                         for b in plan.buckets],
             "pad_waste_frac": plan.pad_waste_frac,
             "predicted_wall_s": plan.predicted_wall_s})
@@ -178,7 +183,11 @@ def advise_fleet(shapes, *, tick_iters: int = 5,
     dispatches.  ``shapes`` is a list of per-tenant (N, T_capacity, k)
     triples; ``tick_iters`` the per-tick warm-EM budget.  Deterministic
     given a fixed profile registry: ties prefer fewer classes, then the
-    smaller class-dims tuple."""
+    smaller class-dims tuple.  Each class carries the evidence-gated
+    ``filter`` engine ``fleet.admission.choose_engine`` would route it to
+    (what ``open_fleet(filter="auto")`` compiles; always "info" on an
+    uncalibrated registry)."""
+    from ..fleet.admission import choose_engine
     from ..sched.buckets import plan_capacity_classes
     from .cost import fit_cost_model
     from .store import RunStore, runs_dir
@@ -204,7 +213,9 @@ def advise_fleet(shapes, *, tick_iters: int = 5,
             "max_classes": mc, "n_classes": len(plan.buckets),
             "classes": [{"dims": {"T": b.dims[0], "N": b.dims[1],
                                   "k": b.dims[2]},
-                         "tenants": list(b.jobs)}
+                         "tenants": list(b.jobs),
+                         "filter": choose_engine(b.dims, int(tick_iters),
+                                                 model=model)}
                         for b in plan.buckets],
             "pad_waste_frac": plan.pad_waste_frac,
             "predicted_tick_wall_s": plan.predicted_wall_s})
@@ -303,7 +314,10 @@ def main(argv=None) -> int:
         for l in res["layouts"]:
             dims = " + ".join(
                 f"({c['dims']['T']},{c['dims']['N']},{c['dims']['k']})"
-                f"x{len(c['tenants'])}" for c in l["classes"])
+                f"x{len(c['tenants'])}"
+                + ("" if c.get("filter", "info") == "info"
+                   else f"[{c['filter']}]")
+                for c in l["classes"])
             print(f"  #{l['rank']}: {l['n_classes']} class"
                   f"{'es' if l['n_classes'] != 1 else ''} {dims:40s} "
                   f"predicted tick {l['predicted_tick_wall_s']:.3f}s, "
@@ -337,7 +351,10 @@ def main(argv=None) -> int:
         for l in res["layouts"]:
             dims = " + ".join(
                 f"({b['dims']['T']},{b['dims']['N']},{b['dims']['k']})"
-                f"x{len(b['jobs'])}" for b in l["buckets"])
+                f"x{len(b['jobs'])}"
+                + ("" if b.get("filter", "info") == "info"
+                   else f"[{b['filter']}]")
+                for b in l["buckets"])
             print(f"  #{l['rank']}: {l['n_buckets']} bucket"
                   f"{'s' if l['n_buckets'] != 1 else ''} {dims:40s} "
                   f"predicted {l['predicted_wall_s']:.3f}s, "

@@ -400,6 +400,15 @@ def record_event(registry: MetricsRegistry, ledger: Optional[Ledger],
         ne = _num(ev.get("n_evicted"))
         if ne:
             registry.counter("evicted_rows_total", tenant=ten).inc(ne)
+        cov = _num(ev.get("coverage"))
+        if cov is not None:
+            # Live band calibration: the observed fraction of this
+            # query's new rows inside the previous query's 90% band
+            # (serving sessions/fleets stamp it per query; conservative
+            # lowrank bands should sit at or above 0.90).
+            registry.gauge("forecast_coverage", tenant=ten).set(cov)
+            registry.histogram("forecast_coverage_pct",
+                               tenant=ten).observe(cov * 100.0)
         if ledger is not None:
             row = ledger.row(sid, ten)
             row["queries"] += 1

@@ -267,13 +267,18 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
             q_div += bool(e.get("diverged"))
             sid = str(e.get("session", "?"))
             ps = q_sessions.setdefault(
-                sid, {"queries": 0, "walls": [], "t_rows": None})
+                sid, {"queries": 0, "walls": [], "t_rows": None,
+                      "engine": None, "covs": []})
             ps["queries"] += 1
             if isinstance(e.get("wall"), (int, float)):
                 ps["walls"].append(float(e["wall"]))
                 q_walls.append(float(e["wall"]))
             if e.get("t_rows") is not None:
                 ps["t_rows"] = int(e["t_rows"])
+            if e.get("engine"):
+                ps["engine"] = str(e["engine"])
+            if isinstance(e.get("coverage"), (int, float)):
+                ps["covs"].append(float(e["coverage"]))
             if e.get("degraded"):
                 n_degraded += 1
                 degraded_sess.append(sid)
@@ -282,11 +287,16 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
                 n_evicting_q += 1
             if e.get("queue_wait") is not None:
                 n_fleet_q += 1
-                pt = fleet_tenant.setdefault(str(e.get("tenant", "?")),
-                                             {"queries": 0, "waits": []})
+                pt = fleet_tenant.setdefault(
+                    str(e.get("tenant", "?")),
+                    {"queries": 0, "waits": [], "engine": None, "covs": []})
                 pt["queries"] += 1
                 if isinstance(e.get("queue_wait"), (int, float)):
                     pt["waits"].append(float(e["queue_wait"]))
+                if e.get("engine"):
+                    pt["engine"] = str(e["engine"])
+                if isinstance(e.get("coverage"), (int, float)):
+                    pt["covs"].append(float(e["coverage"]))
         elif kind == "tick":
             n_ticks += 1
             if (isinstance(e.get("n_active"), (int, float))
@@ -497,6 +507,9 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
         st = _stats(ps.pop("walls"))
         if st:
             ps["query_wall_s"] = st
+        covs = ps.pop("covs")
+        if covs:
+            ps["forecast_coverage"] = sum(covs) / len(covs)
     out["queries"] = {
         "n_queries": n_queries,
         "n_sessions": len(q_sessions),
@@ -517,6 +530,9 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
         st = _stats(pt.pop("waits"))
         if st:
             pt["queue_wait_s"] = st
+        covs = pt.pop("covs")
+        if covs:
+            pt["forecast_coverage"] = sum(covs) / len(covs)
     for pb in per_bucket.values():
         os_ = pb.pop("occ")
         if os_:
@@ -786,12 +802,17 @@ def _print_text(s: dict) -> None:
         for sid, ps in qs.get("per_session", {}).items():
             bits = [f"  session {sid}: {ps['queries']} "
                     f"quer{'ies' if ps['queries'] != 1 else 'y'}"]
+            if ps.get("engine"):
+                bits.append(f"engine {ps['engine']}")
             if ps.get("t_rows") is not None:
                 bits.append(f"{ps['t_rows']} rows held")
             pw = ps.get("query_wall_s") or {}
             if pw:
                 bits.append(f"wall p50 {_fmt_s(pw['p50'])} / "
                             f"p99 {_fmt_s(pw['p99'])}")
+            if isinstance(ps.get("forecast_coverage"), (int, float)):
+                bits.append(f"90% band coverage "
+                            f"{100 * ps['forecast_coverage']:.0f}%")
             print(", ".join(bits))
     fl = s.get("fleet")
     if fl and fl["n_ticks"]:
@@ -824,10 +845,15 @@ def _print_text(s: dict) -> None:
         for tid, pt in fl.get("per_tenant", {}).items():
             bits = [f"  {tid:12s} {pt['queries']} "
                     f"quer{'ies' if pt['queries'] != 1 else 'y'}"]
+            if pt.get("engine"):
+                bits.append(f"engine {pt['engine']}")
             qw = pt.get("queue_wait_s") or {}
             if qw:
                 bits.append(f"queue wait p50 {_fmt_s(qw['p50'])} / "
                             f"p99 {_fmt_s(qw['p99'])}")
+            if isinstance(pt.get("forecast_coverage"), (int, float)):
+                bits.append(f"90% band coverage "
+                            f"{100 * pt['forecast_coverage']:.0f}%")
             print(", ".join(bits))
     a = s.get("advice")
     if a:

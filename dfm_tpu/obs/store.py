@@ -326,6 +326,12 @@ _BENCH_NUMERIC_KEYS = (
     # zero-downtime contract (any drop regresses).
     "daemon_qps", "daemon_p99_ms", "daemon_shed_rate",
     "daemon_handoff_gap_ms", "daemon_dropped_queries",
+    # Engine-complete serving: aggregate wall of a lowrank-routed wide-k
+    # fleet vs its forced-info twin (bench.fleet, same tenants/schedule/
+    # container) and of a pit_qr long-window ring session vs its info
+    # twin (bench.stream) — both higher-is-better speedup ratios (the
+    # regress gate's relative band absorbs twin-ratio timing jitter).
+    "fleet_widek_speedup", "stream_pit_speedup",
 )
 
 
@@ -407,10 +413,11 @@ def backfill(root: str = ".", store: Optional[RunStore] = None,
     store = store or RunStore(runs or runs_dir() or DEFAULT_DIR)
     existing = store.sources()
     n = 0
-    # Round artifacts plus any per-bench artifact that shares the
-    # one-JSON-line-in-"parsed" format (BENCH_stream.json, BENCH_longt.json,
-    # BENCH_kscale.json, ...); BENCH_ALL.json is a different shape and is
-    # handled below.
+    # Round artifacts plus any per-bench artifact in either layout: the
+    # driver wrapper ({"parsed": <one JSON line>, "tail": ...} —
+    # BENCH_stream.json, BENCH_longt.json, ...) or the bare one-JSON-line
+    # payload itself (BENCH_daemon.json); BENCH_ALL.json is a different
+    # shape and is handled below.
     paths = sorted(set(glob.glob(os.path.join(root, "BENCH_*.json")))
                    - {os.path.join(root, "BENCH_ALL.json")})
     for path in paths:
@@ -424,6 +431,8 @@ def backfill(root: str = ".", store: Optional[RunStore] = None,
             print("warning: backfill: %s: %s" % (path, e), file=sys.stderr)
             continue
         parsed = data.get("parsed") or {}
+        if _num(parsed.get("value")) is None:
+            parsed = data          # bare one-JSON-line artifact
         if _num(parsed.get("value")) is None:
             continue
         rec = record_from_bench_json(

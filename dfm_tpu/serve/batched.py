@@ -14,8 +14,15 @@ runs — ``batched_ragged_append`` mirrors the per-tenant scatter,
 final smooth/nowcast/forecast stage mirrors ``_session_core`` line for
 line — so lane b of a fleet tick pins to the same tenant's lone
 ``NowcastSession.update`` at the same budget (tests/test_fleet.py, x64 +
-f32 variants).  The fleet is info-filter-only (the batched twins are
-info-form); parity references use ``TPUBackend(filter="info")``.
+f32 variants).
+
+Engine routing (``_batched_e_step``): a bucket runs any serving engine —
+``info`` keeps the hand-batched info-form twins byte-for-byte, while
+``pit_qr`` and ``lowrank(rank=r)`` vmap the lone masked filter/smoother
+pair over the lane axis (lanes are independent, so the vmap is exact and
+shards under ``fleet_impl_sharded`` without collectives).  One fused
+``serve_update`` executable per (bucket-shape, engine); parity references
+are lone same-engine sessions/fits.
 
 Per-tenant independence inside the one program:
 
@@ -94,6 +101,31 @@ def batched_ring_evict(Ybuf, Wbuf, n_evict, t_cur):
     return jax.vmap(ring_evict)(Ybuf, Wbuf, n_evict, t_cur)
 
 
+def _batched_e_step(Ybuf, Wbuf, p, cfg):
+    """Batched masked E-step routed by ``cfg.filter``.
+
+    ``info`` keeps the hand-batched info-form twins BYTE-IDENTICAL to the
+    pre-routing fleet (``batched_filter_masked`` + ``_batched_rts``);
+    every other engine vmaps the lone masked pair (``cfg.filter_fn`` /
+    ``cfg.smoother_fn``) over the lane axis — exactly the program lane
+    b's lone session would run, so per-tenant parity is by construction.
+    Lanes never interact, so the vmap shards under ``shard_map`` with no
+    collectives.  Returns (loglik (B,), x_sm, P_sm, P_lag).
+    """
+    if cfg.filter == "info":
+        ll, (xp, Pp, xf, Pf) = batched_filter_masked(Ybuf, Wbuf, p)
+        x_sm, P_sm, P_lag = _batched_rts(xp, Pp, xf, Pf, p.A)
+        return ll, x_sm, P_sm, P_lag
+    ff, sf = cfg.filter_fn(), cfg.smoother_fn()
+
+    def one(Y, W, p1):
+        kf = ff(Y, p1, mask=W)
+        sm = sf(kf, p1)
+        return kf.loglik, sm.x_sm, sm.P_sm, sm.P_lag
+
+    return jax.vmap(one)(Ybuf, Wbuf, p)
+
+
 @dataclasses.dataclass(frozen=True)
 class FleetOptions:
     """Static per-bucket program options (hashable jit static).
@@ -125,13 +157,12 @@ def _fleet_em_scan(Ybuf, Wbuf, p0, tol, floor, iter_cap, tick_act, t_new,
 
     def body(c, j):
         p, p_prev, ll_prev, state, n_lls, good_it = c
-        ll, (xp, Pp, xf, Pf) = batched_filter_masked(Ybuf, Wbuf, p)
+        ll, x_sm, P_sm, P_lag = _batched_e_step(Ybuf, Wbuf, p, cfg)
         ll = ll.astype(acc)
         if opts.fault_tenant is not None:   # static chaos seam
             ll = ll.at[opts.fault_tenant].add(jnp.where(
                 j == opts.fault_iter,
                 -jnp.asarray(opts.fault_drop, acc), jnp.zeros((), acc)))
-        x_sm, P_sm, P_lag = _batched_rts(xp, Pp, xf, Pf, p.A)
         p_new = batched_m_step_masked(Ybuf, Wbuf, x_sm, P_sm, P_lag, p,
                                       cfg, t_new)
         live = (state == RUNNING) & (n_lls < iter_cap) & tick_act
@@ -196,23 +227,31 @@ def _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0, tol,
     p_fit, state, n_iters, good_it, lls = _fleet_em_scan(
         Ybuf, Wbuf, p0, tol, floor, iter_cap, tick_act, t_new, cfg,
         max_iters, opts)
-    # Smooth + forecast at the fitted params, same program — the exact
-    # masked filter/smoother pair the lone session core runs.
-    _, (xp, Pp, xf, Pf) = batched_filter_masked(Ybuf, Wbuf, p_fit)
-    x_sm, P_sm, _ = _batched_rts(xp, Pp, xf, Pf, p_fit.A)
+    # Smooth + forecast at the fitted params, same program — the same
+    # engine-routed masked pair the lone session core runs (for pit_qr/
+    # lowrank this IS ``EMConfig.report_pair``; info keeps the batched
+    # info-form twins bit-for-bit).
+    _, x_sm, P_sm, _ = _batched_e_step(Ybuf, Wbuf, p_fit, cfg)
     take = lambda a, t: jnp.take(a, t, axis=0, mode="clip")  # noqa: E731
     x_T = jax.vmap(take)(x_sm, t_new - 1)
     P_T = jax.vmap(take)(P_sm, t_new - 1)
     nowcast = jnp.einsum("bnk,bk->bn", p_fit.Lam, x_T)
+    # Per-lane observation-space one-sigma bands — the batched twin of
+    # the lone session's ``obs_sd`` (conservative under lowrank r < k).
+    obs_sd = lambda P: jnp.sqrt(jnp.maximum(  # noqa: E731
+        jnp.einsum("bnk,bkl,bnl->bn", p_fit.Lam, P, p_fit.Lam) + p_fit.R,
+        jnp.zeros((), Ybuf.dtype)))
+    nowcast_sd = obs_sd(P_T)
 
     def fstep(carry, _):
         x, Pc = carry
         x1 = matvec_vpu(p_fit.A, x)
         P1 = matmul_vpu(matmul_vpu(p_fit.A, Pc), _bT(p_fit.A)) + p_fit.Q
-        return (x1, P1), (x1, jnp.einsum("bnk,bk->bn", p_fit.Lam, x1))
+        return (x1, P1), (x1, jnp.einsum("bnk,bk->bn", p_fit.Lam, x1),
+                          obs_sd(P1))
 
-    _, (f_fore, y_fore) = lax.scan(fstep, (x_T, P_T), None,
-                                   length=opts.horizon)
+    _, (f_fore, y_fore, y_sd) = lax.scan(fstep, (x_T, P_T), None,
+                                         length=opts.horizon)
     di = None
     if opts.di:
         di = jax.vmap(
@@ -230,8 +269,10 @@ def _fleet_core(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0, tol,
         "x_sm": x_sm,
         "P_sm": P_sm,
         "nowcast": nowcast,
+        "nowcast_sd": nowcast_sd,
         "f_fore": jnp.moveaxis(f_fore, 0, 1),    # (B, h, k)
         "y_fore": jnp.moveaxis(y_fore, 0, 1),    # (B, h, N)
+        "y_sd": jnp.moveaxis(y_sd, 0, 1),        # (B, h, N)
         "di": di,
     }
 

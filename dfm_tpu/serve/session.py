@@ -77,8 +77,6 @@ from ..obs.trace import current_tracer, shape_key
 from ..ops.precision import accum_dtype
 from ..robust.dispatch import guarded_dispatch
 from ..robust.health import FitHealth, HealthEvent
-from ..ssm.info_filter import info_filter
-from ..ssm.kalman import kalman_filter, rts_smoother
 from ..ssm.params import SSMParams as JaxParams
 from ..utils.data import build_mask
 from .batched import ring_evict
@@ -86,6 +84,38 @@ from .batched import ring_evict
 __all__ = ["NowcastSession", "SessionUpdate", "open_session"]
 
 _SESSION_IDS = itertools.count(1)
+
+# Engines a session can route (EMConfig.filter values whose masked
+# filter/smoother pairs serve a capacity-padded panel; "ss" and "auto"
+# resolve through the backend's masked pick instead).
+_SERVE_FILTERS = ("dense", "info", "pit", "pit_qr", "lowrank")
+
+# The 90% two-sided band the serving layer reports coverage against —
+# the same z as ``ssm.lowrank_filter.state_coverage``'s default.
+_Z90 = 1.6448536269514722
+
+
+def _resolve_serve_engine(b, res, filter, rank, N):
+    """Resolve a session's filter engine + lowrank rank.
+
+    An explicit ``filter=`` wins; otherwise the fit's RESOLVED engine
+    (``FitResult.filter``, stamped by ``fit``) is inherited when it can
+    serve a masked panel, falling back to the backend's masked auto pick
+    (``_filter_for``) for ss/auto/absent.  ``rank`` rides only with
+    lowrank so every other engine's EMConfig equals the pre-routing one
+    — the same executables, bit-identical serving for existing users.
+    """
+    if filter is not None:
+        flt = str(filter)
+        if flt not in _SERVE_FILTERS:
+            raise ValueError(
+                f"unknown serving filter {filter!r}; sessions route "
+                f"{_SERVE_FILTERS}")
+    else:
+        rf = getattr(res, "filter", None)
+        flt = rf if rf in _SERVE_FILTERS else b._filter_for(N, True)
+    r = int(getattr(b, "rank", 0) if rank is None else rank)
+    return flt, (r if flt == "lowrank" else 0)
 
 
 def live_observe(ev: dict) -> None:
@@ -127,23 +157,34 @@ def _session_core(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0, tol,
                        opts, n_steps=t_new)
     p_fit = f["p"]
     # Smooth + forecast at the fitted params, same program — the exact
-    # filter/smoother pair the fused fit uses (ss never reaches masked
-    # panels: _filter_for(masked=True) returns dense or info only).
-    ff = kalman_filter if cfg.filter == "dense" else info_filter
+    # pair the fused fit uses (EMConfig.report_pair: pit_qr/lowrank
+    # report through their own smoothers, dense/info keep the historical
+    # pairs bit-for-bit; ss never reaches masked panels).
+    ff, sf = cfg.report_pair()
     kf = ff(Ybuf, p_fit, mask=Wbuf)
-    sm = rts_smoother(kf, p_fit)
+    sm = sf(kf, p_fit)
     x_T = jnp.take(sm.x_sm, t_new - 1, axis=0, mode="clip")
     P_T = jnp.take(sm.P_sm, t_new - 1, axis=0, mode="clip")
     nowcast = p_fit.Lam @ x_T
+    # Observation-space one-sigma bands (standardized units): the
+    # smoothed/predicted state covariance pushed through the loadings
+    # plus the idiosyncratic variance.  Under lowrank at r < k these are
+    # the CONSERVATIVE covariances (bands only widen) the serving layer
+    # promotes to first-class outputs; under the exact engines they are
+    # the exact predictive bands.  Free: they ride the one d2h.
+    obs_sd = lambda P: jnp.sqrt(jnp.maximum(  # noqa: E731
+        jnp.einsum("nk,kl,nl->n", p_fit.Lam, P, p_fit.Lam) + p_fit.R,
+        jnp.zeros((), Ybuf.dtype)))
+    nowcast_sd = obs_sd(P_T)
 
     def fstep(carry, _):
         x, P = carry
         x1 = p_fit.A @ x
         P1 = p_fit.A @ P @ p_fit.A.T + p_fit.Q
-        return (x1, P1), (x1, p_fit.Lam @ x1)
+        return (x1, P1), (x1, p_fit.Lam @ x1, obs_sd(P1))
 
-    _, (f_fore, y_fore) = lax.scan(fstep, (x_T, P_T), None,
-                                   length=opts.horizon)
+    _, (f_fore, y_fore, y_sd) = lax.scan(fstep, (x_T, P_T), None,
+                                         length=opts.horizon)
     di = (_di_forecast_core_masked(sm.x_sm, Ybuf, t_new, opts.horizon)
           if opts.di else None)
     return {
@@ -158,8 +199,10 @@ def _session_core(Ybuf, Wbuf, rows, rmask, n_new, n_evict, t_cur, p0, tol,
         "x_sm": sm.x_sm,
         "P_sm": sm.P_sm,
         "nowcast": nowcast,
+        "nowcast_sd": nowcast_sd,
         "f_fore": f_fore,
         "y_fore": y_fore,
+        "y_sd": y_sd,
         "di": di,
     }
 
@@ -199,6 +242,14 @@ class SessionUpdate:
     factor_cov: np.ndarray     # (t, k, k) smoothed covariances
     t: int                     # live panel length after this update
     wall_s: float
+    # First-class uncertainty bands (original units; conservative —
+    # bands only widen — under ``filter="lowrank"`` at r < k, exact
+    # under the exact engines).  ``coverage`` is the observed fraction
+    # of THIS update's new rows inside the PREVIOUS query's 90% band
+    # (None for the first query or a pure re-forecast).
+    nowcast_sd: Optional[np.ndarray] = None    # (N,) one-sigma band
+    forecast_sd: Optional[np.ndarray] = None   # (h, N) per-step bands
+    coverage: Optional[float] = None
 
 
 class NowcastSession:
@@ -214,6 +265,7 @@ class NowcastSession:
                  max_update_rows: int = 8, max_iters: int = 5,
                  tol: float = 1e-6, horizon: Optional[int] = None,
                  di: Optional[bool] = None, ring: bool = False,
+                 filter: Optional[str] = None, rank: Optional[int] = None,
                  backend=None, robust=None):
         from ..api import (CPUBackend, DynamicFactorModel, FitResult,
                            _resolve_policy, get_backend)
@@ -272,11 +324,11 @@ class NowcastSession:
             self._Ybuf = jnp.asarray(self._Yhost, dt)
             self._Wbuf = jnp.asarray(self._Whost, dt)
             self._p = JaxParams.from_numpy(res.params, dtype=dt)
-        flt = b._filter_for(N, True)   # masked: dense or info, never ss
+        flt, rank_r = _resolve_serve_engine(b, res, filter, rank, N)
         self._cfg = EMConfig(estimate_A=res.model.estimate_A,
                              estimate_Q=res.model.estimate_Q,
                              estimate_init=res.model.estimate_init,
-                             filter=flt, debug=False)
+                             filter=flt, rank=rank_r, debug=False)
         self._backend = b
         self._model = res.model
         self._dt = dt
@@ -292,10 +344,13 @@ class NowcastSession:
         self._chunk = max(1, int(getattr(b, "fused_chunk", 8)))
         self._closed = False
         self._n_queries = 0
+        self._last_band = None   # (y_fore, y_sd) of the previous query
         self._sid = f"s{next(_SESSION_IDS)}"
-        self._key = shape_key(self._Ybuf, flt, f"rows{self._r_max}",
-                              f"chunk{self._chunk}",
-                              f"max{self._max_iters}")
+        self._key = shape_key(
+            self._Ybuf, flt,
+            *((f"rank{rank_r}",) if flt == "lowrank" else ()),
+            f"rows{self._r_max}", f"chunk{self._chunk}",
+            f"max{self._max_iters}")
         # Self-healing: inherit the backend's robust policy unless the
         # caller overrides (robust=False -> unguarded original path).
         self._policy = _resolve_policy(
@@ -318,6 +373,17 @@ class NowcastSession:
         """True if the session evicts its oldest rows past capacity
         (unbounded stream) instead of raising."""
         return self._ring
+
+    @property
+    def filter(self) -> str:
+        """Resolved serving engine (inherited from the fit's
+        ``FitResult.filter`` unless ``open_session(filter=)`` overrode)."""
+        return self._cfg.filter
+
+    @property
+    def rank(self) -> int:
+        """Lowrank conditioning rank (0 outside ``filter="lowrank"``)."""
+        return self._cfg.rank
 
     @property
     def total_rows(self) -> int:
@@ -414,6 +480,17 @@ class NowcastSession:
                 [W_rows, np.zeros((pad, self._N), W_rows.dtype)], axis=0)
         t_mid = self._t - n_evict
         t_new = t_mid + n_new
+        # Live coverage: the observed fraction of THIS update's new rows
+        # inside the PREVIOUS query's 90% band (original units; host-only
+        # arithmetic on values already in hand — zero extra dispatches).
+        coverage = None
+        if n_new and self._last_band is not None:
+            pf, ps = self._last_band
+            n_cmp = min(n_new, pf.shape[0])
+            obs = W_rows[:n_cmp] > 0
+            if obs.any():
+                hit = np.abs(rows[:n_cmp] - pf[:n_cmp]) <= _Z90 * ps[:n_cmp]
+                coverage = float(np.mean(hit[obs]))
         # Per-update absolute loglik noise floor at the LIVE panel size —
         # the same floor a cold fit of the extended panel would use.
         floor = noise_floor_for(self._dt, t_new * self._N,
@@ -517,8 +594,11 @@ class NowcastSession:
                    n_new=int(n_new), wall=wall,
                    n_iters=int(host["n_iters"]),
                    N=int(self._N), k=int(self._model.n_factors),
+                   engine=self._cfg.filter,
                    converged=bool(host["status"] == _CONVERGED),
                    diverged=bool(diverged),
+                   **({"coverage": coverage} if coverage is not None
+                      else {}),
                    **({"n_evicted": int(n_evict)} if n_evict else {}),
                    **({"degraded": True} if degraded else {}))
         if tr is not None:
@@ -530,11 +610,18 @@ class NowcastSession:
             live_observe({"t": t0 + wall, "kind": "query", **qev})
         inv = (self._std.inverse if self._std is not None
                else (lambda a: a))
+        # Bands destandardize by the scale alone (the affine shift cancels
+        # in a standard deviation).
+        sd_inv = ((lambda s: s * self._std.scale)
+                  if self._std is not None else (lambda s: s))
+        y_fore = np.asarray(inv(host["y_fore"]))
+        fore_sd = np.asarray(sd_inv(host["y_sd"]))
+        self._last_band = (y_fore, fore_sd)
         di = host["di"]
         n = min(int(host["n_iters"]), self._max_iters)
         return SessionUpdate(
             nowcast=np.asarray(inv(host["nowcast"])),
-            forecasts={"y": np.asarray(inv(host["y_fore"])),
+            forecasts={"y": y_fore,
                        "f": host["f_fore"],
                        "di": np.asarray(inv(di)) if di is not None else None},
             logliks=host["lls"][:n],
@@ -544,7 +631,10 @@ class NowcastSession:
             factors=host["x_sm"][:t_new],
             factor_cov=host["P_sm"][:t_new],
             t=t_new,
-            wall_s=wall)
+            wall_s=wall,
+            nowcast_sd=np.asarray(sd_inv(host["nowcast_sd"])),
+            forecast_sd=fore_sd,
+            coverage=coverage)
 
     def _read(self, out, want_params: bool = False):
         """Materialize the small host-bound outputs (inside the dispatch
@@ -559,8 +649,10 @@ class NowcastSession:
             "good_it": int(out["good_it"]),
             "lls": np.asarray(out["lls"], np.float64),
             "nowcast": np.asarray(out["nowcast"], np.float64),
+            "nowcast_sd": np.asarray(out["nowcast_sd"], np.float64),
             "f_fore": np.asarray(out["f_fore"], np.float64),
             "y_fore": np.asarray(out["y_fore"], np.float64),
+            "y_sd": np.asarray(out["y_sd"], np.float64),
             "di": (np.asarray(out["di"], np.float64)
                    if out["di"] is not None else None),
             "x_sm": np.asarray(out["x_sm"], np.float64),
@@ -638,6 +730,8 @@ class NowcastSession:
                           else np.zeros(0)),
             "capacity": self._capacity,
             "ring": self._ring,
+            "filter": self._cfg.filter,
+            "rank": self._cfg.rank,
             "t_total": self._t_total,
             "max_update_rows": self._r_max,
             "max_iters": self._max_iters,
@@ -658,7 +752,9 @@ class NowcastSession:
     @classmethod
     def restore(cls, path: str, *, backend=None, robust=None,
                 capacity: Optional[int] = None,
-                ring: Optional[bool] = None) -> "NowcastSession":
+                ring: Optional[bool] = None,
+                filter: Optional[str] = None,
+                rank: Optional[int] = None) -> "NowcastSession":
         """Rebuild a warm session from ``snapshot(path)``.
 
         The stored panel is verified against its content fingerprint
@@ -705,6 +801,12 @@ class NowcastSession:
             meta["ring"] = (z["ring"][()] if "ring" in z.files else False)
             meta["t_total"] = (z["t_total"][()] if "t_total" in z.files
                                else Y_live.shape[0])
+            # PR 17 fields: the engine + rank round-trip through the
+            # snapshot; pre-engine snapshots fall back to the backend's
+            # masked auto pick (the pre-PR behavior).
+            meta["filter"] = (str(z["filter"][()]) if "filter" in z.files
+                              else "")
+            meta["rank"] = (int(z["rank"][()]) if "rank" in z.files else 0)
         if fp and panel_fingerprint(Y_live, W_live) != fp:
             raise ValueError(
                 f"session snapshot {path!r} is corrupt: the stored live "
@@ -765,11 +867,16 @@ class NowcastSession:
             self._Ybuf = jnp.asarray(self._Yhost, dt)
             self._Wbuf = jnp.asarray(self._Whost, dt)
             self._p = JaxParams.from_numpy(params, dtype=dt)
-        flt = b._filter_for(N, True)
+        # Engine round-trip: an explicit ``filter=``/``rank=`` override
+        # wins; otherwise the snapshot's stored engine is restored
+        # exactly (pre-engine snapshots fall back to the masked pick).
+        stored = type("_S", (), {"filter": meta["filter"]})()
+        flt, rank_r = _resolve_serve_engine(
+            b, stored, filter, meta["rank"] if rank is None else rank, N)
         self._cfg = EMConfig(estimate_A=model.estimate_A,
                              estimate_Q=model.estimate_Q,
                              estimate_init=model.estimate_init,
-                             filter=flt, debug=False)
+                             filter=flt, rank=rank_r, debug=False)
         self._backend = b
         self._model = model
         self._dt = dt
@@ -785,10 +892,13 @@ class NowcastSession:
         self._chunk = max(1, int(getattr(b, "fused_chunk", 8)))
         self._closed = False
         self._n_queries = int(meta["n_queries"])
+        self._last_band = None
         self._sid = f"s{next(_SESSION_IDS)}"
-        self._key = shape_key(self._Ybuf, flt, f"rows{self._r_max}",
-                              f"chunk{self._chunk}",
-                              f"max{self._max_iters}")
+        self._key = shape_key(
+            self._Ybuf, flt,
+            *((f"rank{rank_r}",) if flt == "lowrank" else ()),
+            f"rows{self._r_max}", f"chunk{self._chunk}",
+            f"max{self._max_iters}")
         self._policy = _resolve_policy(
             getattr(b, "robust", True) if robust is None else robust)
         self.health = FitHealth(engine="serve")
@@ -829,6 +939,11 @@ def open_session(res=None, Y=None, mask=None, *, snapshot=None,
                       (same executable, constant memory, unbounded
                       stream) instead of raising; the session always
                       holds the trailing ``capacity``-row window.
+    filter / rank   : serving engine ("dense", "info", "pit", "pit_qr",
+                      "lowrank") and lowrank conditioning rank; default
+                      inherits the fit's resolved ``FitResult.filter``
+                      (rank from the backend), so a pit_qr or lowrank
+                      fit serves through the same engine it fitted with.
     backend         : "tpu" (default) or a TPUBackend instance.
     robust          : ``RobustPolicy`` / True / False — the self-healing
                       query guard; default inherits the backend's policy.

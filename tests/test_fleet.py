@@ -53,8 +53,11 @@ from dfm_tpu.sched.buckets import plan_capacity_classes
 from dfm_tpu.serve.batched import FleetOptions, _fleet_impl
 from dfm_tpu.utils import dgp
 
-# The fleet core is info-filter-only; parity references must run the
-# same filter (the auto heuristic would pick dense at these small N).
+# Default-engine pins run info explicitly so the lone-session parity
+# reference is deterministic (the auto heuristic would pick dense at
+# these small N, which fleet buckets map to the info twins).  The fleet
+# core routes any engine — per-engine parity is pinned in the ENGINE
+# ROUTING section below against lone SAME-engine sessions.
 BE = TPUBackend(filter="info")
 _PF = ("Lam", "A", "Q", "R", "mu0", "P0")
 
@@ -223,6 +226,187 @@ def test_sharded_fleet_matches_single_device(trio):
         assert a.n_iters == b.n_iters
 
 
+# ----------------------------------------------------- engine routing --
+
+ENGINES = [("pit_qr", 0), ("lowrank", 2)]
+
+
+@pytest.fixture(scope="module")
+def eng_pair():
+    """Two small same-shape tenants for the routed-engine pins.  The
+    pit_qr executables carry a log-depth combine tree whose CPU-mesh
+    compile cost grows quickly with the padded length; the parity
+    contract is shape-independent, so these pins run a small capacity
+    (ragged two-shape bucketing is pinned engine-free above)."""
+    return [_tenant(8, 24, 2, 43), _tenant(8, 24, 2, 44)]
+
+
+@pytest.mark.parametrize("eng,rk", ENGINES)
+def test_fleet_engine_matches_lone_same_engine(eng_pair, eng, rk):
+    """Per-engine parity: a pit_qr/lowrank-routed bucket answers what
+    each tenant's lone SAME-engine session would.  The vmapped engine
+    pair reorders one dot_general per step vs the lone pair (XLA batched
+    lowering), so the x64 pin is near-machine-eps rather than bit-exact;
+    the info engine is pinned truly bit-identical below."""
+    kw = dict(capacity=28, max_update_rows=3, max_iters=4, tol=0.0)
+    fl = open_fleet([t[0] for t in eng_pair], [t[1] for t in eng_pair],
+                    filter=eng, rank=rk, max_classes=1, **kw)
+    want_rk = rk if eng == "lowrank" else 0
+    for c in fl.classes:
+        assert c["filter"] == eng and c["rank"] == want_rk
+    lone = [open_session(t[0], t[1], filter=eng, rank=rk, **kw)
+            for t in eng_pair]
+    for i, n in enumerate((1, 3)):
+        fl.submit(f"t{i}", eng_pair[i][2][:n])
+    out = fl.drain()
+    for i, n in enumerate((1, 3)):
+        _assert_matches(out[f"t{i}"][0], lone[i].update(eng_pair[i][2][:n]),
+                        tol=1e-9, atol=1e-10)
+    for s in lone:
+        s.close()
+    fl.close()
+
+
+def test_fleet_engine_matches_lone_f32():
+    b32 = TPUBackend(dtype=jnp.float32, filter="lowrank", rank=2)
+    tens = [_tenant(10, 32, 2, 33, backend=b32),
+            _tenant(10, 32, 2, 34, backend=b32)]
+    fl = _open(tens, backend=b32, capacity=40, max_iters=3,
+               filter="lowrank", rank=2)
+    lone = [_lone(t[0], t[1], backend=b32, capacity=40, max_iters=3,
+                  filter="lowrank", rank=2) for t in tens]
+    for i, n in enumerate((2, 1)):
+        fl.submit(f"t{i}", tens[i][2][:n])
+    out = fl.drain()
+    for i, n in enumerate((2, 1)):
+        u, ref = out[f"t{i}"][0], lone[i].update(tens[i][2][:n])
+        assert u.n_iters == ref.n_iters
+        np.testing.assert_allclose(u.nowcast, ref.nowcast, rtol=5e-3,
+                                   atol=5e-3)
+        np.testing.assert_allclose(u.factors, ref.factors, rtol=5e-3,
+                                   atol=5e-3)
+    fl.close()
+
+
+def test_fleet_info_explicit_bit_identical_to_default(trio):
+    """filter="info" routes through the byte-identical hand-batched
+    filter/smoother twins the pre-routing fleet always ran: explicit
+    info vs the default (inherited) engine is BIT-identical."""
+    outs = []
+    for kw in ({}, {"filter": "info"}):
+        fl = _open(trio, **kw)
+        assert all(c["filter"] == "info" and c["rank"] == 0
+                   for c in fl.classes)
+        for i, n in enumerate((2, 1, 3)):
+            fl.submit(f"t{i}", trio[i][2][:n])
+        outs.append(fl.drain())
+        fl.close()
+    for t in ("t0", "t1", "t2"):
+        a, b = outs[0][t][0], outs[1][t][0]
+        np.testing.assert_array_equal(a.nowcast, b.nowcast)
+        np.testing.assert_array_equal(a.factors, b.factors)
+        np.testing.assert_array_equal(a.forecasts["y"], b.forecasts["y"])
+        np.testing.assert_array_equal(a.logliks, b.logliks)
+
+
+def test_fleet_engine_inherits_fit_filter():
+    """No filter= needed: a pit_qr fit serves through pit_qr buckets
+    (FitResult.filter inheritance); non-routable engines map to info;
+    unknown names raise."""
+    bq = TPUBackend(filter="pit_qr")
+    res, Y0, _ = _tenant(8, 24, 2, 41, backend=bq)
+    assert res.filter == "pit_qr"
+    fl = open_fleet([res], [Y0], capacity=32, max_iters=2, tol=0.0,
+                    backend=bq)
+    assert fl.classes[0]["filter"] == "pit_qr"
+    fl.close()
+    rd, Yd, _ = _tenant(8, 24, 2, 42, backend=TPUBackend(filter="dense"))
+    fl = open_fleet([rd], [Yd], capacity=32, max_iters=2, tol=0.0)
+    assert fl.classes[0]["filter"] == "info"
+    fl.close()
+    with pytest.raises(ValueError, match="unknown fleet filter"):
+        open_fleet([rd], [Yd], filter="dense")
+
+
+def test_choose_engine_evidence_gate():
+    """The PR 15 evidence gate carried into serving: an engine whose
+    residual scale was never profiled is not an "auto" candidate even
+    when its structural prior is cheaper."""
+    from dfm_tpu.fleet.admission import choose_engine
+
+    class _M:
+        pit_qr_calibrated = False
+        lowrank_calibrated = False
+
+        def iter_s(self, N, T, k, filt="seq"):
+            return {"seq": 1.0, "pit_qr": 0.2, "lowrank": 0.1}[filt]
+
+    m = _M()
+    assert choose_engine((56, 12, 2), 4, model=m) == "info"
+    m.pit_qr_calibrated = True
+    assert choose_engine((56, 12, 2), 4, model=m) == "pit_qr"
+    m.lowrank_calibrated = True
+    assert choose_engine((56, 12, 8), 4, rank=2, model=m) == "lowrank"
+    # rank >= k: the downdate cannot drop work — next-best engine wins.
+    assert choose_engine((56, 12, 2), 4, rank=2, model=m) == "pit_qr"
+
+
+def test_fleet_auto_engine_empty_registry_is_info(trio, tmp_path):
+    """filter="auto" with nothing profiled keeps every gate closed: the
+    fleet compiles exactly the info engine."""
+    fl = _open(trio, filter="auto", runs=str(tmp_path / "empty_runs"))
+    assert all(c["filter"] == "info" for c in fl.classes)
+    fl.close()
+
+
+def test_fleet_bands_and_coverage(trio):
+    """Rank-r conservative bands as first-class outputs: nowcast_sd /
+    forecast_sd ride the existing d2h; the NEXT query scores realized
+    rows against the previous 90% bands (host-side, zero dispatches)."""
+    fl = _open(trio)
+    N = trio[0][1].shape[1]
+    fl.submit("t0", trio[0][2][:2])
+    u1 = fl.drain()["t0"][0]
+    assert u1.nowcast_sd.shape == (N,) and (u1.nowcast_sd > 0).all()
+    assert u1.forecast_sd.shape == u1.forecasts["y"].shape
+    assert u1.coverage is None          # nothing was predicted before
+    fl.submit("t0", trio[0][2][2:3])
+    u2 = fl.drain()["t0"][0]
+    rows = trio[0][2][2:3]
+    hit = (np.abs(rows[0] - u1.forecasts["y"][0])
+           <= 1.6448536269514722 * u1.forecast_sd[0])
+    assert u2.coverage == pytest.approx(float(np.mean(hit)))
+    fl.close()
+
+
+def test_fleet_snapshot_roundtrip_engine(trio, tmp_path):
+    """snapshot_all → restore_fleet round-trips the engine + rank per
+    tenant (manifest back-compat: missing keys restore as info)."""
+    from dfm_tpu.fleet.driver import restore_fleet
+    fl = _open(trio, filter="lowrank", rank=2)
+    fl.submit("t0", trio[0][2][:1])
+    fl.drain()
+    d = str(tmp_path / "snap")
+    fl.snapshot_all(d)
+    fl.close()
+    fl2 = restore_fleet(d, backend=BE)
+    assert all(c["filter"] == "lowrank" and c["rank"] == 2
+               for c in fl2.classes)
+    fl2.close()
+    # Pre-engine manifest (no filter/rank keys): defaults to info.
+    import json as _json
+    mpath = tmp_path / "snap" / "manifest.json"
+    man = _json.loads(mpath.read_text())
+    for ten in man["tenants"]:
+        ten.pop("filter", None)
+        ten.pop("rank", None)
+    mpath.write_text(_json.dumps(man))
+    fl3 = restore_fleet(d, backend=BE)
+    assert all(c["filter"] == "info" and c["rank"] == 0
+               for c in fl3.classes)
+    fl3.close()
+
+
 # ------------------------------------- scatter-append padding seams --
 
 def _tick_direct(bk, rows, n_new, active=True):
@@ -347,6 +531,10 @@ def test_fleet_trace_budget_and_report_section(trio):
     assert fs["per_bucket"]["0"]["ticks"] == 4
     for t in ("t0", "t1", "t2"):
         assert fs["per_tenant"][t]["queue_wait_s"]["p99"] >= 0
+        # Engine stamp + realized band coverage ride the query events
+        # (t0/t1/t2 all answered >= 2 queries, so coverage resolved).
+        assert fs["per_tenant"][t]["engine"] == "info"
+        assert 0.0 <= fs["per_tenant"][t]["forecast_coverage"] <= 1.0
     q = s["queries"]
     assert q["recompiles_after_warmup"] == 0
     assert q["per_session"][fl.fleet_id]["queries"] == 8
@@ -479,6 +667,9 @@ def test_advise_fleet_deterministic(tmp_path):
         names = sorted(t for c in l["classes"] for t in c["tenants"])
         assert names == list(range(5))
         assert l["predicted_tick_wall_s"] > 0
+        # Engine-annotated layouts: every class carries the evidence-
+        # gated engine choice — "info" on an uncalibrated registry.
+        assert all(c["filter"] == "info" for c in l["classes"])
     assert a["calibrated"] is False      # empty registry -> priors only
 
 

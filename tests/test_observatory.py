@@ -129,6 +129,33 @@ def test_backfill_checked_in_artifacts(tmp_path):
     assert len(store.load()) == len(recs)
 
 
+def test_backfill_seeds_complete_serving_baseline(tmp_path):
+    """Every bench family's artifact is checked in (PR 17 satellite):
+    one ``store backfill`` on a fresh registry seeds a regression
+    baseline for EVERY serving CLI — including the engine-leg metrics —
+    and a second import appends nothing."""
+    store = obs_store.RunStore(str(tmp_path))
+    obs_store.backfill(REPO, store=store)
+    recs = store.load()
+    by_kind = {}
+    for r in recs:
+        by_kind.setdefault(r["kind"], []).append(r)
+    for kind in ("bench", "bench_all", "bench_longt", "bench_kscale",
+                 "bench_stream", "bench_serve", "bench_mixed",
+                 "bench_fleet", "bench_daemon"):
+        assert kind in by_kind, f"no checked-in artifact seeds {kind}"
+    # The engine-leg speedups ride the fleet/stream artifacts so
+    # obs.regress gates them from the first live run.
+    fleet_metrics = {k for r in by_kind["bench_fleet"]
+                     for k in r["metrics"]}
+    stream_metrics = {k for r in by_kind["bench_stream"]
+                      for k in r["metrics"]}
+    assert "fleet_widek_speedup" in fleet_metrics
+    assert "stream_pit_speedup" in stream_metrics
+    assert obs_store.backfill(REPO, store=store) == 0
+    assert len(store.load()) == len(recs)
+
+
 def test_backfill_glob_infers_kind_per_file(tmp_path):
     """The importer sweeps EVERY ``BENCH_*.json`` (not a hand-kept list):
     a new bench CLI's checked-in artifact seeds history the moment it

@@ -307,3 +307,6 @@ def test_advise_jobs_ranks_layouts_deterministically(tmp_path):
     for l in a["layouts"]:
         covered = sorted(j for bk in l["buckets"] for j in bk["jobs"])
         assert covered == list(range(len(shapes)))
+        # Engine-annotated layouts: the evidence-gated choice is "info"
+        # on an uncalibrated registry (no engine was ever profiled).
+        assert all(bk["filter"] == "info" for bk in l["buckets"])

@@ -140,6 +140,152 @@ def test_update_matches_cold_fit_f32(panel):
     assert u.n_iters == ref.n_iters
 
 
+# ----------------------------------------------------- engine routing --
+
+def _eng_backend(eng, rk=0):
+    return TPUBackend(filter=eng, rank=rk)
+
+
+@pytest.fixture(scope="module")
+def eng_panel():
+    """Small panel for the routed-engine pins.  The pit_qr executables
+    carry a log-depth combine tree whose CPU-mesh compile cost grows
+    quickly with the padded length; the parity contract is
+    shape-independent, so these pins run the smallest shape that still
+    pads (capacity > T) and masks (one NaN cell)."""
+    rng = np.random.default_rng(17)
+    p = dgp.dfm_params(N=8, k=2, rng=rng)
+    Y, _ = dgp.simulate(p, T=32, rng=rng)
+    Y[2, 3] = np.nan
+    return Y
+
+
+@pytest.mark.parametrize("eng,rk", [("pit_qr", 0), ("lowrank", 2)])
+def test_session_engine_matches_cold_fused_fit(eng_panel, eng, rk):
+    """Per-engine parity: a session opened on a pit_qr/lowrank fit
+    inherits the engine and pins to a cold SAME-engine ``fit(fused=True)``
+    of the concatenated panel.  fp tolerance, not exactness: the pit_qr
+    combine tree (and the lowrank downdate ordering) reassociates across
+    the capacity-padded length.  (Chained-update pinning is engine-free
+    session state and covered by the info tests above; the smoke legs
+    chain updates through both engines.)"""
+    b = _eng_backend(eng, rk)
+    # res0 runs at the oracle's exact (T, max_iters, tol) statics so the
+    # inheritance fit and the parity reference ride ONE compiled program
+    # per engine; where its start params came from is irrelevant to the
+    # pin (session from res0.params over 29 rows == cold fit from the
+    # same params).
+    res0 = fit(MODEL, eng_panel[:29], backend=b, fused=True, max_iters=5,
+               tol=0.0)
+    assert res0.filter == eng
+    Y0 = eng_panel[:26]
+    sess = open_session(res0, Y0, backend=b, capacity=30,
+                        max_update_rows=3, max_iters=5, tol=0.0)
+    assert sess.filter == eng and sess.rank == (rk if eng == "lowrank"
+                                                else 0)
+    u1 = sess.update(eng_panel[26:29])
+    ref1 = _cold_ref(eng_panel[:29], res0.params, 5, backend=b)
+    _assert_update_matches(u1, ref1, states_tol=1e-8, ll_rtol=1e-6)
+    sess.close()
+
+
+def test_session_engine_inherit_and_override(panel):
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=6, tol=1e-6)
+    # Explicit filter= wins over the fit's resolved engine.
+    sess = open_session(res0, Y0, capacity=60, max_update_rows=2,
+                        max_iters=2, filter="lowrank", rank=2)
+    assert sess.filter == "lowrank" and sess.rank == 2
+    sess.close()
+    # Non-lowrank engines zero the rank.
+    sess = open_session(res0, Y0, capacity=60, max_update_rows=2,
+                        max_iters=2, filter="info", rank=3)
+    assert sess.filter == "info" and sess.rank == 0
+    sess.close()
+    with pytest.raises(ValueError, match="filter"):
+        open_session(res0, Y0, filter="nope")
+
+
+def test_session_snapshot_roundtrip_engine(eng_panel, tmp_path):
+    """snapshot → restore round-trips the engine + rank (lowrank carries
+    BOTH keys; pit_qr's snapshot path is pinned by test_stream's ring
+    round-trip); a pre-engine snapshot (no filter/rank keys) restores
+    through the backend's auto resolution."""
+    b = _eng_backend("lowrank", 2)
+    # Identical fit/session statics to the lowrank pin above: zero new
+    # executables in this test.
+    res0 = fit(MODEL, eng_panel[:29], backend=b, fused=True, max_iters=5,
+               tol=0.0)
+    Y0 = eng_panel[:26]
+    sess = open_session(res0, Y0, backend=b, capacity=30,
+                        max_update_rows=3, max_iters=5, tol=0.0)
+    sess.update(eng_panel[26:28])
+    p = str(tmp_path / "s.npz")
+    sess.snapshot(p)
+    sess2 = open_session(snapshot=p, backend=b)
+    assert sess2.filter == "lowrank" and sess2.rank == 2
+    ua = sess.update(eng_panel[28:30])
+    ub = sess2.update(eng_panel[28:30])
+    np.testing.assert_array_equal(ua.nowcast, ub.nowcast)
+    np.testing.assert_array_equal(ua.logliks, ub.logliks)
+    sess.close()
+    sess2.close()
+    # Strip the engine keys: the restore resolves via the backend.
+    with np.load(p) as z:
+        data = {k: z[k] for k in z.files if k not in ("filter", "rank")}
+    p_old = str(tmp_path / "old.npz")
+    np.savez(p_old, **data)
+    sess3 = open_session(snapshot=p_old)
+    assert sess3.filter in ("dense", "info", "pit", "pit_qr", "lowrank")
+    sess3.close()
+
+
+def test_session_bands_and_coverage(panel):
+    """Conservative uncertainty bands as first-class outputs: per-series
+    nowcast_sd + per-step forecast_sd ride the query's one d2h; the NEXT
+    update scores realized rows against the previous 90% bands."""
+    from dfm_tpu.serve.session import _Z90
+    Y0 = panel[:40]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=8, tol=1e-6)
+    sess = open_session(res0, Y0, capacity=64, max_update_rows=4,
+                        max_iters=3, tol=0.0, horizon=2)
+    u1 = sess.update(panel[40:42])
+    assert u1.nowcast_sd.shape == (12,) and (u1.nowcast_sd > 0).all()
+    assert u1.forecast_sd.shape == (2, 12)
+    assert (u1.forecast_sd > 0).all()
+    assert u1.coverage is None          # nothing was predicted before
+    u2 = sess.update(panel[42:44])
+    hit = (np.abs(panel[42:44] - u1.forecasts["y"][:2])
+           <= _Z90 * u1.forecast_sd[:2])
+    assert u2.coverage == pytest.approx(float(np.mean(hit)))
+    assert 0.0 <= u2.coverage <= 1.0
+    sess.close()
+
+
+def test_query_events_and_report_carry_engine_coverage(eng_panel):
+    """Traced queries stamp the resolved engine; realized coverage rides
+    the query event into summarize()'s per-session section and the text
+    report.  (Statics match the lowrank pins above: one shared serve
+    executable across the engine tests.)"""
+    Y0 = eng_panel[:26]
+    res0 = fit(MODEL, Y0, fused=True, max_iters=6, tol=1e-6)
+    tr = Tracer()
+    with activate(tr):
+        sess = open_session(res0, Y0, capacity=30, max_update_rows=3,
+                            max_iters=5, tol=0.0, filter="lowrank", rank=2)
+        sess.update(eng_panel[26:28])
+        sess.update(eng_panel[28:30])
+        sess.close()
+    q = [e for e in tr.events if e.get("kind") == "query"]
+    assert all(e.get("engine") == "lowrank" for e in q)
+    assert "coverage" not in q[0] and isinstance(q[1]["coverage"], float)
+    s = summarize(tr.events)
+    ps = s["queries"]["per_session"][sess.session_id]
+    assert ps["engine"] == "lowrank"
+    assert ps["forecast_coverage"] == pytest.approx(q[1]["coverage"])
+    _print_text(s)
+
+
 def test_pure_reforecast_update(panel):
     """Satellite (ISSUE 11): ``update(None)`` is a pure RE-FORECAST —
     no append, t unchanged, SAME executable and exactly one blocking

@@ -38,8 +38,10 @@ from dfm_tpu.sched.buckets import lane_rent_bytes
 from dfm_tpu.utils import dgp
 
 MODEL = DynamicFactorModel(n_factors=2, standardize=False)
-# The fleet core is info-filter-only; parity references must run the
-# same filter (the auto heuristic would pick dense at these small N).
+# Default-engine pins run info explicitly so parity references are
+# deterministic (the auto heuristic would pick dense at these small N,
+# which fleet buckets map to the info twins); ring eviction under the
+# routed engines is pinned in test_ring_engine_roundtrip below.
 BE = TPUBackend(filter="info")
 
 
@@ -276,6 +278,33 @@ def test_snapshot_restore_smaller_capacity_keeps_trailing_window(
     ref = _cold_ref(panel[9:45], p_now, 3)
     _assert_update_matches(u2, ref)
     assert np.isfinite(u1.nowcast).all()
+    re.close()
+
+
+def test_ring_engine_roundtrip(panel, tmp_path):
+    """Ring eviction under a routed engine: a pit_qr ring session past
+    capacity pins to a cold SAME-engine fused fit of the trailing window
+    (fp tolerance — the parallel-scan combine tree reassociates), and a
+    snapshot restore into a SMALLER capacity keeps the engine.  Runs a
+    small window: pit_qr CPU-mesh compiles grow quickly with the scan
+    length and the ring contract is shape-independent."""
+    b = TPUBackend(filter="pit_qr")
+    Y0 = panel[:28]
+    # Same (T, max_iters, tol) as the trailing-window oracle below, so
+    # both cold fits ride ONE compiled pit program.
+    res0 = fit(MODEL, Y0, backend=b, fused=True, max_iters=4, tol=0.0)
+    sess = open_session(res0, Y0, backend=b, capacity=28,
+                        max_update_rows=4, max_iters=4, tol=0.0,
+                        ring=True)
+    assert sess.filter == "pit_qr"
+    u1 = sess.update(panel[28:31])        # evicts rows 0-2 in graph
+    assert sess.n_evicted == 3 and sess.total_rows == 31
+    ref1 = _cold_ref(panel[3:31], res0.params, 4, backend=b)
+    _assert_update_matches(u1, ref1, tol=1e-8, atol=1e-8, ll_rtol=1e-6)
+    path = sess.snapshot(str(tmp_path / "ring_eng.npz"))
+    sess.close()
+    re = open_session(snapshot=path, capacity=24, backend=b)
+    assert re.filter == "pit_qr" and re.capacity == 24 and re.ring
     re.close()
 
 

@@ -12,6 +12,15 @@
 #      guarded session while its bucket-mates stay BIT-IDENTICAL to a
 #      fault-free twin fleet, and that the evicted tenant's next query
 #      still answers (healed on the lone session).
+#   3. Engine: a lowrank-routed fleet bucket (filter="lowrank", rank=r
+#      with r < k, the genuinely approximate regime) compiles ONE rank-r
+#      serve_update executable (0 recompiles after warmup, <= 1 blocking
+#      d2h per tick) and answers every tenant like its lone same-engine
+#      session — which tests/test_serve.py pins to a lone same-engine
+#      fused fit, so the cold-fit anchor is transitive.  One warm EM
+#      iteration per query keeps the approximate E-step out of the
+#      divergence guard's rollback path (rollback choice is threshold-
+#      sensitive and not a cross-path parity contract).
 #
 # Usage (from the repo root):
 #   tools/fleet_smoke.sh
@@ -27,6 +36,8 @@ OUT=$(JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" DFM_RUNS= \
       DFM_BENCH_ROUNDS="${DFM_BENCH_ROUNDS:-5}" \
       DFM_BENCH_SERVE_ITERS="${DFM_BENCH_SERVE_ITERS:-3}" \
       DFM_BENCH_ITERS="${DFM_BENCH_ITERS:-20}" \
+      DFM_BENCH_FLEET_WIDEK_MIX="${DFM_BENCH_FLEET_WIDEK_MIX:-60,80,50x1}" \
+      DFM_BENCH_WIDEK_ROUNDS="${DFM_BENCH_WIDEK_ROUNDS:-1}" \
       python -m bench.fleet)
 echo "$OUT"
 
@@ -118,6 +129,97 @@ assert np.isfinite(upd.nowcast).all() and not upd.diverged, \
 fleet.close()
 print(f"chaos: post-quarantine t1 query healed on its lone session "
       f"(t={upd.t})")
+PY
+
+echo "--- fleet smoke: lowrank engine leg ---"
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" DFM_RUNS= python - <<'PY'
+import tempfile
+import warnings
+
+import numpy as np
+
+import jax
+jax.config.update("jax_enable_x64", True)   # tight engine-parity asserts
+
+from dfm_tpu import (DynamicFactorModel, TPUBackend, fit, open_fleet,
+                     open_session)
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.report import summarize
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.utils import dgp
+
+# A fleet bucket routed through the rank-r downdate engine at r < k
+# (the genuinely approximate regime): one lowrank serve_update
+# executable serves every tick, the serving budgets hold, and each
+# tenant's answer matches its LONE same-engine session query-for-query
+# (the vmapped engine pair reassociates ~1 ulp/dot vs the lone pair —
+# fp tolerance, not exactness).  ONE warm iteration per query: the
+# approximate E-step is non-monotone, and multi-iteration tol=0.0
+# serving can trip the divergence guard on a borderline dip — the
+# rollback point is threshold-chosen, hence ulp-sensitive, and
+# fleet-vs-lone parity through a rollback is deliberately NOT a
+# contract.
+K, RANK, ITERS, TICKS = 6, 2, 1, 3
+be = TPUBackend(filter="lowrank", rank=RANK)
+model = DynamicFactorModel(n_factors=K)
+ress, Ys, streams = [], [], []
+for i in range(3):
+    rg = np.random.default_rng(170 + i)
+    Yi, _ = dgp.simulate(dgp.dfm_params(20, K, rg), 46, rg)
+    ress.append(fit(model, Yi[:40], max_iters=10, backend=be,
+                    fused=True, telemetry=False))
+    Ys.append(Yi[:40]); streams.append(Yi[40:])
+
+kw = dict(capacity=52, max_update_rows=2, max_iters=ITERS, tol=0.0,
+          backend=be)
+trace = tempfile.mktemp(suffix=".jsonl")
+tr = Tracer(path=trace, detector=RecompileDetector())
+with activate(tr), warnings.catch_warnings():
+    warnings.simplefilter("ignore", RuntimeWarning)
+    fl = open_fleet(ress, Ys, max_classes=1, filter="lowrank",
+                    rank=RANK, **kw)
+    assert all(c["filter"] == "lowrank" and c["rank"] == RANK
+               for c in fl.classes), fl.classes
+    outs = []
+    for t in range(TICKS):
+        for i, name in enumerate(fl.tenants):
+            fl.submit(name, streams[i][2 * t:2 * t + 2])
+        outs.append(fl.drain())
+    names = fl.tenants
+    fl.close()
+tr.close()
+
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore", RuntimeWarning)
+    lone = [open_session(ress[i], Ys[i], filter="lowrank", rank=RANK,
+                         **kw) for i in range(3)]
+    for t in range(TICKS):
+        for i, name in enumerate(names):
+            u = outs[t][name][0]
+            ref = lone[i].update(streams[i][2 * t:2 * t + 2])
+            assert u.n_iters == ref.n_iters
+            np.testing.assert_allclose(u.nowcast, ref.nowcast,
+                                       rtol=1e-9, atol=1e-10)
+            np.testing.assert_allclose(u.forecasts["y"],
+                                       ref.forecasts["y"],
+                                       rtol=1e-9, atol=1e-10)
+            assert u.nowcast_sd is not None and np.all(u.nowcast_sd > 0), \
+                f"engine leg FAILED: {name} missing conservative bands"
+    for s in lone:
+        s.close()
+
+s = summarize(tr.events)
+q, fs = s["queries"], s["fleet"]
+assert q["recompiles_after_warmup"] == 0, \
+    f"engine leg FAILED: {q['recompiles_after_warmup']} recompiles"
+assert s["blocking_transfers"] <= TICKS, \
+    f"engine leg FAILED: {s['blocking_transfers']} d2h for {TICKS} ticks"
+assert all(fs["per_tenant"][n]["engine"] == "lowrank" for n in names), \
+    "engine leg FAILED: report did not stamp the lowrank engine"
+print(f"engine leg: lowrank(rank={RANK}) fleet == lone same-engine "
+      f"sessions across {TICKS} ticks x {len(names)} tenants; "
+      f"{s['blocking_transfers']} d2h, 0 recompiles after warmup, "
+      "bands present")
 PY
 
 echo "fleet smoke: all gates passed"

@@ -215,5 +215,18 @@ assert store.noise_floor("daemon_shed_rate") > 0, \
 assert store.noise_floor("daemon_dropped_queries") == 0, \
     "perf_gate: daemon_dropped_queries must gate exactly (zero-downtime)"'
 
+# The engine-complete serving metrics (bench.fleet wide-k leg +
+# bench.stream pit_qr ring leg) must stay registered: both are
+# engine-vs-forced-info-twin speedup ratios gating higher-is-better
+# (the regress gate's relative band absorbs twin-ratio timing jitter).
+python -c '
+from dfm_tpu.obs import store
+need = ("fleet_widek_speedup", "stream_pit_speedup")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+for k in need:
+    assert not store.lower_is_better(k), \
+        f"perf_gate: {k} must gate higher-is-better"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"

@@ -7,8 +7,12 @@
 # query.  A second leg opens a fleet with more registered tenants than
 # resident HBM lanes, churns the hot set through warm AND cold tiers,
 # and asserts every paged-out tenant heals BIT-EXACT against an all-hot
-# twin after re-admission.  The quick way to answer "can this serve an
-# infinite stream at constant memory" without the real chip.
+# twin after re-admission.  A third leg rides the ring on the square-root
+# parallel-in-time engine (filter="pit_qr"): eviction + warm EM + bands
+# through the PIT scans, pinned to a cold same-engine fused fit of the
+# trailing window, engine surviving a snapshot/restore round-trip.  The
+# quick way to answer "can this serve an infinite stream at constant
+# memory" without the real chip.
 #
 # Usage (from the repo root):
 #   tools/stream_smoke.sh [trace_path]       # default /tmp/dfm_stream.jsonl
@@ -110,6 +114,58 @@ with tempfile.NamedTemporaryFile(suffix=".npz") as f:
 fl.close(); tw.close()
 print(f"tiering: 4 tenants on 2 lanes, {n_paged} re-admissions + one "
       "cold round-trip, all bit-exact vs the all-hot twin")
+
+# -- leg 3: pit_qr ring session -----------------------------------------
+# The square-root parallel-in-time engine behind the same ring seam:
+# in-graph eviction + warm EM + forecasts through the PIT combine tree,
+# pinned to a cold same-engine fused fit of the trailing window (the
+# combine tree reassociates across capacity padding — fp tolerance, not
+# exactness), engine + ring surviving a snapshot/restore round-trip.
+import os
+
+import jax
+jax.config.update("jax_enable_x64", True)
+
+from dfm_tpu import TPUBackend
+
+bq = TPUBackend(filter="pit_qr")
+rng3 = np.random.default_rng(16)
+Yq, _ = dgp.simulate(dgp.dfm_params(12, 2, rng3), 48, rng3)
+# standardize=False: the session freezes scaling stats at open, so the
+# trailing-window cold-fit pin is exact only without re-standardization
+# (same convention as tests/test_stream.py).
+mq = DynamicFactorModel(n_factors=2, standardize=False)
+resq = fit(mq, Yq[:40], max_iters=10,
+           backend=bq, fused=True, telemetry=False)
+assert resq.filter == "pit_qr", resq.filter
+# The panel opens FULL, so the first update already evicts: its answer
+# is pinned against a cold same-engine fused fit of the trailing
+# window from the same start params at the same budget.
+sq = open_session(resq, Yq[:40], capacity=40, max_update_rows=4,
+                  max_iters=4, tol=0.0, backend=bq, ring=True)
+assert sq.filter == "pit_qr", sq.filter
+uq = sq.update(Yq[40:44])                 # evicts 4 oldest rows in-graph
+assert sq.n_evicted == 4, sq.n_evicted
+refq = fit(mq, Yq[4:44], backend=bq,
+           fused=True, max_iters=4, tol=0.0, init=resq.params)
+assert uq.n_iters == refq.n_iters
+np.testing.assert_allclose(uq.nowcast, refq.nowcast, rtol=1e-8, atol=1e-8)
+np.testing.assert_allclose(uq.forecasts["y"], refq.forecasts["y"],
+                           rtol=1e-8, atol=1e-8)
+assert uq.nowcast_sd is not None and np.all(uq.nowcast_sd > 0), \
+    "pit leg FAILED: missing observation-space bands"
+
+snap = tempfile.mktemp(suffix=".npz")
+sq.snapshot(snap)
+sq.close()
+from dfm_tpu import open_session as _reopen
+sr = _reopen(snapshot=snap, backend=bq)
+assert sr.filter == "pit_qr" and sr.ring, (sr.filter, sr.ring)
+ur = sr.update(Yq[44:48])
+assert np.isfinite(ur.nowcast).all() and sr.n_evicted == 8
+sr.close(); os.unlink(snap)
+print("pit_qr ring leg: eviction + warm EM pinned to the trailing-window "
+      "same-engine cold fit; engine + ring survived snapshot/restore")
 PY
 
 echo "--- stream smoke gate ($TRACE) ---"
