@@ -187,6 +187,10 @@ def main():
             f"served during swap, {dropped} dropped")
 
         st = succ.status()
+        dr = st.get("drift") or {}
+        log(f"drift plane: armed={dr.get('armed')}, "
+            f"{len(dr.get('per_tenant', {}))} tenants scored, "
+            f"last swap seq {dr.get('last_swap_seq')}")
         cli.shutdown()
         th2.join(timeout=60)
         daemon.close()
@@ -216,6 +220,12 @@ def main():
         "n_backpressure": int(st["n_backpressure"]),
         "n_snapshots": int(st["n_snapshots"]),
         "journal_seq": int(st["journal_seq"]),
+        # Model-quality trail (status "drift" section): per-tenant drift
+        # scores + the journal seq of the latest hot swap, if any.
+        "drift_armed": bool(dr.get("armed")),
+        "drift_scores": {t: v.get("drift_score")
+                         for t, v in dr.get("per_tenant", {}).items()},
+        "last_swap_seq": dr.get("last_swap_seq"),
         "serve_iters": serve_iters,
         "mix": mix,
         "run_id": new_run_id(),

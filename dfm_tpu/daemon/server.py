@@ -135,6 +135,10 @@ class DFMDaemon:
         self.n_snapshots = 0
         self.n_handoffs = 0
         self._since_snapshot = 0
+        # Maintenance visibility: journal seq at which the most recent
+        # params swap (live-plane swaps_total movement) became visible.
+        self._seen_swaps = 0
+        self._last_swap_seq: Optional[int] = None
         # Per-tenant admission price from the calibrated cost model: one
         # query = one dispatch floor + max_iters warm-EM iterations at
         # the tenant's padded class shape.  Deterministic given the
@@ -555,9 +559,28 @@ class DFMDaemon:
 
     def status(self) -> dict:
         from ..obs.live import plane
+        pl = plane()
         with self._lock:
             depth = len(self._queue)
             work = self._queued_work_s()
+        # Model-quality trail: the live plane's per-tenant drift score +
+        # hot-swap counters (fed by fleet.run_maintenance events), and
+        # the journal seq at which the latest swap became visible —
+        # answers were served from the OLD params up to that seq.
+        ds = pl.drift_status()
+        drift = {t: {"drift_score": round(float(v.get(
+                         "drift_score", 0.0)), 6),
+                     "breached": bool(v.get("breached")),
+                     "n_fired": int(v.get("n_fired", 0))}
+                 for t, v in ds.get("per_tenant", {}).items()
+                 if t in self._est_s}
+        counters = pl.registry.snapshot().get("counters", {})
+        swaps = {t: int(counters.get(f"swaps_total{{tenant={t}}}", 0))
+                 for t in self._est_s}
+        n_swaps = sum(swaps.values())
+        if n_swaps > self._seen_swaps:
+            self._seen_swaps = n_swaps
+            self._last_swap_seq = self._journal.last_seq
         return {
             "ok": True, "fleet": self._fleet.fleet_id,
             "tenants": sorted(self._est_s),
@@ -570,6 +593,10 @@ class DFMDaemon:
             "n_handoffs": self.n_handoffs,
             "journal_seq": self._journal.last_seq,
             "slo": plane().slo.status(),
+            "drift": {"armed": bool(ds.get("armed")),
+                      "per_tenant": drift,
+                      "swaps": {t: n for t, n in swaps.items() if n},
+                      "last_swap_seq": self._last_swap_seq},
         }
 
     # -- socket serving -------------------------------------------------

@@ -16,7 +16,10 @@ evicted to a lone guarded session without stalling its bucket-mates.
 from .admission import ClassAssignment, fleet_pad_waste, plan_admission
 from .buffers import FleetBucket, TenantSlot
 from .driver import SessionFleet, open_fleet, read_manifest, restore_fleet
+from .maintenance import (MaintenancePolicy, MaintenanceRecord,
+                          heldout_score, run_maintenance)
 
 __all__ = ["SessionFleet", "open_fleet", "restore_fleet", "read_manifest",
            "FleetBucket", "TenantSlot", "ClassAssignment",
-           "plan_admission", "fleet_pad_waste"]
+           "plan_admission", "fleet_pad_waste", "MaintenancePolicy",
+           "MaintenanceRecord", "heldout_score", "run_maintenance"]

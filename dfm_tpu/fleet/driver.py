@@ -50,7 +50,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..estim.batched import (CONVERGED, DIVERGED, slice_params_to_k,
+from ..estim.batched import (CONVERGED, DIVERGED, pad_params_to_k,
+                             pad_params_to_n, slice_params_to_k,
                              slice_params_to_n)
 from ..obs.trace import current_tracer
 from ..robust.dispatch import guarded_dispatch
@@ -490,6 +491,55 @@ class SessionFleet:
         self._page("admit", slot, bucket, time.perf_counter() - t0,
                    lane=lane)
 
+    def swap_params(self, tenant: str, params) -> None:
+        """Hot-swap one tenant's model params wherever it lives (the
+        maintenance seam, ``fleet.maintenance``).
+
+        ``params`` is a ``cpu_ref.SSMParams`` at the tenant's TRUE
+        (N, k), in its frozen standardized scale.  A hot tenant's lane is
+        rewritten through the exact demote/admit round-trip (refresh the
+        bucket-mates' f64 shadows from the device — an exact
+        representation — then redeploy), so bucket-mates are bit-
+        identical before and after; warm/cold tenants get their parked
+        shadows rewritten in place; a quarantined tenant delegates to its
+        lone session's ``swap_params``.  No executable changes, no
+        recompiles: the next tick is the same fused program.  Swapping
+        bit-equal params is a bit-identical no-op.
+        """
+        self._check_open()
+        if tenant not in self._slot_of:
+            raise KeyError(f"unknown tenant {tenant!r} (fleet has "
+                           f"{sorted(self._slot_of)})")
+        bucket, slot = self._slot_of[tenant]
+        Lam = np.asarray(params.Lam, np.float64)
+        if tuple(Lam.shape) != (slot.N, slot.k):
+            raise ValueError(
+                f"swap_params: Lam has shape {tuple(Lam.shape)}, tenant "
+                f"{tenant!r} serves (N, k)=({slot.N}, {slot.k})")
+        if slot.quarantined:
+            slot.evicted.swap_params(params)
+            return
+        _, N_b, k_b = bucket.dims
+        p_pad = pad_params_to_n(pad_params_to_k(params.copy(), k_b), N_b)
+        if slot.tier == "hot":
+            bucket.p_host = bucket.params_host()
+            bucket.p_host[slot.lane] = p_pad
+            bucket.redeploy()
+            # Materialize the rebuilt device buffers NOW: the swap runs
+            # on the maintenance pass, and the h2d re-upload must not
+            # land on the next serving query's wall.
+            jax.block_until_ready((bucket.Ybuf, bucket.Wbuf, bucket.p))
+        elif slot.tier == "warm":
+            slot.warm_p = p_pad
+        else:                           # cold: rewrite the npz in place
+            from ..utils.checkpoint import _FIELDS
+            with np.load(slot.cold_path) as z:
+                keep = {f: np.asarray(z[f]) for f in z.files
+                        if f not in _FIELDS}
+            np.savez(slot.cold_path, **keep,
+                     **{f: np.asarray(getattr(p_pad, f), np.float64)
+                        for f in _FIELDS})
+
     def _choose_victim(self, bucket):
         """Pick the hot lane to page out: among bucket-mates with no
         pending work (and not quarantined), the least-recently-used.
@@ -751,14 +801,19 @@ class SessionFleet:
             # PREVIOUS query's 90% band (original units, host-only —
             # the fleet twin of the lone session's tracking).
             cov = None
+            inz = None
             if q.n_new and slot.last_band is not None:
                 pf, ps = slot.last_band
                 n_cmp = min(q.n_new, pf.shape[0])
                 obs = q.W_rows[:n_cmp] > 0
                 if obs.any():
-                    hit = (np.abs(q.rows[:n_cmp] - pf[:n_cmp])
-                           <= _Z90 * ps[:n_cmp])
+                    err = np.abs(q.rows[:n_cmp] - pf[:n_cmp])
+                    hit = err <= _Z90 * ps[:n_cmp]
                     cov = float(np.mean(hit[obs]))
+                    # Standardized innovation magnitude — the fleet twin
+                    # of the lone session's drift signal (obs/drift.py).
+                    z = err / np.maximum(ps[:n_cmp], 1e-12)
+                    inz = float(np.mean(z[obs]))
             upd = self._lane_update(bucket, host, slot, t_new, wall)
             upd.coverage = cov
             slot.last_band = (upd.forecasts["y"], upd.forecast_sd)
@@ -790,6 +845,14 @@ class SessionFleet:
             # wall_share: this tenant's attributed slice of the tick's
             # wall (split equally over the tick's active lanes), so the
             # per-tenant ledger sums back to the tick walls.
+            # Loglik-per-row trend signal (values already in the tick's
+            # host read — zero extra dispatches).
+            n_ll = min(int(host["n_iters"][lane]), slot.max_iters)
+            llpr = None
+            if n_ll > 0 and t_new > 0:
+                ll_last = float(host["lls"][lane][n_ll - 1])
+                if np.isfinite(ll_last):
+                    llpr = ll_last / t_new
             qev = dict(session=self._fid, tenant=slot.name,
                        t_rows=int(t_new), n_new=int(q.n_new), wall=wall,
                        wall_share=wall / max(len(lane_q), 1),
@@ -801,6 +864,9 @@ class SessionFleet:
                                       == CONVERGED),
                        diverged=diverged,
                        **({"coverage": cov} if cov is not None else {}),
+                       **({"innov_z": inz} if inz is not None else {}),
+                       **({"ll_per_row": llpr} if llpr is not None
+                          else {}),
                        **({"n_evicted": int(e)} if e else {}),
                        **({"degraded": True} if degraded else {}))
             if tr is not None:
@@ -1000,6 +1066,13 @@ class SessionFleet:
                 "rank": int(bucket.cfg.rank),
                 "was_quarantined": bool(slot.quarantined),
             })
+            # PR 18: the tenant's drift-detector state (None when the
+            # plane is disarmed or nothing scored) rides the manifest so
+            # a restored fleet continues mid-baseline.
+            from ..obs.live import plane as _plane
+            dstate = _plane().drift_state(name)
+            if dstate is not None:
+                tenants[-1]["drift_state"] = dstate
         manifest = {
             "fleet_snapshot_format": FLEET_SNAPSHOT_FORMAT,
             "schema_version": SNAPSHOT_SCHEMA_VERSION,
@@ -1191,8 +1264,13 @@ def restore_fleet(dir_path: str, **kwargs) -> SessionFleet:
         filter=filts, rank=ranks,
         max_update_rows=int(manifest["max_update_rows"]), **kwargs)
     # Stream-position ledger (ring eviction counts) survives the restart.
+    from ..obs.live import plane as _plane
     for ten in manifest["tenants"]:
         _, slot = fleet._slot_of[ten["name"]]
         slot.t_total = int(ten["t_total"])
         slot.n_queries = int(ten["n_queries"])
+        # PR 18: drift-detector state continues mid-baseline (no-op when
+        # the plane is disarmed — the off path stays bit-identical).
+        if ten.get("drift_state"):
+            _plane().restore_drift(ten["name"], ten["drift_state"])
     return fleet

@@ -1,0 +1,227 @@
+"""Closed-loop model maintenance: drift -> background refit -> hot swap.
+
+The drift detector (``obs/drift.py``, fed by the live plane from the
+query signals every serving seam already emits) answers "which tenants'
+models have gone stale"; this module turns that into action without
+touching the serving path:
+
+1. **Trigger** — ``run_maintenance(fleet)`` collects the breached
+   tenants from the live plane (or takes an explicit list) and records
+   the signal values at the moment of the decision.
+2. **Background refit** — one ``sched.submit`` batch re-estimates the
+   drifted tenants' params, warm-started from each tenant's CURRENT
+   params (``Job(init=...)``).  The jobs carry the tenant's standardized
+   panel with ``standardize=False`` models, so the refit params come
+   back directly in the slot's frozen standardized scale — swappable
+   without any rescaling.  Missing entries are mean-imputed (exact zero
+   in the standardized scale) because the batched engine requires fully
+   observed panels; the held-out scores below are masked, so imputation
+   never contaminates the quality decision.
+3. **Quality gate** — before/after held-out one-step prediction error
+   (the arXiv 1910.08615 objective): the NumPy f64 oracle filters the
+   panel and scores ``y_t - Lam x_pred_t`` over the observed entries of
+   the trailing ``holdout_rows`` rows.  One-step predictions at t use
+   only data before t, so training through the window is legitimate
+   pseudo-out-of-sample scoring.  The swap happens only when the refit
+   improves the score by at least ``min_gain``.
+4. **Hot swap** — ``fleet.swap_params`` rewrites the tenant's params in
+   place through the exact demote/admit shadow round-trip: same
+   executable, zero recompiles, bucket-mates bit-identical.  The
+   tenant's drift detector is reset (a new regime needs a new healthy
+   baseline).
+5. **Decision trail** — every phase emits a structured ``maintenance``
+   trace event (trigger signals, advisor's engine pick, refit cost,
+   quality delta, swap timestamp) that ``record_event`` maps to the
+   live-plane counters/gauges (``refits_total``/``swaps_total``/
+   ``drift_score``) and ``obs.report`` renders as the per-tenant
+   maintenance table.
+
+The engine/rank advisor (``admission.choose_engine``, calibrated +
+evidence-gated) is consulted per tenant and its pick recorded; the
+in-place swap itself is params-only on the SAME engine — changing the
+serving engine would need a new executable (a recompile the serving
+budget forbids), so an engine disagreement is surfaced in the trail for
+the operator instead of applied silently.
+
+Everything here is host-side and jax-free except the refit dispatches
+themselves; nothing runs unless ``run_maintenance`` is called, so the
+serving path is bit-identical with maintenance never invoked.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["MaintenancePolicy", "MaintenanceRecord", "heldout_score",
+           "run_maintenance"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MaintenancePolicy:
+    """Knobs for one maintenance pass."""
+
+    holdout_rows: int = 8      # trailing rows scored held-out one-step
+    min_gain: float = 0.0      # required score improvement to swap
+    max_iters: int = 50        # background refit EM budget
+    tol: float = 1e-6          # background refit stop tolerance
+    max_buckets: int = 3       # sched.submit bucketing cap
+
+
+@dataclasses.dataclass
+class MaintenanceRecord:
+    """One tenant's decision-trail row (what the trace events carry)."""
+
+    tenant: str
+    trigger: dict              # signal values at the decision
+    advice: str                # advisor's engine pick (recorded, not applied)
+    engine: str                # the engine actually serving the tenant
+    refit_s: float
+    refit_iters: int
+    score_before: float        # held-out one-step MSE (standardized)
+    score_after: float
+    quality_delta: float       # score_before - score_after (> 0 == better)
+    action: str                # "swap" or "skip"
+    swap_t: Optional[float]    # perf_counter at swap (None when skipped)
+
+
+def heldout_score(Y_std: np.ndarray, W: Optional[np.ndarray], params,
+                  holdout_rows: int) -> float:
+    """Held-out one-step prediction error (standardized units).
+
+    Runs the NumPy f64 oracle filter over the panel and scores the
+    one-step predictions ``Lam x_pred_t`` against the realized rows over
+    the observed entries of the trailing ``holdout_rows`` rows — the
+    "fitting a Kalman smoother to data" quality objective.  Lower is
+    better; NaN when the window holds no observed entries.
+    """
+    from ..backends import cpu_ref
+    Y = np.asarray(Y_std, np.float64)
+    T = Y.shape[0]
+    h = max(1, min(int(holdout_rows), T - 1))
+    kf = cpu_ref.kalman_filter(Y, params, mask=W)
+    pred = kf.x_pred @ np.asarray(params.Lam, np.float64).T
+    lo = T - h
+    obs = (np.asarray(W, np.float64)[lo:] > 0 if W is not None
+           else np.isfinite(Y[lo:]))
+    err = np.where(obs, np.nan_to_num(Y[lo:]) - pred[lo:], 0.0)
+    n = float(obs.sum())
+    if n == 0:
+        return float("nan")
+    return float((err * err).sum() / n)
+
+
+def _emit(ev: dict) -> None:
+    """One maintenance trace event: to the active tracer (which forwards
+    to the live plane) or straight to the plane when untraced."""
+    from ..obs.trace import current_tracer
+    tr = current_tracer()
+    if tr is not None:
+        tr.emit("maintenance", **{k: v for k, v in ev.items()
+                                  if k not in ("t", "kind")})
+    else:
+        from ..obs.live import observe
+        observe(ev)
+
+
+def run_maintenance(fleet, tenants: Optional[Sequence[str]] = None, *,
+                    policy: Optional[MaintenancePolicy] = None,
+                    backend: str = "tpu",
+                    runs: Optional[str] = None) -> List[MaintenanceRecord]:
+    """One maintenance pass over ``fleet``: refit + conditionally swap.
+
+    ``tenants=None`` takes the live plane's currently-breached drift
+    detectors (restricted to this fleet's tenants); pass an explicit
+    list to force a pass.  Returns one :class:`MaintenanceRecord` per
+    tenant processed (empty when nothing drifted).  Serving ticks are
+    untouched: refits run as a separate background ``sched.submit``
+    batch and land through the in-place params swap seam.
+    """
+    from ..obs.live import plane as _plane
+    from ..sched import Job, submit
+    from .admission import choose_engine
+    policy = policy if policy is not None else MaintenancePolicy()
+    pl = _plane()
+    if tenants is None:
+        tenants = [t for t in pl.drift_status()["breached"]
+                   if t in fleet._slot_of]
+    tenants = list(tenants)
+    if not tenants:
+        return []
+
+    jobs, ctx = [], []
+    for name in tenants:
+        if name not in fleet._slot_of:
+            raise KeyError(f"unknown tenant {name!r} (fleet has "
+                           f"{sorted(fleet._slot_of)})")
+        bucket, slot = fleet._slot_of[name]
+        Y = np.asarray(slot.Y_orig, np.float64)
+        W = np.asarray(slot.W_orig, np.float64)
+        Yz = slot.std.transform(Y) if slot.std is not None else Y
+        # Mean imputation in the standardized scale (exact zeros) — the
+        # batched refit engine needs fully-observed panels; the held-out
+        # scores below stay masked to truly observed entries.
+        Yz = np.where(W > 0, np.nan_to_num(Yz), 0.0)
+        p_cur = fleet._slot_params_np(bucket, slot)
+        before = heldout_score(Yz, W, p_cur, policy.holdout_rows)
+        engine = bucket.cfg.filter if not slot.quarantined else \
+            slot.evicted._cfg.filter
+        advice = choose_engine(
+            (Y.shape[0], slot.N, slot.k), policy.max_iters,
+            rank=int(bucket.cfg.rank), runs=runs)
+        det = pl.drift_state(name)
+        trigger = dict((det or {}).get("last", {}))
+        trigger["drift_score"] = float((det or {}).get("drift_score", 0.0))
+        _emit({"t": time.perf_counter(), "kind": "maintenance",
+               "session": fleet.fleet_id, "tenant": name,
+               "action": "trigger", "engine": engine, "advice": advice,
+               **{k: round(float(v), 6) for k, v in trigger.items()}})
+        model = dataclasses.replace(slot.model, standardize=False)
+        jobs.append(Job(Y=Yz, model=model, tenant=name, init=p_cur,
+                        max_iters=policy.max_iters, tol=policy.tol))
+        ctx.append((name, bucket, slot, Yz, W, before, engine, advice,
+                    trigger))
+
+    stats: dict = {}
+    results = submit(jobs, backend=backend,
+                     max_buckets=policy.max_buckets, stats=stats)
+
+    records: List[MaintenanceRecord] = []
+    for res, (name, bucket, slot, Yz, W, before, engine, advice,
+              trigger) in zip(results, ctx):
+        p_new = res.fit.params
+        after = heldout_score(Yz, W, p_new, policy.holdout_rows)
+        delta = (before - after if np.isfinite(before)
+                 and np.isfinite(after) else float("nan"))
+        _emit({"t": time.perf_counter(), "kind": "maintenance",
+               "session": fleet.fleet_id, "tenant": name,
+               "action": "refit", "refit_s": float(res.compute_s),
+               "n_iters": int(res.fit.n_iters),
+               "converged": bool(res.fit.converged),
+               "engine": engine, "advice": advice})
+        do_swap = bool(np.isfinite(delta) and delta >= policy.min_gain)
+        swap_t = None
+        if do_swap:
+            fleet.swap_params(name, p_new)
+            pl.reset_drift(name)
+            swap_t = time.perf_counter()
+        _emit({"t": swap_t if swap_t is not None else time.perf_counter(),
+               "kind": "maintenance", "session": fleet.fleet_id,
+               "tenant": name, "action": "swap" if do_swap else "skip",
+               "quality_delta": (round(delta, 9) if np.isfinite(delta)
+                                 else None),
+               "score_before": (round(before, 9) if np.isfinite(before)
+                                else None),
+               "score_after": (round(after, 9) if np.isfinite(after)
+                               else None),
+               "engine": engine, "advice": advice})
+        records.append(MaintenanceRecord(
+            tenant=name, trigger=trigger, advice=advice, engine=engine,
+            refit_s=float(res.compute_s), refit_iters=int(res.fit.n_iters),
+            score_before=float(before), score_after=float(after),
+            quality_delta=float(delta), action="swap" if do_swap
+            else "skip", swap_t=swap_t))
+    return records

@@ -1,0 +1,257 @@
+"""Per-tenant model-quality drift detection (jax-free).
+
+Serving sessions already score every update against the PREVIOUS query's
+one-step forecast — standardized innovation magnitude (``innov_z``),
+realized 90% band coverage (``coverage``), and loglik-per-row
+(``ll_per_row``) all ride on the query trace events with zero extra
+dispatches (the numbers fall out of host arithmetic the seams already
+do).  ``DriftDetector`` folds that stream into a CUSUM-style change
+detector per tenant:
+
+- the first ``baseline_n`` scored updates freeze a rolling baseline
+  (mean/sd per signal) — "what does this tenant's model look like when
+  it is healthy";
+- ``ll_per_row`` enters as its FIRST DIFFERENCE: the level is the
+  whole panel's average loglik, which legitimately trends as the panel
+  grows or the ring retires history, so CUSUM-on-level would
+  accumulate false drift by construction.  The difference is the
+  marginal fit of the newest data (plus the warm-EM param step) —
+  stationary when the model is healthy, persistently negative when it
+  is stale;
+- afterwards each update contributes its worst baseline-relative
+  exceedance to a one-sided CUSUM ``g = max(0, g + dev - allowance)``
+  (Page 1954; undercoverage is measured against the nominal band level,
+  so the conservative rank-r bands of arXiv 2405.08971 never read as
+  drift when they over-cover);
+- the detector FIRES when ``g`` crosses ``threshold`` (with at least
+  ``min_updates`` post-baseline observations) and CLEARS below
+  ``clear_at * threshold`` — the same fire/clear hysteresis state
+  machine as ``obs.slo.SLOMonitor``.  ``drift_score = g / threshold``
+  is the live gauge (1.0 == at the firing boundary).
+
+Armed via ``DFM_DRIFT=1`` (library default OFF: ``drift_from_env``
+returns None when the variable is unset/0, exactly like
+``slo_from_env``).  Deterministic by construction: a pure function of
+the observation sequence — no internal clock reads, no randomness —
+and ``state_dict``/``from_state`` round-trip through session/fleet
+snapshots so a restored detector continues mid-baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Optional
+
+__all__ = ["DriftConfig", "DriftDetector", "drift_from_env"]
+
+_SIGNALS = ("innov_z", "ll_per_row")   # baseline-tracked signals
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftConfig:
+    """Model-quality drift objective for one serving tenant."""
+
+    baseline_n: int = 12          # updates that freeze the healthy baseline
+    min_updates: int = 3          # post-baseline updates before firing
+    # CUSUM slack per update, in baseline-sd units.  The statistic feeds
+    # on the WORST of up to three signals, and the expected max of ~3
+    # standardized healthy deviations is ~0.85 sd — the allowance must
+    # over-cover that bias or the healthy regime accumulates g by
+    # construction (false fires).  Genuine breaks move the signals by
+    # many sd, so detection lag is barely affected.
+    allowance: float = 1.0
+    # Cumulative exceedance that fires.  Small serving panels make the
+    # per-update signals heavy-tailed (tens of cross-sectionally
+    # CORRELATED cells per update — the effective sample is far smaller
+    # than the cell count), so a single unlucky update can contribute
+    # several sd; a genuine break contributes 5-20 sd per update
+    # SUSTAINED, so a threshold a few multiples of the one-update tail
+    # still detects within ~1-3 updates.
+    threshold: float = 6.0
+    clear_at: float = 0.25        # hysteresis: clears below clear_at*threshold
+    nominal_coverage: float = 0.90  # band level the coverage signal targets
+    coverage_scale: float = 0.10  # undercoverage per one "sd unit" of drift
+    sd_floor: float = 1e-3        # baseline sd floor (constant-signal guard)
+
+
+def drift_from_env() -> Optional[DriftConfig]:
+    """DriftConfig from ``DFM_DRIFT`` (+ optional ``DFM_DRIFT_*`` knobs),
+    or None when unset/"0"/"off"/"false" — the detector stays disarmed
+    and the serving path is bit-identical to a drift-free build."""
+    v = os.environ.get("DFM_DRIFT")
+    if v is None or v.strip().lower() in ("", "0", "off", "false"):
+        return None
+    env = os.environ.get
+    base = DriftConfig()
+    return DriftConfig(
+        baseline_n=int(env("DFM_DRIFT_BASELINE_N") or base.baseline_n),
+        min_updates=int(env("DFM_DRIFT_MIN_UPDATES") or base.min_updates),
+        allowance=float(env("DFM_DRIFT_ALLOWANCE") or base.allowance),
+        threshold=float(env("DFM_DRIFT_THRESHOLD") or base.threshold),
+        clear_at=float(env("DFM_DRIFT_CLEAR_AT") or base.clear_at))
+
+
+class DriftDetector:
+    """One tenant's CUSUM change detector with fire/clear hysteresis.
+
+    ``observe`` consumes one scored update and returns ``"fire"`` on the
+    drift transition, ``"clear"`` on recovery, else None.  Signals may
+    arrive partially (a query with no realized overlap carries no
+    coverage) — missing signals simply don't contribute that update.
+    """
+
+    def __init__(self, config: Optional[DriftConfig] = None):
+        self.config = config if config is not None else DriftConfig()
+        self.n = 0                    # scored updates seen
+        self.g = 0.0                  # CUSUM statistic
+        self.drift_score = 0.0        # g / threshold (the live gauge)
+        self.drift_score_max = 0.0
+        self.breached = False
+        self.n_fired = 0
+        self.last: dict = {}          # most recent signal values
+        # Baseline accumulators per signal: n / sum / sum of squares.
+        # The ll_per_row accumulator tracks FIRST DIFFERENCES (see
+        # module docstring) — the level is nonstationary by design.
+        self._bl = {s: [0, 0.0, 0.0] for s in _SIGNALS}
+        self._ll_prev: Optional[float] = None
+
+    # -- baseline ---------------------------------------------------------
+
+    def _baseline(self, sig: str):
+        n, s, ss = self._bl[sig]
+        if n == 0:
+            return None, None
+        mean = s / n
+        var = max(0.0, ss / n - mean * mean)
+        sd = max(self.config.sd_floor, math.sqrt(var))
+        return mean, sd
+
+    def _in_baseline(self) -> bool:
+        return self.n <= self.config.baseline_n
+
+    # -- the one entry point ----------------------------------------------
+
+    def observe(self, t: float, innov_z: Optional[float] = None,
+                coverage: Optional[float] = None,
+                ll_per_row: Optional[float] = None) -> Optional[str]:
+        del t   # timestamps ride on the emitted events, not the statistic
+        cfg = self.config
+        self.n += 1
+        if isinstance(innov_z, (int, float)) and math.isfinite(innov_z):
+            self.last["innov_z"] = float(innov_z)
+        if isinstance(coverage, (int, float)) and math.isfinite(coverage):
+            self.last["coverage"] = float(coverage)
+        # ll_per_row is differenced: the tracked statistic is the change
+        # since the previous scored update (None on the first one).
+        ll_diff = None
+        if isinstance(ll_per_row, (int, float)) and math.isfinite(ll_per_row):
+            self.last["ll_per_row"] = float(ll_per_row)
+            if self._ll_prev is not None:
+                ll_diff = float(ll_per_row) - self._ll_prev
+            self._ll_prev = float(ll_per_row)
+        if self._in_baseline():
+            vals = {"innov_z": innov_z if isinstance(innov_z, (int, float))
+                    and math.isfinite(innov_z) else None,
+                    "ll_per_row": ll_diff}
+            for k, v in vals.items():
+                if v is not None:
+                    b = self._bl[k]
+                    b[0] += 1
+                    b[1] += float(v)
+                    b[2] += float(v) * float(v)
+            return None
+        devs = []
+        if isinstance(innov_z, (int, float)) and math.isfinite(innov_z):
+            mean, sd = self._baseline("innov_z")
+            if mean is not None:
+                devs.append((float(innov_z) - mean) / sd)   # one-sided: up
+        if ll_diff is not None:
+            mean, sd = self._baseline("ll_per_row")
+            if mean is not None:
+                devs.append((mean - ll_diff) / sd)          # down = drift
+        if isinstance(coverage, (int, float)) and math.isfinite(coverage):
+            # Nominal-relative: over-coverage (conservative bands) is fine.
+            devs.append((cfg.nominal_coverage - float(coverage))
+                        / cfg.coverage_scale)
+        if not devs:
+            return None
+        self.g = max(0.0, self.g + max(devs) - cfg.allowance)
+        self.drift_score = self.g / cfg.threshold if cfg.threshold > 0 else 0.0
+        if self.drift_score > self.drift_score_max:
+            self.drift_score_max = self.drift_score
+        scored = self.n - cfg.baseline_n
+        if (not self.breached and self.g > cfg.threshold
+                and scored >= cfg.min_updates):
+            self.breached = True
+            self.n_fired += 1
+            return "fire"
+        if self.breached and self.g < cfg.clear_at * cfg.threshold:
+            self.breached = False
+            return "clear"
+        return None
+
+    def reset(self) -> None:
+        """Start a new regime (called after a hot swap): the refit model
+        needs a fresh healthy baseline before it can be accused of
+        drifting; fire counters survive for the ledger."""
+        self.n = 0
+        self.g = 0.0
+        self.drift_score = 0.0
+        self.breached = False
+        self.last = {}
+        self._bl = {s: [0, 0.0, 0.0] for s in _SIGNALS}
+        self._ll_prev = None
+
+    # -- introspection / persistence --------------------------------------
+
+    def status(self) -> dict:
+        bl = {}
+        for s in _SIGNALS:
+            mean, sd = self._baseline(s)
+            if mean is not None:
+                bl[s] = {"mean": round(mean, 6), "sd": round(sd, 6)}
+        return {
+            "breached": self.breached,
+            "drift_score": round(self.drift_score, 6),
+            "drift_score_max": round(self.drift_score_max, 6),
+            "n_fired": self.n_fired,
+            "n_observed": self.n,
+            "in_baseline": self._in_baseline(),
+            "baseline": bl,
+            "last": {k: round(v, 6) for k, v in self.last.items()},
+        }
+
+    def state_dict(self) -> dict:
+        return {
+            "v": 1,
+            "config": dataclasses.asdict(self.config),
+            "n": self.n, "g": self.g,
+            "drift_score": self.drift_score,
+            "drift_score_max": self.drift_score_max,
+            "breached": self.breached, "n_fired": self.n_fired,
+            "last": dict(self.last),
+            "baseline": {s: list(self._bl[s]) for s in _SIGNALS},
+            "ll_prev": self._ll_prev,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "DriftDetector":
+        cfg = DriftConfig(**state.get("config", {}))
+        det = cls(cfg)
+        det.n = int(state.get("n", 0))
+        det.g = float(state.get("g", 0.0))
+        det.drift_score = float(state.get("drift_score", 0.0))
+        det.drift_score_max = float(state.get("drift_score_max", 0.0))
+        det.breached = bool(state.get("breached", False))
+        det.n_fired = int(state.get("n_fired", 0))
+        det.last = {str(k): float(v)
+                    for k, v in state.get("last", {}).items()}
+        for s in _SIGNALS:
+            b = state.get("baseline", {}).get(s)
+            if b is not None:
+                det._bl[s] = [int(b[0]), float(b[1]), float(b[2])]
+        lp = state.get("ll_prev")
+        det._ll_prev = float(lp) if lp is not None else None
+        return det

@@ -39,11 +39,12 @@ import threading
 from collections import deque
 from typing import Optional
 
+from .drift import DriftConfig, DriftDetector, drift_from_env
 from .metrics import Ledger, MetricsRegistry, record_event
 from .slo import AnomalyDetector, SLOConfig, SLOMonitor, slo_from_env
 
 __all__ = ["LivePlane", "plane", "observe", "reset_plane", "set_slo",
-           "accounting", "status"]
+           "set_drift", "drift_status", "accounting", "status"]
 
 
 def _json_default(o):
@@ -66,12 +67,15 @@ class LivePlane:
                  flight_dir: Optional[str] = None,
                  flight_min_interval_s: float = 10.0,
                  snapshot_path: Optional[str] = None,
-                 snapshot_interval_s: float = 5.0):
+                 snapshot_interval_s: float = 5.0,
+                 drift: Optional[DriftConfig] = None):
         self.enabled = enabled
         self.registry = MetricsRegistry()
         self.ledger = Ledger()
         self.slo = SLOMonitor(slo)
         self.anomaly = AnomalyDetector()
+        self.drift_cfg = drift           # None == disarmed (the default)
+        self._drift: dict = {}           # (tenant-or-session) -> detector
         self.ring: deque = deque(maxlen=int(ring_events))
         self.health_events: list = []       # HealthEvent(kind="slo_burn"/..)
         self.flight_dir = flight_dir
@@ -100,7 +104,8 @@ class LivePlane:
             flight_min_interval_s=float(env("DFM_FLIGHT_MIN_INTERVAL_S",
                                             "10.0")),
             snapshot_path=env("DFM_METRICS_SNAPSHOT") or None,
-            snapshot_interval_s=float(env("DFM_METRICS_INTERVAL_S", "5.0")))
+            snapshot_interval_s=float(env("DFM_METRICS_INTERVAL_S", "5.0")),
+            drift=drift_from_env())
 
     # -- the single entry point ------------------------------------------
 
@@ -119,8 +124,8 @@ class LivePlane:
                 self.ring.append(ev)
                 record_event(self.registry, self.ledger, ev)
                 transitions = self._feed_guards(ev)
-            for name, action, detail in transitions:
-                self._emit_burn(ev, name, action, detail)
+            for name, action, detail, extra in transitions:
+                self._emit_burn(ev, name, action, detail, extra)
             self._maybe_snapshot(ev.get("t"))
         except Exception:
             self.errors += 1
@@ -142,20 +147,40 @@ class LivePlane:
             trans = self.slo.observe(t, wall_ms, error=bad)
             if trans == "fire":
                 out.append(("slo_burn", "fired",
-                            f"burn_rate={self.slo.burn_rate:.2f}"))
+                            f"burn_rate={self.slo.burn_rate:.2f}", None))
             elif trans == "clear":
                 out.append(("slo_burn", "cleared",
-                            f"burn_rate={self.slo.burn_rate:.2f}"))
+                            f"burn_rate={self.slo.burn_rate:.2f}", None))
             if self.anomaly.observe(wall_ms):
                 out.append(("latency_anomaly", "spike",
                             f"p99 vs baseline "
-                            f"{self.anomaly.baseline_ms:.3f}ms"))
+                            f"{self.anomaly.baseline_ms:.3f}ms", None))
+            if self.drift_cfg is not None:
+                key = str(ev.get("tenant") or ev.get("session") or "-")
+                det = self._drift.get(key)
+                if det is None:
+                    det = self._drift[key] = DriftDetector(self.drift_cfg)
+                dt = det.observe(t, innov_z=ev.get("innov_z"),
+                                 coverage=ev.get("coverage"),
+                                 ll_per_row=ev.get("ll_per_row"))
+                if dt is not None:
+                    # Carry the CUSUM score + trigger signals on the
+                    # health event so record_event can map them (live ==
+                    # replay) and the maintenance trail sees the values
+                    # at the moment of the decision.
+                    extra = {"drift_score": round(det.drift_score, 6),
+                             **{k: round(v, 6)
+                                for k, v in det.last.items()}}
+                    out.append(("drift",
+                                "fired" if dt == "fire" else "cleared",
+                                f"drift_score={det.drift_score:.2f}",
+                                extra))
         elif (kind == "health" and ev.get("event") == "dispatch_error"):
             self.slo.observe(t, 0.0, error=True)
         return out
 
     def _emit_burn(self, src: dict, name: str, action: str,
-                   detail: str) -> None:
+                   detail: str, extra: Optional[dict] = None) -> None:
         """Record an slo_burn / latency_anomaly health event: into the
         flight ring + registry directly (the reentrancy guard is up), as
         a ``HealthEvent``, mirrored to any active tracer, and — the whole
@@ -171,6 +196,8 @@ class LivePlane:
               "iteration": -1, "action": action, "detail": detail,
               "engine": "live",
               "burn_rate": round(self.slo.burn_rate, 6)}
+        if extra:
+            ev.update(extra)
         if he.tenant:
             ev["tenant"] = he.tenant
         if he.session:
@@ -247,6 +274,7 @@ class LivePlane:
             "ledger": self.ledger.snapshot(),
             "slo": self.slo.status(),
             "anomaly": self.anomaly.status(),
+            "drift": self.drift_status(),
             "flight": {"ring_events": len(self.ring),
                        "dumps": self.flight_dumps,
                        "dir": self.flight_dir},
@@ -273,6 +301,47 @@ class LivePlane:
         self._last_snap_t = t
         self.write_snapshot()
 
+    # -- drift ------------------------------------------------------------
+
+    def set_drift(self, config: Optional[DriftConfig]) -> None:
+        """Arm (or disarm, with None) per-tenant drift detection; existing
+        detector state is dropped (a new objective needs new baselines)."""
+        with self._lock:
+            self.drift_cfg = config
+            self._drift = {}
+
+    def drift_status(self) -> dict:
+        """Live per-tenant drift state (the daemon ``status`` surface)."""
+        with self._lock:
+            per = {k: d.status() for k, d in sorted(self._drift.items())}
+        return {"armed": self.drift_cfg is not None,
+                "n_tenants": len(per),
+                "breached": sorted(k for k, s in per.items()
+                                   if s["breached"]),
+                "per_tenant": per}
+
+    def drift_state(self, key: str) -> Optional[dict]:
+        """Snapshot one tenant's detector (session/fleet persistence)."""
+        with self._lock:
+            det = self._drift.get(str(key))
+        return det.state_dict() if det is not None else None
+
+    def restore_drift(self, key: str, state: Optional[dict]) -> None:
+        """Re-seed one tenant's detector from ``drift_state`` output (only
+        meaningful when the plane is armed — a disarmed plane stays
+        detector-free so the off path is bit-identical)."""
+        if state is None or self.drift_cfg is None:
+            return
+        with self._lock:
+            self._drift[str(key)] = DriftDetector.from_state(state)
+
+    def reset_drift(self, key: str) -> None:
+        """Start a fresh baseline for one tenant (post-swap regime)."""
+        with self._lock:
+            det = self._drift.get(str(key))
+            if det is not None:
+                det.reset()
+
     # -- queries ----------------------------------------------------------
 
     def accounting(self, session: Optional[str] = None) -> dict:
@@ -284,6 +353,7 @@ class LivePlane:
             "n_series": self.registry.n_series,
             "slo": self.slo.status(),
             "anomaly": self.anomaly.status(),
+            "drift": self.drift_status(),
             "flight_dumps": self.flight_dumps,
             "ring_events": len(self.ring),
             "errors": self.errors,
@@ -325,6 +395,16 @@ def reset_plane() -> None:
 def set_slo(config: Optional[SLOConfig]) -> None:
     """Arm (or disarm, with None) the live plane's SLO monitor."""
     plane().slo.set_config(config)
+
+
+def set_drift(config: Optional[DriftConfig]) -> None:
+    """Arm (or disarm, with None) per-tenant drift detection."""
+    plane().set_drift(config)
+
+
+def drift_status() -> dict:
+    """Live per-tenant drift state (armed flag + detector statuses)."""
+    return plane().drift_status()
 
 
 def accounting(session: Optional[str] = None) -> dict:

@@ -488,6 +488,38 @@ def record_event(registry: MetricsRegistry, ledger: Optional[Ledger],
         if event == "shed":
             registry.counter("sheds_total",
                              tenant=str(ten or "-")).inc()
+        if event == "drift":
+            # Model-quality drift transitions (obs/drift.py): the
+            # fired/cleared health event carries the CUSUM score, so the
+            # gauge is replayable from a trace — live plane and
+            # report.summarize see the same values by construction.
+            who = str(ten or sid or "-")
+            registry.counter("drift_events_total", tenant=who,
+                             action=str(ev.get("action", "?"))).inc()
+            ds = _num(ev.get("drift_score"))
+            if ds is not None:
+                registry.gauge("drift_score", tenant=who).set(ds)
+    elif kind == "maintenance":
+        # Closed-loop maintenance decision trail (fleet/maintenance.py):
+        # trigger / refit / swap / skip share one kind with an ``action``
+        # discriminator; the Prometheus export rides on these series.
+        ten = str(ev.get("tenant", "-"))
+        action = str(ev.get("action", "?"))
+        registry.counter("maintenance_events_total", tenant=ten,
+                         action=action).inc()
+        if action == "refit":
+            registry.counter("refits_total", tenant=ten).inc()
+            cs = _num(ev.get("refit_s"))
+            if cs is not None:
+                registry.histogram("refit_ms", tenant=ten).observe(cs * 1e3)
+        elif action == "swap":
+            registry.counter("swaps_total", tenant=ten).inc()
+            qd = _num(ev.get("quality_delta"))
+            if qd is not None:
+                registry.gauge("maintenance_quality_delta",
+                               tenant=ten).set(qd)
+        elif action == "skip":
+            registry.counter("maintenance_skips_total", tenant=ten).inc()
     elif kind == "daemon":
         # The serving daemon's front door (dfm_tpu/daemon/): admission,
         # durability and handoff events share one kind with an

@@ -184,6 +184,17 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
     dm_replayed = 0
     dm_tenant: dict = {}
     n_shed = 0
+    # model-quality maintenance (obs/drift + fleet/maintenance)
+    n_drift_fired = n_drift_cleared = 0
+    mt_counts: dict = {}
+    mt_tenant: dict = {}
+
+    def _mt_row(who: str) -> dict:
+        return mt_tenant.setdefault(who, {
+            "drift_fires": 0, "drift_clears": 0, "drift_score": None,
+            "trigger": {}, "refits": 0, "refit_s": 0.0, "swaps": 0,
+            "skips": 0, "quality_delta": None, "engine": None,
+            "advice": None, "action": None})
 
     for e in _event_stream(events_or_path):
         n_events += 1
@@ -332,6 +343,26 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
                     "requests": 0, "backpressure": 0, "shed": 0})
                 pt["requests" if act == "request"
                    else "backpressure"] += 1
+        elif kind == "maintenance":
+            act = str(e.get("action", "?"))
+            mt_counts[act] = mt_counts.get(act, 0) + 1
+            mt = _mt_row(str(e.get("tenant", "?")))
+            if act == "trigger":
+                mt["trigger"] = {
+                    k: float(e[k]) for k in
+                    ("drift_score", "innov_z", "coverage", "ll_per_row")
+                    if isinstance(e.get(k), (int, float))}
+                mt["engine"] = e.get("engine")
+                mt["advice"] = e.get("advice")
+            elif act == "refit":
+                mt["refits"] += 1
+                if isinstance(e.get("refit_s"), (int, float)):
+                    mt["refit_s"] += float(e["refit_s"])
+            elif act in ("swap", "skip"):
+                mt["swaps" if act == "swap" else "skips"] += 1
+                mt["action"] = act
+                if isinstance(e.get("quality_delta"), (int, float)):
+                    mt["quality_delta"] = float(e["quality_delta"])
         elif kind == "health":
             n_health += 1
             health_kinds.add(e.get("event", e.get("name", "?")))
@@ -347,6 +378,17 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
                 pt = dm_tenant.setdefault(str(e.get("tenant", "?")), {
                     "requests": 0, "backpressure": 0, "shed": 0})
                 pt["shed"] += 1
+            if e.get("event") == "drift":
+                who = str(e.get("tenant") or e.get("session") or "?")
+                mt = _mt_row(who)
+                if e.get("action") == "fired":
+                    n_drift_fired += 1
+                    mt["drift_fires"] += 1
+                else:
+                    n_drift_cleared += 1
+                    mt["drift_clears"] += 1
+                if isinstance(e.get("drift_score"), (int, float)):
+                    mt["drift_score"] = float(e["drift_score"])
             ten = e.get("tenant")
             if ten:
                 pt = rb_tenant.setdefault(str(ten), {
@@ -590,6 +632,19 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
         "degraded_queries": n_degraded,
         "per_tenant": rb_tenant,
         "per_session": rb_sess,
+    }
+    # Model-quality maintenance (obs/drift + fleet/maintenance): the
+    # closed loop's decision trail — drift detector transitions from the
+    # HealthEvents the live plane emits, plus per-tenant trigger/refit/
+    # swap rows from the maintenance trace events.
+    out["maintenance"] = {
+        "drift_fires": n_drift_fired,
+        "drift_clears": n_drift_cleared,
+        "triggers": mt_counts.get("trigger", 0),
+        "refits": mt_counts.get("refit", 0),
+        "swaps": mt_counts.get("swap", 0),
+        "skips": mt_counts.get("skip", 0),
+        "per_tenant": mt_tenant,
     }
     # The live-plane digest: the same record_event mapping obs.live runs
     # in-process, replayed over this trace.
@@ -855,6 +910,43 @@ def _print_text(s: dict) -> None:
                 bits.append(f"90% band coverage "
                             f"{100 * pt['forecast_coverage']:.0f}%")
             print(", ".join(bits))
+    mt = s.get("maintenance")
+    if mt and (mt["drift_fires"] or mt["drift_clears"] or mt["triggers"]
+               or mt["refits"] or mt["swaps"] or mt["skips"]):
+        print(f"maintenance: {mt['drift_fires']} drift fire"
+              f"{'' if mt['drift_fires'] == 1 else 's'} "
+              f"({mt['drift_clears']} cleared), {mt['triggers']} trigger"
+              f"{'' if mt['triggers'] == 1 else 's'}, {mt['refits']} "
+              f"refit{'' if mt['refits'] == 1 else 's'}, {mt['swaps']} "
+              f"swap{'' if mt['swaps'] == 1 else 's'}, {mt['skips']} "
+              f"skip{'' if mt['skips'] == 1 else 's'}")
+        for tid, pt in mt.get("per_tenant", {}).items():
+            bits = [f"  {tid:12s}"]
+            if pt.get("drift_fires") or pt.get("drift_clears"):
+                bits.append(f"drift fired x{pt['drift_fires']}"
+                            + (f" (score {pt['drift_score']:.2f})"
+                               if isinstance(pt.get("drift_score"),
+                                             (int, float)) else ""))
+            tr = pt.get("trigger") or {}
+            if tr:
+                bits.append("trigger " + " ".join(
+                    f"{k}={v:.3g}" for k, v in tr.items()))
+            if pt.get("refits"):
+                bits.append(f"{pt['refits']} refit"
+                            f"{'' if pt['refits'] == 1 else 's'} "
+                            f"({_fmt_s(pt['refit_s'])})")
+            if pt.get("action"):
+                act = ("SWAPPED" if pt["action"] == "swap"
+                       else "skipped (no gain)")
+                if isinstance(pt.get("quality_delta"), (int, float)):
+                    act += f", quality delta {pt['quality_delta']:+.3g}"
+                bits.append(act)
+            if pt.get("engine"):
+                eng = f"engine {pt['engine']}"
+                if pt.get("advice") and pt["advice"] != pt["engine"]:
+                    eng += f" (advisor: {pt['advice']})"
+                bits.append(eng)
+            print(", ".join(b for b in bits if b.strip()))
     a = s.get("advice")
     if a:
         pred, real = a.get("predicted_wall_s"), a.get("realized_wall_s")

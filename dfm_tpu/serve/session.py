@@ -484,13 +484,20 @@ class NowcastSession:
         # inside the PREVIOUS query's 90% band (original units; host-only
         # arithmetic on values already in hand — zero extra dispatches).
         coverage = None
+        innov_z = None
         if n_new and self._last_band is not None:
             pf, ps = self._last_band
             n_cmp = min(n_new, pf.shape[0])
             obs = W_rows[:n_cmp] > 0
             if obs.any():
-                hit = np.abs(rows[:n_cmp] - pf[:n_cmp]) <= _Z90 * ps[:n_cmp]
+                err = np.abs(rows[:n_cmp] - pf[:n_cmp])
+                hit = err <= _Z90 * ps[:n_cmp]
                 coverage = float(np.mean(hit[obs]))
+                # Standardized innovation magnitude: |realized - forecast|
+                # in units of the forecast sd — the drift detector's
+                # primary signal (obs/drift.py); ~sqrt(2/pi) when healthy.
+                z = err / np.maximum(ps[:n_cmp], 1e-12)
+                innov_z = float(np.mean(z[obs]))
         # Per-update absolute loglik noise floor at the LIVE panel size —
         # the same floor a cold fit of the extended panel would use.
         floor = noise_floor_for(self._dt, t_new * self._N,
@@ -590,6 +597,15 @@ class NowcastSession:
             self._p = out["p"]
             self._div_run = 0
         degraded = bool(diverged or repaired)
+        # Loglik-per-row trend signal for the drift detector: the final
+        # in-loop loglik normalized by the live panel length (host values
+        # already in hand — zero extra dispatches).
+        n_ll = min(int(host["n_iters"]), self._max_iters)
+        ll_per_row = None
+        if n_ll > 0 and t_new > 0:
+            ll_last = float(host["lls"][n_ll - 1])
+            if np.isfinite(ll_last):
+                ll_per_row = ll_last / t_new
         qev = dict(session=self._sid, t_rows=int(t_new),
                    n_new=int(n_new), wall=wall,
                    n_iters=int(host["n_iters"]),
@@ -598,6 +614,10 @@ class NowcastSession:
                    converged=bool(host["status"] == _CONVERGED),
                    diverged=bool(diverged),
                    **({"coverage": coverage} if coverage is not None
+                      else {}),
+                   **({"innov_z": innov_z} if innov_z is not None
+                      else {}),
+                   **({"ll_per_row": ll_per_row} if ll_per_row is not None
                       else {}),
                    **({"n_evicted": int(n_evict)} if n_evict else {}),
                    **({"degraded": True} if degraded else {}))
@@ -694,6 +714,32 @@ class NowcastSession:
                     "repaired resident params and re-uploaded")))
         self._div_run = 0
 
+    # -- maintenance ----------------------------------------------------
+    def swap_params(self, params) -> None:
+        """Hot-swap the resident model params (the maintenance seam).
+
+        ``params`` is a ``cpu_ref.SSMParams`` in THIS session's
+        standardized scale (e.g. a background refit warm-started from the
+        current params — ``fleet.maintenance``).  One h2d upload through
+        the same path ``_redeploy`` uses; the serving executable, panel,
+        ring ledger and engine are untouched, so the next update is the
+        same single dispatch with zero recompiles.  Swapping bit-equal
+        params is a bit-identical no-op: casting the same f64 values
+        reproduces the same device values.
+        """
+        self._check_open()
+        Lam = np.asarray(params.Lam, np.float64)
+        want = (self._N, self._model.n_factors)
+        if tuple(Lam.shape) != want:
+            raise ValueError(
+                f"swap_params: Lam has shape {tuple(Lam.shape)}, session "
+                f"serves (N, k)={want}")
+        p_np = params.copy()
+        with self._backend._precision_ctx():
+            self._p = JaxParams.from_numpy(p_np, dtype=self._dt)
+        self._p_host = p_np
+        self._div_run = 0
+
     # -- accounting ----------------------------------------------------
     def accounting(self) -> dict:
         """This session's live-plane resource ledger: queries answered,
@@ -744,6 +790,13 @@ class NowcastSession:
             "model_standardize": m.standardize,
             "model_estimate_init": m.estimate_init,
         }
+        # PR 18: the drift detector's state rides the snapshot (JSON
+        # string; empty when the plane is disarmed or nothing scored yet)
+        # so a restored session continues mid-baseline.
+        import json as _json
+        from ..obs.live import plane as _plane
+        dstate = _plane().drift_state(self._sid)
+        extra["drift_state"] = _json.dumps(dstate) if dstate else ""
         save_checkpoint(path, p_np, it=self._t, logliks=[],
                         fingerprint=panel_fingerprint(Y_live, W_live),
                         converged=False, extra=extra)
@@ -807,6 +860,10 @@ class NowcastSession:
             meta["filter"] = (str(z["filter"][()]) if "filter" in z.files
                               else "")
             meta["rank"] = (int(z["rank"][()]) if "rank" in z.files else 0)
+            # PR 18 field: drift-detector state (absent/empty on older
+            # snapshots — the restored session starts a fresh baseline).
+            meta["drift_state"] = (str(z["drift_state"][()])
+                                   if "drift_state" in z.files else "")
         if fp and panel_fingerprint(Y_live, W_live) != fp:
             raise ValueError(
                 f"session snapshot {path!r} is corrupt: the stored live "
@@ -903,6 +960,14 @@ class NowcastSession:
             getattr(b, "robust", True) if robust is None else robust)
         self.health = FitHealth(engine="serve")
         self._div_run = 0
+        if meta["drift_state"]:
+            # Re-seed the live plane's detector under the NEW session id
+            # (a no-op when the plane is disarmed — the off path stays
+            # bit-identical).
+            import json as _json
+            from ..obs.live import plane as _plane
+            _plane().restore_drift(self._sid, _json.loads(
+                meta["drift_state"]))
         return self
 
     def close(self):

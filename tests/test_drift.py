@@ -1,0 +1,472 @@
+"""Model-quality drift detection + closed-loop maintenance (ISSUE 18).
+
+The operative contracts, on the fake 8-device CPU mesh (conftest):
+
+- DETECTOR: the jax-free CUSUM detector (``obs/drift.py``) fires on a
+  sustained shift of any query signal, clears with hysteresis, treats
+  the ll-per-row LEVEL as nonstationary (only its first difference is
+  tracked — a trending panel loglik never reads as drift), never fires
+  on over-coverage, and its state round-trips exactly.
+- OFF-PATH INERTNESS: the SAME serving workload with drift detection
+  disarmed and armed produces bit-identical numbers and the same
+  dispatch count — the detector is host arithmetic on signals the
+  query path already emits.
+- HOT SWAP: ``fleet.swap_params`` serves exactly what a fleet opened
+  cold on the swapped params serves; swapping unchanged params is a
+  bit-identical no-op; a swap mid-ring-stream leaves the eviction
+  ledger intact.
+- MAINTENANCE: ``run_maintenance`` refits in the background (serving
+  executable untouched), gates the swap on held-out quality, resets the
+  swapped tenant's detector, and leaves params untouched on a skip.
+- PERSISTENCE: detector state rides session/fleet snapshots.
+- TRAIL: ``summarize`` always carries a stable-keyed ``maintenance``
+  section; trigger/refit/swap events land as per-tenant rows.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from dfm_tpu import DynamicFactorModel, fit, open_fleet, open_session
+from dfm_tpu.api import TPUBackend
+from dfm_tpu.fleet import MaintenancePolicy, heldout_score, run_maintenance
+from dfm_tpu.obs import live as live_mod
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.drift import DriftConfig, DriftDetector, drift_from_env
+from dfm_tpu.obs.report import summarize
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.utils import dgp
+
+BE = TPUBackend(filter="info")
+MODEL = DynamicFactorModel(n_factors=2)
+CFG = DriftConfig()
+
+
+@pytest.fixture
+def fresh_plane(monkeypatch):
+    """A clean enabled plane for this test; restore the lazy singleton."""
+    for var in ("DFM_METRICS", "DFM_DRIFT", "DFM_SLO_P99_MS",
+                "DFM_FLIGHT_DIR", "DFM_METRICS_SNAPSHOT"):
+        monkeypatch.delenv(var, raising=False)
+    live_mod.reset_plane()
+    yield live_mod.plane()
+    live_mod.reset_plane()
+
+
+def _panel(T, N, k, seed):
+    rng = np.random.default_rng(seed)
+    Y, _ = dgp.simulate(dgp.dfm_params(N, k, rng), T, rng)
+    return Y
+
+
+def _feed_healthy(det, n, z=0.8, cov=0.92, ll=-1.3):
+    """Healthy stream with small deterministic jitter so the baseline
+    sds are honest (a constant signal would pin them to the floor and
+    turn the first real deviation into a ~1000-sd event)."""
+    out = []
+    for i in range(n):
+        j = 0.1 * (-1.0) ** i
+        out.append(det.observe(float(i), innov_z=z + 0.5 * j,
+                               coverage=cov, ll_per_row=ll + j))
+    return out
+
+
+# ------------------------------------------------------- detector ------
+
+def test_fire_then_clear_hysteresis():
+    det = DriftDetector(CFG)
+    assert all(r is None for r in _feed_healthy(det, CFG.baseline_n + 2))
+    assert not det.breached and det.drift_score == 0.0
+    # A sustained break: hot innovations + undercoverage + loglik drop.
+    fired_at = None
+    for j in range(20):
+        r = det.observe(100.0 + j, innov_z=3.0, coverage=0.4,
+                        ll_per_row=-8.0 - j)
+        if r == "fire":
+            fired_at = j
+            break
+    assert fired_at is not None and det.breached and det.n_fired == 1
+    assert det.drift_score > 1.0
+    assert det.drift_score_max >= det.drift_score
+    # Recovery: healthy signals decay g below clear_at * threshold (the
+    # loop bound scales with g at the fire — g shrinks by at most the
+    # allowance per healthy update).
+    seen = set()
+    det2_ll = det.last["ll_per_row"]
+    for j in range(int(det.g / CFG.allowance) + 20):
+        seen.add(det.observe(200.0 + j, innov_z=0.8, coverage=0.92,
+                             ll_per_row=det2_ll))
+        if "clear" in seen:
+            break
+    assert "clear" in seen and not det.breached
+    assert det.n_fired == 1          # clear does not double-count
+
+
+def test_ll_level_trend_is_not_drift():
+    """A steadily trending loglik LEVEL (constant first difference, as a
+    growing or ring-evicting panel produces) must never fire; a sudden
+    drop in the difference must."""
+    det = DriftDetector(CFG)
+    for i in range(40):
+        r = det.observe(float(i), innov_z=0.8, coverage=0.92,
+                        ll_per_row=-1.0 - 0.05 * i)   # trending level
+        assert r is None, f"trending ll level fired at {i}"
+    assert det.g == 0.0
+    for j in range(10):
+        r = det.observe(100.0 + j, innov_z=0.8, coverage=0.92,
+                        ll_per_row=-3.0 - 4.0 * j)    # diff jumps to -4
+        if r == "fire":
+            break
+    assert det.breached
+
+
+def test_partial_and_missing_signals():
+    det = DriftDetector(CFG)
+    _feed_healthy(det, CFG.baseline_n)
+    g = det.g
+    assert det.observe(50.0) is None                  # no signals at all
+    assert det.g == g
+    det.observe(51.0, innov_z=float("nan"), coverage=None)
+    assert det.g == g                                 # non-finite ignored
+    det.observe(52.0, coverage=0.9)                   # coverage-only is fine
+    assert np.isfinite(det.g)
+
+
+def test_overcoverage_never_fires():
+    """Conservative rank-r bands OVER-cover — that must read as healthy
+    (the coverage deviation is one-sided against the nominal level)."""
+    det = DriftDetector(CFG)
+    _feed_healthy(det, CFG.baseline_n)
+    for j in range(40):
+        r = det.observe(100.0 + j, innov_z=0.8, coverage=1.0,
+                        ll_per_row=-1.3)
+        assert r is None
+    assert det.g == 0.0
+
+
+def test_state_roundtrip_continues_identically():
+    """snapshot/restore mid-stream == uninterrupted, including the
+    ll first-difference accumulator."""
+    for cut in (CFG.baseline_n // 2, CFG.baseline_n + 4):
+        a = DriftDetector(CFG)
+        _feed_healthy(a, cut, ll=-2.0)
+        b = DriftDetector.from_state(
+            json.loads(json.dumps(a.state_dict())))
+        assert b._ll_prev == a._ll_prev
+        for j in range(25):
+            ra = a.observe(100.0 + j, innov_z=2.5, coverage=0.5,
+                           ll_per_row=-6.0 - j)
+            rb = b.observe(100.0 + j, innov_z=2.5, coverage=0.5,
+                           ll_per_row=-6.0 - j)
+            assert ra == rb
+            assert a.g == b.g and a.drift_score == b.drift_score
+        assert a.status() == b.status()
+
+
+def test_reset_keeps_fire_counter():
+    det = DriftDetector(CFG)
+    _feed_healthy(det, CFG.baseline_n)
+    for j in range(30):
+        if det.observe(100.0 + j, innov_z=4.0, coverage=0.3):
+            break
+    assert det.n_fired == 1 and det.breached
+    det.reset()
+    assert det.n_fired == 1          # ledger survives
+    assert det.n == 0 and det.g == 0.0 and not det.breached
+    assert det._ll_prev is None and det.last == {}
+    assert det._in_baseline()        # fresh regime, fresh baseline
+
+
+def test_drift_from_env(monkeypatch):
+    for off in (None, "", "0", "off", "false", "OFF"):
+        if off is None:
+            monkeypatch.delenv("DFM_DRIFT", raising=False)
+        else:
+            monkeypatch.setenv("DFM_DRIFT", off)
+        assert drift_from_env() is None
+    monkeypatch.setenv("DFM_DRIFT", "1")
+    assert drift_from_env() == DriftConfig()
+    monkeypatch.setenv("DFM_DRIFT_THRESHOLD", "9.5")
+    monkeypatch.setenv("DFM_DRIFT_BASELINE_N", "7")
+    cfg = drift_from_env()
+    assert cfg.threshold == 9.5 and cfg.baseline_n == 7
+
+
+# ------------------------------------------------------ live plane -----
+
+def test_plane_fire_emits_health_event_and_metrics(fresh_plane):
+    pl = fresh_plane
+    pl.set_drift(DriftConfig())
+    for i in range(CFG.baseline_n + 10):
+        drifted = i >= CFG.baseline_n + 2
+        pl.observe({"t": float(i), "kind": "query", "session": "s9",
+                    "tenant": "acme", "wall": 0.002,
+                    "innov_z": 3.5 if drifted else 0.8,
+                    "coverage": 0.3 if drifted else 0.92,
+                    "ll_per_row": -9.0 - i if drifted else -1.3})
+    st = pl.drift_status()
+    assert st["armed"] and "acme" in st["breached"]
+    assert st["per_tenant"]["acme"]["n_fired"] == 1
+    snap = pl.registry.snapshot()
+    assert any(k.startswith("drift_events_total")
+               for k in snap["counters"])
+    assert any(k.startswith("drift_score") for k in snap["gauges"])
+    # state snapshot surfaces per tenant + restore continues
+    state = pl.drift_state("acme")
+    pl.set_drift(DriftConfig())       # drops detectors
+    assert pl.drift_status()["per_tenant"] == {}
+    pl.restore_drift("acme", state)
+    assert pl.drift_status()["per_tenant"]["acme"]["n_fired"] == 1
+
+
+def test_disarmed_plane_tracks_nothing(fresh_plane):
+    pl = fresh_plane
+    assert pl.drift_cfg is None       # library default: off
+    pl.observe({"t": 0.0, "kind": "query", "session": "s1",
+                "wall": 0.001, "innov_z": 99.0, "coverage": 0.0})
+    assert pl.drift_status() == {"armed": False, "n_tenants": 0,
+                                 "breached": [], "per_tenant": {}}
+    pl.restore_drift("x", {"v": 1})   # no-op while disarmed
+    assert pl.drift_status()["per_tenant"] == {}
+
+
+# ------------------------------------------------------ report ---------
+
+def test_summarize_maintenance_section_always_present_empty_shape():
+    s = summarize([{"kind": "dispatch", "program": "x", "key": "k",
+                    "t": 0.0, "dur": 0.01, "barrier": True,
+                    "first_call": True}])
+    assert s["maintenance"] == {"drift_fires": 0, "drift_clears": 0,
+                                "triggers": 0, "refits": 0, "swaps": 0,
+                                "skips": 0, "per_tenant": {}}
+    assert json.loads(json.dumps(s)) == s
+
+
+def test_summarize_maintenance_rows_from_trace_events():
+    evs = [
+        {"kind": "maintenance", "t": 1.0, "tenant": "acme",
+         "action": "trigger", "engine": "info", "advice": "info",
+         "drift_score": 1.4, "innov_z": 2.1, "coverage": 0.5},
+        {"kind": "maintenance", "t": 2.0, "tenant": "acme",
+         "action": "refit", "refit_s": 0.8, "n_iters": 12,
+         "converged": True, "engine": "info", "advice": "info"},
+        {"kind": "maintenance", "t": 3.0, "tenant": "acme",
+         "action": "swap", "quality_delta": 0.25, "score_before": 1.0,
+         "score_after": 0.75, "engine": "info", "advice": "info"},
+    ]
+    mt = summarize(evs)["maintenance"]
+    assert (mt["triggers"], mt["refits"], mt["swaps"], mt["skips"]) \
+        == (1, 1, 1, 0)
+    row = mt["per_tenant"]["acme"]
+    assert row["action"] == "swap"
+    assert row["quality_delta"] == 0.25
+    assert row["trigger"]["drift_score"] == 1.4
+    assert row["engine"] == "info" and row["advice"] == "info"
+
+
+# --------------------------------------------- serving integration -----
+
+Y_ALL = None
+
+
+def _data():
+    global Y_ALL
+    if Y_ALL is None:
+        Y_ALL = _panel(48, 8, 2, 77)
+    return Y_ALL[:40], Y_ALL[40:]
+
+
+def _session_workload():
+    """Tiny traced session run: (sha over answers, dispatch count)."""
+    import hashlib
+    Y0, stream = _data()
+    h = hashlib.sha256()
+    tr = Tracer(detector=RecompileDetector())
+    with activate(tr):
+        res = fit(MODEL, Y0, max_iters=4, tol=1e-6, fused=True)
+        sess = open_session(res, Y0, capacity=48, max_update_rows=2,
+                            max_iters=2, tol=0.0)
+        for i in range(3):
+            u = sess.update(stream[2 * i:2 * i + 2])
+            h.update(np.asarray(u.nowcast, np.float64).tobytes())
+            h.update(np.asarray(u.forecasts["y"], np.float64).tobytes())
+        sess.close()
+    return h.hexdigest(), tr.summary()["dispatches"]
+
+
+def test_drift_armed_is_bit_identical_at_equal_dispatches(fresh_plane):
+    live_mod.set_drift(None)
+    off = _session_workload()
+    live_mod.set_drift(DriftConfig())
+    on = _session_workload()
+    assert off == on
+    # ... and the armed run actually scored the queries.
+    assert live_mod.drift_status()["n_tenants"] == 1
+
+
+def _fleet_answer(res, Y0, rows, swap=None, ring=False, n_updates=1):
+    fl = open_fleet([res], [Y0], tenants=["t0"], capacity=48,
+                    max_update_rows=2, max_iters=2, tol=0.0, ring=ring)
+    if swap is not None:
+        fl.swap_params("t0", swap)
+    for i in range(n_updates):
+        fl.submit("t0", rows[2 * i:2 * i + 2])
+        u = fl.drain()["t0"][-1]
+    fl.close()
+    return u
+
+
+def test_hot_swap_bit_exact_vs_cold_open_and_noop():
+    Y0, stream = _data()
+    res = fit(MODEL, Y0, max_iters=3, tol=0.0, fused=True)
+    res2 = fit(MODEL, Y0, max_iters=10, tol=0.0, fused=True)
+    assert not np.allclose(res.params.Lam, res2.params.Lam)
+    a = _fleet_answer(res, Y0, stream, swap=res2.params)
+    b = _fleet_answer(dataclasses.replace(res, params=res2.params), Y0,
+                      stream)
+    assert np.array_equal(np.asarray(a.nowcast), np.asarray(b.nowcast))
+    for key in a.forecasts:
+        assert np.array_equal(np.asarray(a.forecasts[key]),
+                              np.asarray(b.forecasts[key])), key
+    # No-op swap: unchanged params are bit-identical.
+    c = _fleet_answer(res, Y0, stream)
+    d = _fleet_answer(res, Y0, stream, swap=res.params.copy())
+    assert np.array_equal(np.asarray(c.nowcast), np.asarray(d.nowcast))
+
+
+def test_swap_mid_ring_stream_keeps_eviction_ledger():
+    Y0, stream = _data()
+    res = fit(MODEL, Y0, max_iters=3, tol=0.0, fused=True)
+    ledgers = {}
+    # The per-query warm EM evolves the resident params, so the true
+    # no-op is re-installing the CURRENT resident params (an f64 read
+    # is an exact representation of the device values).
+    for do_swap in (False, True):
+        sess = open_session(res, Y0, capacity=42, max_update_rows=2,
+                            max_iters=2, tol=0.0, ring=True)
+        led = []
+        for i in range(4):
+            if do_swap and i == 2:
+                sess.swap_params(sess._p.to_numpy())   # no-op swap
+            u = sess.update(stream[2 * i:2 * i + 2])
+            led.append((sess.n_evicted, sess.total_rows,
+                        np.asarray(u.nowcast).tobytes()))
+        sess.close()
+        ledgers[do_swap] = led
+    assert ledgers[False] == ledgers[True]
+    # the ring actually evicted during the run
+    assert ledgers[False][-1][0] > 0
+
+
+def test_maintenance_skip_leaves_params_untouched(fresh_plane):
+    live_mod.set_drift(DriftConfig())
+    Y0, stream = _data()
+    res = fit(MODEL, Y0, max_iters=3, tol=0.0, fused=True)
+    answers = {}
+    for gate in ("none", "inf"):
+        fl = open_fleet([res], [Y0], tenants=["t0"], capacity=48,
+                        max_update_rows=2, max_iters=2, tol=0.0)
+        fl.submit("t0", stream[:2])
+        fl.drain()
+        if gate == "inf":
+            recs = run_maintenance(
+                fl, ["t0"], policy=MaintenancePolicy(
+                    min_gain=float("inf"), max_iters=6))
+            assert len(recs) == 1 and recs[0].action == "skip"
+            assert recs[0].swap_t is None
+            assert np.isfinite(recs[0].quality_delta)
+        fl.submit("t0", stream[2:4])
+        answers[gate] = np.asarray(fl.drain()["t0"][-1].nowcast)
+        fl.close()
+    assert np.array_equal(answers["none"], answers["inf"])
+
+
+def test_maintenance_swap_installs_refit_and_resets_detector(fresh_plane):
+    pl = fresh_plane
+    live_mod.set_drift(DriftConfig())
+    Y0, stream = _data()
+    res = fit(MODEL, Y0, max_iters=3, tol=0.0, fused=True)
+    fl = open_fleet([res], [Y0], tenants=["t0"], capacity=48,
+                    max_update_rows=2, max_iters=2, tol=0.0)
+    fl.submit("t0", stream[:2])
+    fl.drain()
+    n_before = pl.drift_status()["per_tenant"]["t0"]["n_observed"]
+    assert n_before >= 1
+    recs = run_maintenance(fl, ["t0"],
+                           policy=MaintenancePolicy(
+                               min_gain=float("-inf"), max_iters=8))
+    assert len(recs) == 1
+    r = recs[0]
+    assert r.action == "swap" and r.swap_t is not None
+    assert r.engine == "info" and r.advice
+    assert r.refit_iters >= 1 and r.refit_s >= 0.0
+    assert np.isfinite(r.score_before) and np.isfinite(r.score_after)
+    assert r.quality_delta == pytest.approx(
+        r.score_before - r.score_after)
+    # swap reset the tenant's detector: a fresh baseline follows.
+    assert pl.drift_status()["per_tenant"]["t0"]["n_observed"] == 0
+    # ... and the refit params are what the fleet now serves.
+    _, slot = fl._slot_of["t0"]
+    p_now = fl._slot_params_np(*fl._slot_of["t0"])
+    Yz = slot.std.transform(np.asarray(slot.Y_orig, np.float64))
+    W = np.asarray(slot.W_orig, np.float64)
+    Yz = np.where(W > 0, np.nan_to_num(Yz), 0.0)
+    assert heldout_score(Yz, W, p_now, 8) == pytest.approx(r.score_after)
+    fl.close()
+
+
+def test_unknown_tenant_raises(fresh_plane):
+    Y0, _ = _data()
+    res = fit(MODEL, Y0, max_iters=2, tol=0.0, fused=True)
+    fl = open_fleet([res], [Y0], tenants=["t0"], capacity=44,
+                    max_update_rows=2, max_iters=2, tol=0.0)
+    with pytest.raises(KeyError):
+        fl.swap_params("ghost", res.params)
+    with pytest.raises(KeyError):
+        run_maintenance(fl, ["ghost"])
+    fl.close()
+
+
+def test_session_snapshot_roundtrips_drift_state(tmp_path, fresh_plane):
+    pl = fresh_plane
+    live_mod.set_drift(DriftConfig())
+    Y0, stream = _data()
+    res = fit(MODEL, Y0, max_iters=3, tol=0.0, fused=True)
+    sess = open_session(res, Y0, capacity=48, max_update_rows=2,
+                        max_iters=2, tol=0.0)
+    for i in range(3):
+        sess.update(stream[2 * i:2 * i + 2])
+    state = pl.drift_state(sess.session_id)
+    assert state is not None and state["n"] == 3
+    path = sess.snapshot(str(tmp_path / "sess.npz"))
+    sess.close()
+    pl.set_drift(DriftConfig())       # wipe in-process detectors
+    sess2 = open_session(snapshot=path)
+    st2 = pl.drift_state(sess2.session_id)
+    assert st2 is not None
+    assert {k: v for k, v in st2.items()} == \
+        {k: v for k, v in state.items()}
+    sess2.close()
+
+
+def test_fleet_snapshot_roundtrips_drift_state(tmp_path, fresh_plane):
+    from dfm_tpu.fleet import restore_fleet
+    pl = fresh_plane
+    live_mod.set_drift(DriftConfig())
+    Y0, stream = _data()
+    res = fit(MODEL, Y0, max_iters=3, tol=0.0, fused=True)
+    fl = open_fleet([res], [Y0], tenants=["t0"], capacity=48,
+                    max_update_rows=2, max_iters=2, tol=0.0)
+    for i in range(2):
+        fl.submit("t0", stream[2 * i:2 * i + 2])
+        fl.drain()
+    state = pl.drift_state("t0")
+    assert state is not None and state["n"] == 2
+    fl.snapshot_all(str(tmp_path / "snap"))
+    fl.close()
+    pl.set_drift(DriftConfig())
+    fl2 = restore_fleet(str(tmp_path / "snap"))
+    assert pl.drift_state("t0") == state
+    fl2.close()

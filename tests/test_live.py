@@ -353,7 +353,7 @@ def test_summary_schema_v1_stable_and_json_roundtrip():
                     "first_call": True}])
     assert s["schema_version"] == 1
     for section in ("tenants", "tenant_fairness", "queries", "fleet",
-                    "robustness", "metrics"):
+                    "robustness", "maintenance", "metrics"):
         assert section in s, section
     assert s["robustness"]["per_tenant"] == {}
     assert s["robustness"]["per_session"] == {}

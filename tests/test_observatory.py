@@ -142,7 +142,7 @@ def test_backfill_seeds_complete_serving_baseline(tmp_path):
         by_kind.setdefault(r["kind"], []).append(r)
     for kind in ("bench", "bench_all", "bench_longt", "bench_kscale",
                  "bench_stream", "bench_serve", "bench_mixed",
-                 "bench_fleet", "bench_daemon"):
+                 "bench_fleet", "bench_daemon", "bench_drift"):
         assert kind in by_kind, f"no checked-in artifact seeds {kind}"
     # The engine-leg speedups ride the fleet/stream artifacts so
     # obs.regress gates them from the first live run.

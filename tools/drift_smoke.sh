@@ -1,0 +1,237 @@
+#!/usr/bin/env bash
+# One-command closed-loop-maintenance check (ISSUE 18), no real chip:
+#
+#   leg 1  off-path bit-identity: the SAME session workload run with
+#          DFM_DRIFT=0 and =1 must produce byte-identical nowcasts AND
+#          the same dispatch count — the detector is host arithmetic on
+#          signals the query path already computes;
+#   leg 2  detection + budgets: the bench.drift soak on a simulated
+#          regime break must fire within the lag budget, swap through
+#          the background refit with ZERO serve_update recompiles,
+#          keep the managed/frozen serving-p99 ratio <= 1.05, buy a
+#          positive held-out quality gain, and stay false-positive-free
+#          on the healthy pre-break regime;
+#   leg 3  hot-swap exactness: after fleet.swap_params the tenant's next
+#          answers must be bit-equal to a lone session opened cold on
+#          the swapped params (info engine — the swap installs EXACTLY
+#          the refit params, nothing else moves);
+#   leg 4  decision trail: a traced maintenance pass must round-trip
+#          through `python -m dfm_tpu.obs.report` — the always-present
+#          maintenance section carries the per-tenant trigger/refit/
+#          swap rows and the text renderer prints them.
+#
+# Usage (from the repo root): tools/drift_smoke.sh
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP=$(mktemp -d /tmp/dfm_drift.XXXXXX)
+trap 'rm -rf "$TMP"' EXIT
+export JAX_PLATFORMS="${JAX_PLATFORMS-cpu}"
+export DFM_RUNS=    # never append smoke runs to the observatory
+
+LAG_BUDGET="${DFM_DRIFT_LAG_BUDGET:-8}"
+
+# --- leg 1: bit-identity + equal dispatch count, detector off vs on -----
+run_workload() {
+  DFM_DRIFT="$1" python - <<'PY'
+import hashlib
+import json
+
+import numpy as np
+
+from dfm_tpu import DynamicFactorModel, fit, open_session
+from dfm_tpu.obs.cost import RecompileDetector
+from dfm_tpu.obs.trace import Tracer, activate
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(7)
+p_true = dgp.dfm_params(24, 2, rng)
+Y, _ = dgp.simulate(p_true, 66, rng)
+Y0, stream = Y[:60], Y[60:]
+
+res = fit(DynamicFactorModel(n_factors=2), Y0, max_iters=16, tol=1e-6,
+          fused=True)
+h = hashlib.sha256()
+tr = Tracer(detector=RecompileDetector())
+with activate(tr):
+    sess = open_session(res, Y0, capacity=90, max_update_rows=2,
+                        max_iters=4, tol=0.0)
+    for rows in (stream[:2], stream[2:4], stream[4:6]):
+        u = sess.update(rows)
+        h.update(np.asarray(u.nowcast, np.float64).tobytes())
+        h.update(np.asarray(u.forecasts["y"], np.float64).tobytes())
+print(json.dumps({"sha": h.hexdigest(),
+                  "dispatches": tr.summary()["dispatches"]}))
+PY
+}
+OFF=$(run_workload 0 | tail -n 1)
+ON=$(run_workload 1 | tail -n 1)
+echo "drift off: $OFF"
+echo "drift on:  $ON"
+[ "$OFF" = "$ON" ] || {
+  echo "drift smoke FAILED: detector changed results or dispatches" >&2
+  exit 1
+}
+echo "leg 1 OK: DFM_DRIFT=0/1 bit-identical, equal dispatch count"
+
+# --- leg 2: break -> fire within budget -> refit+swap, budgets hold -----
+BENCH=$(DFM_BENCH_N="${DFM_BENCH_N:-8}" \
+        DFM_BENCH_DRIFT_T0="${DFM_BENCH_DRIFT_T0:-60}" \
+        DFM_BENCH_DRIFT_PRE="${DFM_BENCH_DRIFT_PRE:-18}" \
+        DFM_BENCH_DRIFT_POST="${DFM_BENCH_DRIFT_POST:-24}" \
+        DFM_BENCH_ITERS="${DFM_BENCH_ITERS:-15}" \
+        DFM_BENCH_DRIFT_REFIT_ITERS="${DFM_BENCH_DRIFT_REFIT_ITERS:-25}" \
+        DFM_BENCH_SERVE_ITERS="${DFM_BENCH_SERVE_ITERS:-1}" \
+        python -m bench.drift)
+echo "$BENCH"
+BENCH_JSON="$BENCH" python - "$LAG_BUDGET" <<'PY'
+import json
+import os
+import sys
+
+d = json.loads(os.environ["BENCH_JSON"].strip().splitlines()[-1])
+budget = int(sys.argv[1])
+lag = d["drift_detection_lag_updates"]
+assert lag <= budget, f"detection lag {lag} > budget {budget}"
+assert d["drift_swaps_total"] >= 1, "maintenance never swapped"
+assert d["recompiles_after_warmup"] == 0, \
+    f"refit+swap recompiled the serving tick: {d}"
+assert d["managed_vs_frozen_heldout_gain"] > 0, \
+    f"maintenance bought no quality: {d['managed_vs_frozen_heldout_gain']}"
+# p99 at smoke sizes is the max of ~40 few-ms CPU-fallback walls and
+# host scheduler jitter alone moves it several ms — apply a 5 ms
+# absolute floor before failing the 1.05 ratio bound.  On the real
+# chip (60-100 ms dispatch walls) that floor is <10% and the recorded
+# run is gated via obs.regress (0.10 p99_ratio noise floor).
+ratio_ok = (d["drift_p99_ratio"] <= 1.05
+            or d["managed_p99_ms"] - d["frozen_p99_ms"] <= 5.0)
+assert ratio_ok, \
+    f"maintenance taxed the serving path: p99 ratio {d['drift_p99_ratio']}" \
+    f" ({d['frozen_p99_ms']} -> {d['managed_p99_ms']} ms)"
+assert d["drift_false_positive_rate"] <= 0.2, \
+    f"detector fired on the healthy regime: {d['drift_false_positive_rate']}"
+print(f"soak: fired {lag} update(s) after the break "
+      f"(budget {budget}), {d['drift_swaps_total']} swap(s), "
+      f"gain {d['managed_vs_frozen_heldout_gain']:+.4g}, "
+      f"p99 ratio {d['drift_p99_ratio']:.3f}, 0 recompiles")
+PY
+echo "leg 2 OK: detection within budget, swap recompile-free, budgets hold"
+
+# --- leg 3: hot swap == cold open on the swapped params (bit-exact) -----
+python - <<'PY'
+import dataclasses
+
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+
+from dfm_tpu import DynamicFactorModel, fit, open_fleet, open_session
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(31)
+p_true = dgp.dfm_params(10, 2, rng)
+Y, _ = dgp.simulate(p_true, 66, rng)
+Y0, stream = Y[:60], Y[60:]
+model = DynamicFactorModel(n_factors=2)
+
+
+def fleet_answer(r, swap=None):
+    fl = open_fleet([r], [Y0], tenants=["t0"], capacity=70,
+                    max_update_rows=2, max_iters=3, tol=0.0)
+    if swap is not None:
+        fl.swap_params("t0", swap)
+    fl.submit("t0", stream[:2])
+    u = fl.drain()["t0"][-1]
+    fl.close()
+    return u
+
+
+with jax.default_matmul_precision("highest"):
+    res = fit(model, Y0, max_iters=8, tol=0.0, fused=True)
+    res2 = fit(model, Y0, max_iters=24, tol=0.0, fused=True)  # "refit"
+    assert not np.allclose(res.params.Lam, res2.params.Lam)
+    res_sw = dataclasses.replace(res, params=res2.params)
+
+    # Contract 1 (bit-exact): a hot swap serves EXACTLY what a fleet
+    # opened cold on the swapped params serves — the swap installs the
+    # refit params and nothing else moves.
+    a = fleet_answer(res, swap=res2.params)
+    b = fleet_answer(res_sw)
+    assert np.array_equal(np.asarray(a.nowcast), np.asarray(b.nowcast)), \
+        "post-swap nowcast != cold open on swapped params"
+    for key in a.forecasts:
+        assert np.array_equal(np.asarray(a.forecasts[key]),
+                              np.asarray(b.forecasts[key])), key
+
+    # Contract 2 (documented parity pin): the swapped tenant matches a
+    # LONE session cold-opened on the swapped params to the fleet-vs-
+    # lone tolerance (vmapped batched linalg reassociates ~1 ulp/dot).
+    sess = open_session(res_sw, Y0, capacity=70, max_update_rows=2,
+                        max_iters=3, tol=0.0)
+    c = sess.update(stream[:2])
+    sess.close()
+    np.testing.assert_allclose(np.asarray(a.nowcast),
+                               np.asarray(c.nowcast),
+                               rtol=0, atol=1e-8)
+
+    # Contract 3: a no-op swap (unchanged params) is bit-identical.
+    d = fleet_answer(res)
+    e = fleet_answer(res, swap=res.params.copy())
+    assert np.array_equal(np.asarray(d.nowcast), np.asarray(e.nowcast)), \
+        "no-op swap changed answers"
+print("hot swap bit-equal to cold open; lone-session parity; "
+      "no-op swap bit-identical")
+PY
+echo "leg 3 OK: hot swap installs exactly the refit params"
+
+# --- leg 4: decision trail round-trips through obs.report ---------------
+TRACE="$TMP/maint.jsonl"
+DFM_TRACE="$TRACE" DFM_DRIFT=1 python - <<'PY'
+import numpy as np
+
+from dfm_tpu import DynamicFactorModel, fit, open_fleet
+from dfm_tpu.fleet import MaintenancePolicy, run_maintenance
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(33)
+p_true = dgp.dfm_params(10, 2, rng)
+Y, _ = dgp.simulate(p_true, 64, rng)
+Y0, stream = Y[:60], Y[60:]
+
+res = fit(DynamicFactorModel(n_factors=2), Y0, max_iters=6, tol=0.0,
+          fused=True)
+fl = open_fleet([res], [Y0], tenants=["t0"], capacity=70,
+                max_update_rows=2, max_iters=3, tol=0.0)
+fl.submit("t0", stream[:2])
+fl.drain()
+recs = run_maintenance(fl, ["t0"],
+                       policy=MaintenancePolicy(max_iters=20))
+fl.close()
+assert len(recs) == 1 and recs[0].action in ("swap", "skip"), recs
+print(f"maintenance pass: {recs[0].action} "
+      f"(delta {recs[0].quality_delta:+.4g})")
+PY
+python -m dfm_tpu.obs.report "$TRACE" --json > "$TMP/report.json"
+python - "$TMP/report.json" <<'PY'
+import json
+import sys
+
+s = json.load(open(sys.argv[1]))
+mt = s["maintenance"]
+assert mt["triggers"] == 1 and mt["refits"] == 1, mt
+assert mt["swaps"] + mt["skips"] == 1, mt
+row = mt["per_tenant"]["t0"]
+assert row["refits"] == 1 and row["action"] in ("swap", "skip"), row
+assert row["engine"], row
+print(f"report maintenance section: {mt['triggers']} trigger, "
+      f"{mt['refits']} refit, action={row['action']}")
+PY
+python -m dfm_tpu.obs.report "$TRACE" > "$TMP/report.txt"
+grep -q "maintenance:" "$TMP/report.txt" || {
+  echo "drift smoke FAILED: text report lost the maintenance stanza" >&2
+  exit 1
+}
+echo "leg 4 OK: decision trail round-trips through obs.report"
+
+echo "drift smoke OK"

@@ -228,5 +228,28 @@ for k in need:
     assert not store.lower_is_better(k), \
         f"perf_gate: {k} must gate higher-is-better"'
 
+# The closed-loop maintenance metrics (bench.drift / tools/drift_smoke.sh)
+# must stay registered: the managed-vs-frozen held-out gain gates
+# higher-is-better (the loop must keep buying forecast quality);
+# detection lag, pre-break false-fire rate and the managed/frozen
+# serving-p99 ratio gate lower-is-better with their own noise floors.
+python -c '
+from dfm_tpu.obs import store
+need = ("managed_vs_frozen_heldout_gain", "drift_detection_lag_updates",
+        "drift_swaps_total", "drift_false_positive_rate",
+        "drift_p99_ratio")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+assert not store.lower_is_better("managed_vs_frozen_heldout_gain"), \
+    "perf_gate: managed_vs_frozen_heldout_gain must gate higher-is-better"
+for k in ("drift_detection_lag_updates", "drift_false_positive_rate",
+          "drift_p99_ratio"):
+    assert store.lower_is_better(k), \
+        f"perf_gate: {k} lost its lower-is-better marker"
+    assert store.noise_floor(k) > 0, \
+        f"perf_gate: {k} lost its noise floor"
+assert store._backfill_kind("BENCH_drift.json") == "bench_drift", \
+    "perf_gate: store backfill no longer imports BENCH_drift.json"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"
