@@ -134,6 +134,41 @@ def main():
         log(f"load: {n_queries} queries in {wall:.3f} s ({qps:.1f} q/s); "
             f"p50 {p50_ms:.1f} ms p99 {p99_ms:.1f} ms")
 
+        # -- tracing-overhead leg: traced vs untraced warm round-trips -
+        # The load leg above ran untraced; a short traced window (ambient
+        # tracer — the daemon's pump thread is not this thread, so
+        # activate() would never reach it) measures the request-waterfall
+        # plumbing's socket-to-socket tax.  Best-of-N on both sides.
+        from dfm_tpu.obs.trace import Tracer, set_ambient
+        n_ov = max(4, min(8, n_queries))
+        un_walls = []
+        for q in range(n_ov):
+            i = q % B
+            tq = time.perf_counter()
+            r = cli.submit(names[i], rows_for(i), wait=True)
+            un_walls.append(time.perf_counter() - tq)
+            assert r.get("ok"), r
+        ov_tracer = Tracer()
+        prev_amb = set_ambient(ov_tracer)
+        try:
+            tr_walls = []
+            for q in range(n_ov):
+                i = q % B
+                tq = time.perf_counter()
+                r = cli.submit(names[i], rows_for(i), wait=True)
+                tr_walls.append(time.perf_counter() - tq)
+                assert r.get("ok"), r
+        finally:
+            set_ambient(prev_amb)
+        trace_overhead_pct = (100.0 * (min(tr_walls) - min(un_walls))
+                              / min(un_walls))
+        n_waterfalls = sum(1 for e in ov_tracer.events
+                           if e.get("kind") == "request")
+        log(f"tracing overhead: traced best {1e3 * min(tr_walls):.2f} ms "
+            f"vs untraced best {1e3 * min(un_walls):.2f} ms "
+            f"({trace_overhead_pct:+.1f}%); {n_waterfalls} waterfalls "
+            f"captured")
+
         # -- overload leg: burn the SLO, burst the shed class ----------
         # An unmeetable latency objective makes every served query a
         # budget violation; after min_events the burn fires and the
@@ -214,6 +249,9 @@ def main():
         "daemon_handoff_gap_ms": round(gap_ms, 2),
         "daemon_dropped_queries": int(dropped),
         "daemon_queries_during_handoff": int(served_during[0]),
+        "trace_overhead_pct": round(trace_overhead_pct, 2),
+        "trace_waterfalls": int(n_waterfalls),
+        "daemon_dedup_hits": int(st.get("dedup_hits", 0)),
         "n_tenants": B,
         "n_queries": n_queries,
         "overload_burst": burst,

@@ -49,7 +49,8 @@ def main():
     from dfm_tpu.utils import dgp
 
     dev = jax.devices()[0]
-    n_stream = (n_queries + 1) * rows  # +1 for the compile/warm-up query
+    # warm-up + traced leg + untraced (tracing-overhead) leg
+    n_stream = (2 * n_queries + 1) * rows
     log(f"device: {dev.platform} ({dev.device_kind}); panel ({N}, {T}) "
         f"k={k}, {n_queries} warm queries x {rows} rows, "
         f"{serve_iters} EM iters/update")
@@ -117,6 +118,22 @@ def main():
             walls.append(time.perf_counter() - t0)
         warm = tracer.summary()
 
+        # Tracing-overhead leg: the same warm queries with the tracer
+        # masked (activate(None) — no spans, no request waterfalls, zero
+        # clock reads).  Best-of-N on both sides isolates the span
+        # plumbing's tax from scheduler noise.
+        untraced_walls = []
+        with activate(None):
+            for i in range(n_queries + 1, 2 * n_queries + 1):
+                t0 = time.perf_counter()
+                sess.update(Y_stream[i * rows:(i + 1) * rows])
+                untraced_walls.append(time.perf_counter() - t0)
+    trace_overhead_pct = (100.0 * (min(walls) - min(untraced_walls))
+                          / min(untraced_walls))
+    log(f"tracing overhead: traced best {1e3 * min(walls):.2f} ms vs "
+        f"untraced best {1e3 * min(untraced_walls):.2f} ms "
+        f"({trace_overhead_pct:+.1f}%)")
+
     p50_ms = 1e3 * _pct(walls, 50)
     p99_ms = 1e3 * _pct(walls, 99)
     blocking = warm["blocking_transfers"] - base["blocking_transfers"]
@@ -152,6 +169,7 @@ def main():
         "serve_p99_ms": round(p99_ms, 2),
         "serve_blocking_transfers_per_query": round(per_query, 3),
         "serve_degraded_queries": int(degraded),
+        "trace_overhead_pct": round(trace_overhead_pct, 2),
         "cold_extend_refit_ms": round(ext_ms, 2),
         "cold_rolling_refit_ms": round(cold_ms, 2),
         "speedup_vs_cold_refit": round(ext_ms / p50_ms, 2),

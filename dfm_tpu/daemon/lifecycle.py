@@ -96,16 +96,26 @@ def replay_entries(fleet, entries) -> int:
     tenant evicted since) must not brick recovery: an entry the fleet
     rejects is skipped with a warning, exactly like a torn line."""
     import numpy as np
+    from ..obs.trace import request_clock
     hi = 0
     n_bad = 0
     for e in entries:
         rows = e.get("rows")
         mask = e.get("mask")
+        # Cross-process trace continuity: a journaled entry keeps its
+        # original trace_id, but replay is NOT the original request — a
+        # fresh replay-marked context (re-stamped t_send so the replayed
+        # waterfall measures replay timing) keeps the id linkable while
+        # making the span impossible to mistake for live traffic.
+        jt = e.get("trace")
+        trace = ({"id": str(jt.get("id", "")), "t_send": request_clock(),
+                  "replay": True} if isinstance(jt, dict) else None)
         try:
             fleet.submit(
                 e["tenant"],
                 None if rows is None else np.asarray(rows, np.float64),
-                mask=None if mask is None else np.asarray(mask))
+                mask=None if mask is None else np.asarray(mask),
+                trace=trace)
         except (KeyError, ValueError, TypeError) as err:
             n_bad += 1
             import warnings

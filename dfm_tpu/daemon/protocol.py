@@ -13,11 +13,15 @@ entries); both ends are Python, and the journal shares the encoding.
 Requests (``op`` selects):
 
 - ``{"op": "submit", "tenant": t, "rows": [[...]]|null, "mask": ...,
-  "id": "..."}`` — enqueue one update (``rows=null`` = pure
-  re-forecast).  ``id`` is the client's idempotency token: retrying a
-  request with the same id after a crash/handoff never double-appends
-  (the daemon answers a duplicate with a pure re-forecast, flagged
-  ``"duplicate": true``).
+  "id": "...", "trace": {"id": "...", "t_send": s}}`` — enqueue one
+  update (``rows=null`` = pure re-forecast).  ``id`` is the client's
+  idempotency token: retrying a request with the same id after a
+  crash/handoff never double-appends (the daemon answers a duplicate
+  with a pure re-forecast, flagged ``"duplicate": true``).  ``trace``
+  is the request-scoped span context (``obs.trace``): the client births
+  a trace_id and stamps ``t_send`` from CLOCK_MONOTONIC; the daemon
+  stamps every downstream boundary into the same dict, journals it, and
+  answers with ``"trace_id"`` so client and server waterfalls join.
 - ``{"op": "ping"}`` / ``{"op": "status"}`` — liveness / introspection.
 - ``{"op": "snapshot"}`` — force a fleet snapshot + journal compaction.
 - ``{"op": "handoff", "reply_to": path}`` — blue/green: drain, snapshot,
@@ -174,8 +178,14 @@ class DaemonClient:
             f = getattr(mask, name, None)
             if f is not None:
                 mask = f()
+        from ..obs.trace import new_trace_id, request_clock
         req = {"op": "submit", "tenant": str(tenant), "rows": rows,
-               "id": req_id or self._next_id()}
+               "id": req_id or self._next_id(),
+               # Trace birth: one uuid + one clock read per round-trip.
+               # Retries reuse the same context (same id, fresh send time
+               # would lie about the true client-observed e2e).
+               "trace": {"id": new_trace_id(),
+                         "t_send": request_clock()}}
         if mask is not None:
             req["mask"] = mask
         while True:

@@ -38,7 +38,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..obs.trace import current_tracer
+from ..obs.trace import current_tracer, finish_request, request_clock
 from ..robust.health import FitHealth, HealthEvent
 from .journal import Journal
 from .lifecycle import recv_listener, restore_daemon_state, send_listener
@@ -92,7 +92,7 @@ class DaemonConfig:
 
 
 class _Ticket:
-    __slots__ = ("req", "seq", "resp", "done", "t_enq")
+    __slots__ = ("req", "seq", "resp", "done", "t_enq", "trace")
 
     def __init__(self, req: dict):
         self.req = req
@@ -100,6 +100,14 @@ class _Ticket:
         self.resp: Optional[dict] = None
         self.done = threading.Event()
         self.t_enq = time.perf_counter()
+        # Request-scoped span context (obs.trace), carried BY REFERENCE
+        # through the queue, the fleet tick, and the ack: each seam
+        # stamps one request_clock() boundary into this dict.
+        tr = req.get("trace")
+        self.trace: Optional[dict] = tr if isinstance(tr, dict) else None
+        if self.trace is not None:
+            self.trace["t_admit"] = request_clock()
+            self.trace["owner"] = "daemon"   # the ack emits the waterfall
 
 
 class DFMDaemon:
@@ -132,6 +140,7 @@ class DFMDaemon:
         self.n_served = 0
         self.n_backpressure = 0
         self.n_shed = 0
+        self.dedup_hits = 0
         self.n_snapshots = 0
         self.n_handoffs = 0
         self._since_snapshot = 0
@@ -283,9 +292,29 @@ class DFMDaemon:
             # Idempotent retry (client reconnected after a crash or
             # handoff): the state change already happened — answer the
             # tenant's latest served result WITHOUT touching the fleet.
+            # Dedup is a first-class observable, not a silent
+            # short-circuit: counted in status(), emitted as a daemon
+            # event, and answered with its own (two-stage) waterfall so
+            # "every answered request has a request event" holds.
+            self.dedup_hits += 1
             resp = dict(self._last_answer.get(
                 tenant, {"ok": True, "note": "already applied"}))
             resp["duplicate"] = True
+            self._emit(action="dedup", tenant=tenant, id=str(rid))
+            trc = req.get("trace")
+            if isinstance(trc, dict):
+                trc.setdefault("t_admit", request_clock())
+                trc["t_ack"] = request_clock()
+                rev = finish_request(trc, tenant=str(tenant),
+                                     session=self._fleet.fleet_id,
+                                     dedup=True)
+                tr = current_tracer()
+                if tr is not None:
+                    tr.emit("request", t=trc.get("t_ack"), **rev)
+                else:
+                    _live_observe({"t": trc.get("t_ack"),
+                                   "kind": "request", **rev})
+                resp["trace_id"] = rev["trace_id"]
             return resp
         floor = self._shed_floor()
         if floor is not None and self._priority(tenant) <= floor:
@@ -333,6 +362,11 @@ class DFMDaemon:
             del self._queue[:len(batch)]
         if not batch:
             return 0
+        t_batch = request_clock() if any(tk.trace is not None
+                                         for tk in batch) else None
+        for tk in batch:
+            if tk.trace is not None:
+                tk.trace["t_batch"] = t_batch   # queue_wait ends here
         with self._fleet_lock:
             import numpy as np
             # Validate + enqueue FIRST: a request the fleet rejects
@@ -349,7 +383,8 @@ class DFMDaemon:
                         tk.req["tenant"],
                         None if rows is None
                         else np.asarray(rows, np.float64),
-                        mask=None if mask is None else np.asarray(mask))
+                        mask=None if mask is None else np.asarray(mask),
+                        trace=tk.trace)
                 except (ValueError, TypeError) as e:
                     tk.resp = {"ok": False, "tenant": tk.req["tenant"],
                                "error": f"rejected: {e}"}
@@ -360,9 +395,12 @@ class DFMDaemon:
                 # Durability before the state change: once journaled, a
                 # crash replays it; enqueued-but-unjournaled submits die
                 # with the process UNACKED (client retries, dedup holds).
+                # "trace" rides into the journal so replay (crash
+                # recovery, takeover delta) keeps the original trace_id
+                # — continuity across the daemon's process boundaries.
                 tk.seq = self._journal.append(
                     {k: tk.req.get(k) for k in ("id", "tenant", "rows",
-                                                "mask")})
+                                                "mask", "trace")})
             if not accepted:
                 return len(batch)
             try:
@@ -406,6 +444,22 @@ class DFMDaemon:
                     self._last_answer[tk.req["tenant"]] = dict(resp)
                     self.n_served += 1
                     self._since_snapshot += 1
+                if tk.trace is not None:
+                    # The ack boundary closes the waterfall: stages are
+                    # adjacent deltas of one clock, so they sum to the
+                    # measured e2e exactly.
+                    tk.trace["t_ack"] = request_clock()
+                    rev = finish_request(tk.trace,
+                                         tenant=str(tk.req["tenant"]),
+                                         session=self._fleet.fleet_id,
+                                         seq=int(tk.seq))
+                    tr = current_tracer()
+                    if tr is not None:
+                        tr.emit("request", t=tk.trace["t_ack"], **rev)
+                    else:
+                        _live_observe({"t": tk.trace["t_ack"],
+                                       "kind": "request", **rev})
+                    resp["trace_id"] = rev["trace_id"]
                 tk.resp = resp
                 tk.done.set()
             if (self.config.snapshot_every
@@ -589,7 +643,8 @@ class DFMDaemon:
             "queue_max": self.config.queue_max,
             "n_requests": self.n_requests, "n_served": self.n_served,
             "n_backpressure": self.n_backpressure,
-            "n_shed": self.n_shed, "n_snapshots": self.n_snapshots,
+            "n_shed": self.n_shed, "dedup_hits": self.dedup_hits,
+            "n_snapshots": self.n_snapshots,
             "n_handoffs": self.n_handoffs,
             "journal_seq": self._journal.last_seq,
             "slo": plane().slo.status(),

@@ -53,7 +53,7 @@ import numpy as np
 from ..estim.batched import (CONVERGED, DIVERGED, pad_params_to_k,
                              pad_params_to_n, slice_params_to_k,
                              slice_params_to_n)
-from ..obs.trace import current_tracer
+from ..obs.trace import current_tracer, finish_request, request_clock
 from ..robust.dispatch import guarded_dispatch
 from ..robust.health import FitHealth, HealthEvent
 from ..serve.batched import (FleetOptions, _fleet_impl, _fleet_impl_donated,
@@ -94,9 +94,9 @@ class _Query:
     """One queued tenant update (host units, validated at submit)."""
 
     __slots__ = ("tenant", "rows", "W_rows", "rz", "n_new", "t_submit",
-                 "seq")
+                 "seq", "trace")
 
-    def __init__(self, tenant, rows, W_rows, rz, n_new, seq):
+    def __init__(self, tenant, rows, W_rows, rz, n_new, seq, trace=None):
         self.tenant = tenant
         self.rows = rows            # (n, N) original units, NaNs kept
         self.W_rows = W_rows        # (n, N) {0,1}
@@ -104,6 +104,7 @@ class _Query:
         self.n_new = n_new
         self.seq = seq
         self.t_submit = time.perf_counter()
+        self.trace = trace          # request span context (obs.trace)
 
 
 def _per_tenant(value, B, name, cast):
@@ -358,13 +359,22 @@ class SessionFleet:
             raise RuntimeError("fleet is closed")
 
     # -- the queue -----------------------------------------------------
-    def submit(self, tenant: str, rows=None, mask=None) -> int:
+    def submit(self, tenant: str, rows=None, mask=None,
+               trace=None) -> int:
         """Enqueue one tenant update ((n, N) or (N,) original-units rows,
         NaN = missing; ``rows=None`` queues a pure re-forecast — warm EM
         + smooth + forecast with no append).  All capacity/shape
         validation happens here, against the PROJECTED live length (rows
         already queued count) — an invalid submit raises without touching
-        the queue.  Returns the queue depth after the submit."""
+        the queue.  Returns the queue depth after the submit.
+
+        ``trace`` is the request span context (``obs.trace``): the
+        daemon passes its ticket's dict; direct callers inherit any
+        enclosing ``request_span`` or, when a tracer is active, birth a
+        fresh context here — the tick stamps dispatch/d2h boundaries
+        into it and the query event carries its trace_id.  Untraced,
+        context-free submits skip the machinery entirely (no clock
+        reads, no ids — byte-identical events to pre-trace builds)."""
         self._check_open()
         if tenant not in self._slot_of:
             raise KeyError(f"unknown tenant {tenant!r} (fleet has "
@@ -407,8 +417,17 @@ class SessionFleet:
         if slot.tier != "hot" and not slot.quarantined:
             self.admit(tenant)
         slot.last_used = next(self._seq)
+        if trace is None:
+            from ..obs.trace import current_request, current_tracer
+            trace = current_request()
+            if trace is None and current_tracer() is not None:
+                from ..obs.trace import new_trace_id, request_clock
+                trace = {"id": new_trace_id(), "t_send": request_clock()}
+        if trace is not None:
+            from ..obs.trace import request_clock
+            trace.setdefault("t_admit", request_clock())
         self._pending.append(_Query(tenant, r, W_rows, rz, r.shape[0],
-                                    next(self._seq)))
+                                    next(self._seq), trace=trace))
         return len(self._pending)
 
     # -- snapshot tiering ----------------------------------------------
@@ -527,8 +546,12 @@ class SessionFleet:
             bucket.redeploy()
             # Materialize the rebuilt device buffers NOW: the swap runs
             # on the maintenance pass, and the h2d re-upload must not
-            # land on the next serving query's wall.
-            jax.block_until_ready((bucket.Ybuf, bucket.Wbuf, bucket.p))
+            # land on the next serving query's wall.  A d2h read-back is
+            # the only real barrier on axon (block_until_ready is a
+            # no-op there — CLAUDE.md, pinned by test_timing_guard).
+            for leaf in jax.tree_util.tree_leaves(
+                    (bucket.Ybuf, bucket.Wbuf, bucket.p)):
+                np.asarray(leaf)
         elif slot.tier == "warm":
             slot.warm_p = p_pad
         else:                           # cold: rewrite the npz in place
@@ -702,6 +725,21 @@ class SessionFleet:
                       opts=bucket.opts)
         pol = self._policy
         tr = current_tracer()
+        # Request spans riding this tick (obs.trace): one CLOCK_MONOTONIC
+        # read per boundary, shared by every span in the batch — zero
+        # clock reads when no query carries a trace.
+        tids = [q.trace.get("id", "") if q.trace is not None else ""
+                for _, q in sorted(lane_q.items())]
+        tr_q = [q.trace for _, q in sorted(lane_q.items())
+                if q.trace is not None]
+
+        def _stamp(key):
+            if tr_q:
+                t_now = request_clock()
+                for trc in tr_q:
+                    trc[key] = t_now
+
+        _stamp("t_tick0")
         acc, dt = bucket.acc, bucket.dt
         t0 = time.perf_counter()
         with self._backend._precision_ctx():
@@ -721,9 +759,15 @@ class SessionFleet:
                 args = (bucket.Ybuf, bucket.Wbuf, rows_j, rmask_j,
                         consts[0], consts[1], consts[2], bucket.p,
                         consts[3], consts[4], consts[5], consts[6])
+                # Span stamps land on EVERY attempt (last one wins), so a
+                # retried dispatch's waterfall truthfully absorbs the
+                # backoff into its dispatch stage.
                 if tr is None:
                     o = impl(*args, **kw)
-                    return o, self._read(o, donated and pol is not None)
+                    _stamp("t_launch")
+                    host = self._read(o, donated and pol is not None)
+                    _stamp("t_read")
+                    return o, host
                 if attempt == 0:
                     tr.maybe_cost("serve_update", bucket.key, impl, *args,
                                   **kw)
@@ -732,7 +776,9 @@ class SessionFleet:
                                  fused=True, n_iters=bucket.max_iters,
                                  batch=B, **extra) as rec:
                     o = impl(*args, **kw)
+                    _stamp("t_launch")
                     host = self._read(o, donated and pol is not None)
+                    _stamp("t_read")
                     if rec is not None:
                         rec["n_iters"] = int(host["n_iters"].max())
                 return o, host
@@ -744,7 +790,7 @@ class SessionFleet:
                     out, host = guarded_dispatch(
                         _once, pol, self.health, label="fleet tick",
                         session=self._fid, tenants=active,
-                        iteration=self._n_ticks,
+                        trace_ids=tids, iteration=self._n_ticks,
                         last_good=lambda: bucket.p_host)
             except GuardFailure as e:
                 # The bucket program cannot be dispatched: quarantine
@@ -868,11 +914,28 @@ class SessionFleet:
                        **({"ll_per_row": llpr} if llpr is not None
                           else {}),
                        **({"n_evicted": int(e)} if e else {}),
-                       **({"degraded": True} if degraded else {}))
+                       **({"degraded": True} if degraded else {}),
+                       **({"trace_id": q.trace.get("id", "")}
+                          if q.trace is not None else {}),
+                       **({"replay": True}
+                          if q.trace is not None and q.trace.get("replay")
+                          else {}))
             if tr is not None:
                 tr.emit("query", **qev)
             else:
                 live_observe({"t": t0 + wall, "kind": "query", **qev})
+            if q.trace is not None and q.trace.get("owner") != "daemon":
+                # Direct fleet.submit / journal replay: the fleet ends
+                # the span here (daemon-owned spans finish at the ack —
+                # the daemon stamps t_ack and emits the request event).
+                q.trace["t_ack"] = request_clock()
+                rev = finish_request(q.trace, tenant=slot.name,
+                                     session=self._fid)
+                if tr is not None:
+                    tr.emit("request", t=q.trace["t_ack"], **rev)
+                else:
+                    live_observe({"t": q.trace["t_ack"],
+                                  "kind": "request", **rev})
             results.append((slot.name, upd))
         tev = dict(session=self._fid,
                    bucket=self._buckets.index(bucket), batch=B,
@@ -981,12 +1044,14 @@ class SessionFleet:
             RuntimeWarning, stacklevel=3)
 
     def _serve_evicted(self, slot, q: "_Query") -> SessionUpdate:
-        """Route one queued query to the tenant's lone evicted session."""
+        """Route one queued query to the tenant's lone evicted session.
+        The request span (if any) rides along — the lone session stamps
+        its boundaries, so quarantined requests keep their waterfall."""
         slot.n_queries += 1
         self._n_queries += 1
         if q.n_new == 0:
-            return slot.evicted.update(None)
-        upd = slot.evicted.update(q.rows, mask=q.W_rows)
+            return slot.evicted.update(None, trace=q.trace)
+        upd = slot.evicted.update(q.rows, mask=q.W_rows, trace=q.trace)
         slot.append_orig(q.rows, q.W_rows)
         if self._ring and slot.t > slot.capacity:
             # Mirror the lone session's ring: the quarantine seed stays

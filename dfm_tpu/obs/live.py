@@ -191,7 +191,8 @@ class LivePlane:
                          action=action, t=t if isinstance(t, (int, float))
                          else 0.0, engine="live",
                          tenant=str(src.get("tenant", "")),
-                         session=str(src.get("session", "")))
+                         session=str(src.get("session", "")),
+                         trace_id=str(src.get("trace_id", "")))
         ev = {"t": he.t, "kind": "health", "event": name, "chunk": -1,
               "iteration": -1, "action": action, "detail": detail,
               "engine": "live",
@@ -202,6 +203,12 @@ class LivePlane:
             ev["tenant"] = he.tenant
         if he.session:
             ev["session"] = he.session
+        if he.trace_id:
+            # The offending request: an SLO burn / latency spike fired on
+            # THIS query's observation, so its trace_id is the tail
+            # exemplar — the flight dump and the mirrored health event
+            # both resolve straight back to the request's full waterfall.
+            ev["trace_id"] = he.trace_id
         with self._lock:
             self.health_events.append(he)
             self.ring.append(ev)

@@ -55,9 +55,14 @@ class Histogram:
     ``quantile`` walks the cumulative counts and returns the geometric
     mean of the matched bucket's edges, clamped to the exact observed
     [min, max].  Values outside [1e-6, 1e6) clamp to the end buckets.
+
+    ``observe(x, exemplar=...)`` keeps ONE tail exemplar per series: the
+    trace_id of the largest exemplar-carrying observation so far — the
+    request to read when the p99 looks wrong (OpenMetrics exemplar on the
+    0.99 quantile in ``render_prom``).
     """
 
-    __slots__ = ("count", "sum", "min", "max", "buckets")
+    __slots__ = ("count", "sum", "min", "max", "buckets", "exemplar")
 
     def __init__(self):
         self.count = 0
@@ -65,8 +70,9 @@ class Histogram:
         self.min = math.inf
         self.max = -math.inf
         self.buckets: Dict[int, int] = {}
+        self.exemplar: Optional[Tuple[float, str]] = None  # (value, trace_id)
 
-    def observe(self, x: float) -> None:
+    def observe(self, x: float, exemplar: Optional[str] = None) -> None:
         x = float(x)
         if not math.isfinite(x):
             return
@@ -76,6 +82,8 @@ class Histogram:
             self.min = x
         if x > self.max:
             self.max = x
+        if exemplar and (self.exemplar is None or x >= self.exemplar[0]):
+            self.exemplar = (x, str(exemplar))
         if x <= _LO:
             i = 0
         else:
@@ -97,10 +105,14 @@ class Histogram:
         return self.max
 
     def to_dict(self) -> dict:
-        return {"count": self.count, "sum": self.sum,
-                "min": self.min if self.count else None,
-                "max": self.max if self.count else None,
-                "buckets": {str(i): n for i, n in sorted(self.buckets.items())}}
+        d = {"count": self.count, "sum": self.sum,
+             "min": self.min if self.count else None,
+             "max": self.max if self.count else None,
+             "buckets": {str(i): n for i, n in sorted(self.buckets.items())}}
+        if self.exemplar is not None:
+            d["exemplar"] = {"value": self.exemplar[0],
+                             "trace_id": self.exemplar[1]}
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Histogram":
@@ -111,6 +123,10 @@ class Histogram:
         h.max = -math.inf if d.get("max") is None else float(d["max"])
         h.buckets = {int(i): int(n)
                      for i, n in dict(d.get("buckets", {})).items()}
+        ex = d.get("exemplar")   # absent in pre-exemplar snapshots: fine
+        if ex:
+            h.exemplar = (float(ex.get("value", 0.0)),
+                          str(ex.get("trace_id", "")))
         return h
 
 
@@ -252,7 +268,14 @@ class MetricsRegistry:
                     if val is None:
                         continue
                     lab = labels + (("quantile", f"{q:g}"),)
-                    lines.append(f"{pname}{_prom_labels(lab)} {val:g}")
+                    line = f"{pname}{_prom_labels(lab)} {val:g}"
+                    if q == 0.99 and h.exemplar is not None:
+                        # OpenMetrics tail exemplar: the trace_id of the
+                        # worst exemplar-carrying observation — a p99
+                        # alert resolves straight to a request trace.
+                        xv, tid = h.exemplar
+                        line += f' # {{trace_id="{tid}"}} {xv:g}'
+                    lines.append(line)
                 lines.append(
                     f"{pname}_count{_prom_labels(labels)} {h.count}")
                 lines.append(
@@ -389,7 +412,7 @@ def record_event(registry: MetricsRegistry, ledger: Optional[Ledger],
         wall = _num(ev.get("wall"))
         if wall is not None:
             registry.histogram("query_wall_ms", tenant=ten).observe(
-                wall * 1e3)
+                wall * 1e3, exemplar=ev.get("trace_id"))
         qw = _num(ev.get("queue_wait"))
         if qw is not None:
             registry.histogram("queue_wait_ms", tenant=ten).observe(qw * 1e3)
@@ -428,6 +451,27 @@ def record_event(registry: MetricsRegistry, ledger: Optional[Ledger],
                         int(N), int(t_rows), int(k))[0] * it
             if ev.get("degraded"):
                 row["degraded"] += 1
+    elif kind == "request":
+        # Per-request latency waterfall (obs.trace.finish_request): one
+        # e2e histogram with a tail exemplar plus one histogram per stage,
+        # so "where does p99 go" is answerable live, not just post-hoc.
+        ten = str(ev.get("tenant", "-"))
+        tid = ev.get("trace_id")
+        registry.counter("requests_total", tenant=ten).inc()
+        if ev.get("replay"):
+            registry.counter("replayed_requests_total", tenant=ten).inc()
+        if ev.get("dedup"):
+            registry.counter("dedup_hits_total", tenant=ten).inc()
+        e2e = _num(ev.get("e2e"))
+        if e2e is not None:
+            registry.histogram("request_e2e_ms", tenant=ten).observe(
+                e2e * 1e3, exemplar=tid)
+        for stage, dur in dict(ev.get("stages") or {}).items():
+            d = _num(dur)
+            if d is not None:
+                registry.histogram("request_stage_ms",
+                                   stage=str(stage)).observe(
+                    max(d, 0.0) * 1e3, exemplar=tid)
     elif kind == "tick":
         fid = str(ev.get("session", "-"))
         registry.counter("ticks_total", fleet=fid).inc()

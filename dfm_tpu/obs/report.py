@@ -29,12 +29,22 @@ duration lists needed for exact nearest-rank percentiles are kept).
 
 The JSON summary schema is versioned (top-level ``schema_version``) and
 the ``tenants`` / ``tenant_fairness`` / ``queries`` / ``fleet`` /
-``daemon`` / ``robustness`` / ``metrics`` sections are always present
-with stable keys,
+``daemon`` / ``requests`` / ``robustness`` / ``metrics`` sections are
+always present with stable keys,
 empty or not.
 
+The ``requests`` section aggregates request-scoped waterfalls
+(``obs.trace`` ``request`` events): per-stage latency percentiles with
+each stage's share of total stage time (the "where does p99 go"
+attribution table), per-tenant breakdowns, tail exemplars (the slowest
+trace_ids), and the maximum waterfall residual |sum(stages) - e2e| —
+zero by construction, so anything over float fuzz flags a broken span.
+
 ``--chrome out.json`` additionally exports the raw event stream to
-Chrome/Perfetto trace-event format for visual pipeline inspection.
+Chrome/Perfetto trace-event format for visual pipeline inspection;
+request waterfalls become per-stage slices on a dedicated lane plus
+Perfetto flow events linking each request to the query/dispatch spans
+that carry its trace_id.
 """
 
 from __future__ import annotations
@@ -188,6 +198,13 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
     n_drift_fired = n_drift_cleared = 0
     mt_counts: dict = {}
     mt_tenant: dict = {}
+    # request-scoped waterfalls (obs.trace request events)
+    rq_n = rq_replayed = rq_dedup = 0
+    rq_e2e: List[float] = []
+    rq_exemplars: List = []       # (e2e, trace_id) — tail kept at the end
+    rq_stage: dict = {}           # stage name -> walls
+    rq_tenant: dict = {}
+    rq_residual_max = 0.0         # max |sum(stages) - e2e| seen
 
     def _mt_row(who: str) -> dict:
         return mt_tenant.setdefault(who, {
@@ -343,6 +360,34 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
                     "requests": 0, "backpressure": 0, "shed": 0})
                 pt["requests" if act == "request"
                    else "backpressure"] += 1
+        elif kind == "request":
+            rq_n += 1
+            rq_replayed += bool(e.get("replay"))
+            rq_dedup += bool(e.get("dedup"))
+            stages = e.get("stages") or {}
+            e2e = e.get("e2e")
+            ssum = 0.0
+            for nm, d in stages.items():
+                if isinstance(d, (int, float)):
+                    rq_stage.setdefault(str(nm), []).append(float(d))
+                    ssum += float(d)
+            if isinstance(e2e, (int, float)):
+                rq_e2e.append(float(e2e))
+                tidv = str(e.get("trace_id") or "")
+                if tidv:
+                    rq_exemplars.append((float(e2e), tidv))
+                if stages:
+                    rq_residual_max = max(rq_residual_max,
+                                          abs(ssum - float(e2e)))
+            who = str(e.get("tenant") or e.get("session") or "?")
+            pr = rq_tenant.setdefault(
+                who, {"n": 0, "e2e": [], "stages": {}})
+            pr["n"] += 1
+            if isinstance(e2e, (int, float)):
+                pr["e2e"].append(float(e2e))
+            for nm, d in stages.items():
+                if isinstance(d, (int, float)):
+                    pr["stages"].setdefault(str(nm), []).append(float(d))
         elif kind == "maintenance":
             act = str(e.get("action", "?"))
             mt_counts[act] = mt_counts.get(act, 0) + 1
@@ -646,6 +691,41 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
         "skips": mt_counts.get("skip", 0),
         "per_tenant": mt_tenant,
     }
+    # Request-scoped waterfalls (obs.trace): the per-stage decomposition
+    # of client-observed latency.  ``per_stage`` is the "where does p99
+    # go" attribution table — each stage's percentiles plus its share of
+    # total stage time; ``tail_exemplars`` are the slowest trace_ids (the
+    # requests to pull out of the raw trace / flight dump when chasing
+    # the p99); ``waterfall_residual_max_s`` must sit at float fuzz —
+    # stages telescope off one CLOCK_MONOTONIC timeline by construction.
+    def _stage_table(stage_walls: dict) -> dict:
+        tot = sum(sum(v) for v in stage_walls.values())
+        tbl = {}
+        order = ("client_send", "queue_wait", "batch_form", "dispatch",
+                 "d2h", "ack")
+        for nm in list(order) + sorted(set(stage_walls) - set(order)):
+            if nm not in stage_walls:
+                continue
+            st = _stats(stage_walls[nm])
+            st["share"] = (sum(stage_walls[nm]) / tot) if tot > 0 else None
+            tbl[nm] = st
+        return tbl
+
+    for pr in rq_tenant.values():
+        pr["e2e_s"] = _stats(pr.pop("e2e"))
+        pr["per_stage"] = _stage_table(pr.pop("stages"))
+    rq_exemplars.sort(key=lambda p: -p[0])
+    out["requests"] = {
+        "n_requests": rq_n,
+        "replayed": rq_replayed,
+        "dedup": rq_dedup,
+        "e2e_s": _stats(rq_e2e),
+        "per_stage": _stage_table(rq_stage),
+        "per_tenant": rq_tenant,
+        "tail_exemplars": [{"e2e_s": v, "trace_id": t}
+                           for v, t in rq_exemplars[:3]],
+        "waterfall_residual_max_s": rq_residual_max,
+    }
     # The live-plane digest: the same record_event mapping obs.live runs
     # in-process, replayed over this trace.
     out["metrics"] = metrics_summary(reg)
@@ -767,6 +847,53 @@ def _print_text(s: dict) -> None:
                 print(f"  {tid:12s} {pt['requests']} accepted, "
                       f"{pt['backpressure']} backpressure, "
                       f"{pt['shed']} shed")
+    rq = s.get("requests")
+    if rq and rq["n_requests"]:
+        e2 = rq.get("e2e_s") or {}
+        line = f"requests: {rq['n_requests']} waterfall"
+        line += "s" if rq["n_requests"] != 1 else ""
+        extras = []
+        if rq.get("replayed"):
+            extras.append(f"{rq['replayed']} replayed")
+        if rq.get("dedup"):
+            extras.append(f"{rq['dedup']} dedup")
+        if extras:
+            line += f" ({', '.join(extras)})"
+        if e2:
+            line += (f"; e2e p50 {_fmt_s(e2['p50'])} / "
+                     f"p99 {_fmt_s(e2['p99'])}")
+        line += (f"; waterfall residual max "
+                 f"{1e3 * rq['waterfall_residual_max_s']:.3f} ms")
+        print(line)
+        ps = rq.get("per_stage") or {}
+        if ps:
+            # Where does the p99 go: per-stage walls + share of total.
+            print(f"  {'stage':12s} {'p50':>9s} {'p99':>9s} {'share':>7s}")
+            for nm, st in ps.items():
+                share = (f"{100 * st['share']:6.1f}%"
+                         if isinstance(st.get("share"), (int, float))
+                         else "      -")
+                print(f"  {nm:12s} {_fmt_s(st['p50']):>9s} "
+                      f"{_fmt_s(st['p99']):>9s} {share:>7s}")
+        for who, pr in (rq.get("per_tenant") or {}).items():
+            e2t = pr.get("e2e_s") or {}
+            bits = [f"  {who:12s} {pr['n']} request"
+                    f"{'s' if pr['n'] != 1 else ''}"]
+            if e2t:
+                bits.append(f"e2e p50 {_fmt_s(e2t['p50'])} / "
+                            f"p99 {_fmt_s(e2t['p99'])}")
+            pst = pr.get("per_stage") or {}
+            if pst:
+                top = max(pst.items(),
+                          key=lambda kv: kv[1].get("share") or 0.0)
+                if isinstance(top[1].get("share"), (int, float)):
+                    bits.append(f"dominant stage {top[0]} "
+                                f"({100 * top[1]['share']:.0f}%)")
+            print(", ".join(bits))
+        tails = rq.get("tail_exemplars") or []
+        if tails:
+            print("  tail exemplars: " + ", ".join(
+                f"{t['trace_id']} ({_fmt_s(t['e2e_s'])})" for t in tails))
     rb = s.get("robustness")
     if rb and (rb["dispatch_retries"] or rb["quarantines"]
                or rb["recovered_divergences"] or rb["degraded_queries"]
@@ -977,7 +1104,11 @@ def to_chrome(events: List[dict]) -> dict:
     spans land on a "device" track (one thread lane per program, so
     pipeline overlap is visible as stacked in-flight spans), transfers
     and host-side markers (chunk checks, fit/advice, health) on a "host"
-    track.  Timestamps are rebased to the first event; ts/dur in µs."""
+    track.  Timestamps are rebased to the first event; ts/dur in µs.
+    Request waterfalls (``request`` events) additionally become per-stage
+    slices on their own lane, joined to the query spans carrying the same
+    trace_id by Perfetto flow arrows (ph s/t/f, id = crc32(trace_id))."""
+    import zlib
     timed = [e for e in events if isinstance(e.get("t"), (int, float))]
     if not timed:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
@@ -990,12 +1121,46 @@ def to_chrome(events: List[dict]) -> dict:
         return tids.setdefault((pid, lane), len(
             [k for k in tids if k[0] == pid]))
 
+    def flow_id(trace_id: str) -> int:
+        return zlib.crc32(trace_id.encode("utf-8"))
+
     out = []
     _skip = ("t", "kind", "dur", "program")
     for e in timed:
         kind = e.get("kind")
         args = {k: v for k, v in e.items() if k not in _skip
                 and v is not None}
+        if kind == "request":
+            # One slice spanning the whole waterfall (the request event's
+            # t is the final boundary stamp, so the slice starts e2e
+            # earlier), per-stage child slices reconstructed by walking
+            # the stage durations forward, and a flow start/finish pair
+            # so Perfetto draws arrows to this trace_id's query spans.
+            e2e = float(e.get("e2e") or 0.0)
+            tidv = str(e.get("trace_id") or "?")
+            lane = tid(_HOST_PID, "requests")
+            out.append({"name": f"request {tidv}", "ph": "X",
+                        "ts": us(float(e["t"]) - e2e), "dur": 1e6 * e2e,
+                        "pid": _HOST_PID, "tid": lane,
+                        "cat": "request", "args": args})
+            cum = float(e["t"]) - e2e
+            for nm, d in (e.get("stages") or {}).items():
+                if not isinstance(d, (int, float)):
+                    continue
+                out.append({"name": str(nm), "ph": "X", "ts": us(cum),
+                            "dur": 1e6 * float(d), "pid": _HOST_PID,
+                            "tid": tid(_HOST_PID, "request stages"),
+                            "cat": "request",
+                            "args": {"trace_id": tidv}})
+                cum += float(d)
+            out.append({"name": "request", "ph": "s", "id": flow_id(tidv),
+                        "ts": us(float(e["t"]) - e2e), "pid": _HOST_PID,
+                        "tid": lane, "cat": "request_flow"})
+            out.append({"name": "request", "ph": "f", "bp": "e",
+                        "id": flow_id(tidv), "ts": us(e["t"]),
+                        "pid": _HOST_PID, "tid": lane,
+                        "cat": "request_flow"})
+            continue
         if kind == "dispatch":
             name = e.get("program", "?")
             out.append({"name": name, "ph": "X", "ts": us(e["t"]),
@@ -1016,6 +1181,14 @@ def to_chrome(events: List[dict]) -> dict:
                         "ts": us(e["t"]), "pid": _HOST_PID,
                         "tid": tid(_HOST_PID, str(kind)),
                         "cat": str(kind), "args": args})
+            if e.get("trace_id"):
+                # A span-carrying marker (query, health, tenant): a flow
+                # step joins it to its request's waterfall slice.
+                out.append({"name": "request", "ph": "t",
+                            "id": flow_id(str(e["trace_id"])),
+                            "ts": us(e["t"]), "pid": _HOST_PID,
+                            "tid": tid(_HOST_PID, str(kind)),
+                            "cat": "request_flow"})
     meta = [{"ph": "M", "name": "process_name", "pid": _DEVICE_PID,
              "args": {"name": "device (dispatch spans)"}},
             {"ph": "M", "name": "process_name", "pid": _HOST_PID,

@@ -48,7 +48,8 @@ _LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
                          "dispatches_per_fit", "pad_waste", "degraded",
                          "slo_burn_rate", "flight_dumps", "noise_ratio",
                          "evictions_per", "shed_rate", "dropped_queries",
-                         "detection_lag", "false_positive", "p99_ratio")
+                         "detection_lag", "false_positive", "p99_ratio",
+                         "trace_overhead")
 
 
 def lower_is_better(metric: str) -> bool:
@@ -110,6 +111,15 @@ _NOISE_FLOORS = (
     # 1.17 on back-to-back identical runs); the smoke's 5 ms absolute
     # floor is the contract check, the gate only catches gross motion.
     ("p99_ratio", 0.25),
+    # Request-tracing overhead (bench.serve / bench.daemon): traced vs
+    # untraced warm wall as a percentage.  Both walls are few-ms
+    # best-of-N on the 1-core CPU-fallback box, so the ratio of their
+    # difference jitters by several points run-to-run with zero tracing-
+    # cost signal; only a >5-point move says the span plumbing got
+    # heavier.  Must match BEFORE the generic "ms" row ("trace_overhead_
+    # pct" is a percentage, not milliseconds... it contains no ms, but
+    # keep it ahead of any future broadening of the generic rows).
+    ("trace_overhead", 5.0),
     ("ms", 2.0),           # milliseconds: ms_per, _ms, dispatch_ms_...
     ("_s", 0.05),          # seconds: wall_s, dispatch_s, compile_s, time_s
     ("secs", 0.05),
@@ -358,6 +368,11 @@ _BENCH_NUMERIC_KEYS = (
     # serving path).
     "drift_detection_lag_updates", "managed_vs_frozen_heldout_gain",
     "drift_swaps_total", "drift_false_positive_rate", "drift_p99_ratio",
+    # Request-scoped tracing (bench.serve / bench.daemon): traced vs
+    # untraced warm wall, best-of-N, as a percentage — the span
+    # plumbing's serving-path tax (lower-is-better; "trace_overhead"
+    # marker + 5-point floor above).
+    "trace_overhead_pct",
 )
 
 

@@ -29,6 +29,15 @@ Event schema (every event):
       ``health``    a ``robust.health.HealthEvent``, timestamped
       ``cost``      static XLA cost model for a program (opt-in)
       ``span``      generic timed region (``name``, ``dur``)
+      ``request``   one per answered serving request: ``trace_id``, the
+                    per-stage latency waterfall (``stages``: adjacent
+                    deltas of ONE monotonic clock, telescoping exactly to
+                    ``e2e``), optional ``replay``/``dedup`` flags
+
+The full kind inventory lives in ``EVENT_KINDS`` — ``summarize()`` and the
+live plane route on these strings, so a typo'd kind silently vanishes from
+every report.  ``tests/test_trace_schema.py`` AST-audits every
+``emit(kind)``/``{"kind": ...}`` literal in the package against it.
 
 Activation: ``fit(telemetry=...)`` pushes a tracer for the duration of the
 fit; ``DFM_TRACE=<path>`` makes a process-ambient file tracer that
@@ -39,17 +48,34 @@ instrumented code picks up when no explicit tracer is active.  With neither,
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 from typing import IO, List, Optional, Union
 
 from .cost import RecompileDetector, global_detector
 
 __all__ = ["Tracer", "current_tracer", "activate", "fit_tracer",
-           "shape_key"]
+           "shape_key", "EVENT_KINDS", "new_trace_id", "request_clock",
+           "current_request", "request_span", "born_request",
+           "finish_request", "set_ambient"]
+
+# Closed schema of event kinds the obs stack routes on.  summarize() /
+# LivePlane.record_event / to_chrome all branch on these strings; a kind
+# not in this set is an event NOTHING will ever aggregate.  Extending the
+# schema means adding the kind here AND teaching obs/metrics.record_event
+# + obs/report what to do with it (tests/test_trace_schema.py enforces
+# membership for every literal in the package).
+EVENT_KINDS = frozenset({
+    "fit", "dispatch", "transfer", "chunk", "freeze", "health", "cost",
+    "span", "query", "tick", "tenant", "page", "daemon", "maintenance",
+    "compile_cache", "advice", "panel_reupload", "fused_fallback",
+    "request",
+})
 
 
 def _json_default(o):
@@ -322,3 +348,122 @@ def fit_tracer(telemetry) -> tuple:
     if isinstance(telemetry, Tracer):
         return telemetry, False
     return Tracer(os.fspath(telemetry)), True
+
+
+def set_ambient(tracer: Optional[Tracer]) -> Optional[Tracer]:
+    """Install ``tracer`` as the process-ambient tracer (the one every
+    thread's ``current_tracer()`` falls back to) and return the previous
+    ambient.  ``activate()`` is thread-local — a daemon's pump thread never
+    sees a tracer the benchmark pushed on the main thread; this is the
+    cross-thread knob.  ``set_ambient(None)`` restores the untraced default
+    (and masks any ``DFM_TRACE`` seed until the process restarts)."""
+    global _env_tracer
+    prev = _env_tracer
+    _env_tracer = tracer
+    return None if prev is _ENV_SENTINEL else prev
+
+
+# -- request-scoped spans -------------------------------------------------
+#
+# A request trace is a plain mutable dict born where a serving request is
+# born (DaemonClient.submit / fleet.submit / session.update) and carried BY
+# REFERENCE through the queue, the tick, and the ack.  Each seam writes one
+# absolute timestamp from request_clock() into it; the finisher turns the
+# telescoping adjacent deltas into the "request" event's stage waterfall —
+# the stages sum to the measured e2e EXACTLY because every boundary is a
+# single reading of a single clock.  request_clock() is CLOCK_MONOTONIC:
+# system-wide per host (unlike a perf_counter epoch, which on some
+# platforms is per-process), so stamps survive the daemon's cross-process
+# seams — kill-9 journal replay and --takeover handoff — the same way the
+# handoff's t_stop does.  (On Linux perf_counter IS CLOCK_MONOTONIC, which
+# is what lets request stamps and ordinary event ``t`` values share one
+# timeline in obs.report --chrome.)
+
+_request_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "dfm_request", default=None)
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex request id (collision-safe at fleet scale, short
+    enough to read in a waterfall)."""
+    return uuid.uuid4().hex[:16]
+
+
+def request_clock() -> float:
+    """The one clock every request stamp uses (see module comment)."""
+    return time.clock_gettime(time.CLOCK_MONOTONIC)
+
+
+def current_request() -> Optional[dict]:
+    """The request trace dict in flight on this context, or None."""
+    return _request_ctx.get()
+
+
+@contextmanager
+def request_span(trace: Optional[dict] = None, *, replay: bool = False):
+    """Bind a request trace dict for the block (contextvar — survives
+    threads only via explicit propagation, which the daemon/fleet do by
+    carrying the dict itself).  With ``trace=None`` a fresh context is
+    born: ``{"id": new_trace_id(), "t_send": request_clock()}``.
+    ``replay=True`` stamps the context so every downstream span and the
+    final waterfall carry ``replay: true`` (journal-replay requests must
+    never be mistaken for live traffic)."""
+    if trace is None:
+        trace = {"id": new_trace_id(), "t_send": request_clock()}
+    if replay:
+        trace["replay"] = True
+    tok = _request_ctx.set(trace)
+    try:
+        yield trace
+    finally:
+        _request_ctx.reset(tok)
+
+
+def born_request(trace: Optional[dict] = None) -> dict:
+    """Resolve the request context for a serving entry point: the dict
+    passed explicitly (daemon → fleet), else the one bound by an enclosing
+    ``request_span``, else a fresh birth."""
+    if trace is not None:
+        return trace
+    cur = _request_ctx.get()
+    if cur is not None:
+        return cur
+    return {"id": new_trace_id(), "t_send": request_clock()}
+
+
+def finish_request(trace: dict, *, tenant: str = "", session: str = "",
+                   **payload) -> dict:
+    """Turn a stamped request trace into the ``request`` event payload.
+
+    Stages are the adjacent deltas of whatever boundary stamps the trace
+    accumulated, in pipeline order — absent seams simply contribute no
+    stage, so a lone ``session.update`` waterfall has three stages while a
+    daemon round-trip has six.  By construction
+    ``sum(stages.values()) == e2e`` to float precision.
+    """
+    order = ("t_send", "t_admit", "t_batch", "t_tick0", "t_launch",
+             "t_read", "t_ack")
+    # Stage name keyed by the boundary that ENDS it; t_tick0 is "the tick
+    # picked this request up", so the stage before it is queue_wait unless
+    # the daemon stamped batch extraction (then it splits into queue_wait
+    # + batch_form).
+    stage_of = {"t_admit": "client_send", "t_batch": "queue_wait",
+                "t_tick0": "queue_wait", "t_launch": "dispatch",
+                "t_read": "d2h", "t_ack": "ack"}
+    present = [k for k in order if k in trace]
+    stages = {}
+    for a, b in zip(present, present[1:]):
+        name = ("batch_form" if (b == "t_tick0" and a == "t_batch")
+                else stage_of[b])
+        stages[name] = float(trace[b]) - float(trace[a])
+    ev = {"trace_id": trace.get("id", ""), "stages": stages,
+          "e2e": (float(trace[present[-1]]) - float(trace[present[0]])
+                  if len(present) > 1 else 0.0)}
+    if tenant:
+        ev["tenant"] = str(tenant)
+    if session:
+        ev["session"] = str(session)
+    if trace.get("replay"):
+        ev["replay"] = True
+    ev.update(payload)
+    return ev

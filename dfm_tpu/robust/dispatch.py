@@ -86,7 +86,8 @@ def guarded_dispatch(call: Callable[[int], object], policy,
                      label: str = "dispatch", tenant: str = "",
                      tenants: Sequence[str] = (), session: str = "",
                      chunk: int = -1, iteration: int = 0, last_good=None,
-                     lls: Sequence[float] = (), p_iters: int = 0):
+                     lls: Sequence[float] = (), p_iters: int = 0,
+                     trace_id: str = "", trace_ids: Sequence[str] = ()):
     """Run ``call(attempt)`` under ``policy``'s retry/backoff/watchdog.
 
     ``call`` receives the 0-based attempt number (so dispatch spans can
@@ -97,6 +98,12 @@ def guarded_dispatch(call: Callable[[int], object], policy,
     whose payload carries ``last_good`` (called first if callable — the
     site's cheapest route to host params), ``lls`` and ``p_iters`` so
     ``on_failure="cpu"`` degradation can resume from the last good state.
+
+    ``trace_id``/``trace_ids`` attach the in-flight request trace(s) to
+    every retry/abort record (``trace_ids`` aligned positionally with
+    ``tenants``), so ``obs.report`` can tie a guard intervention back to
+    the specific requests it delayed.  Empty ids ride nowhere — the
+    untraced payload stays byte-identical.
 
     ``tenants`` (fleet buckets): ONE dispatch serves many tenants, so a
     dispatch failure is every bucket member's failure — each retry/abort
@@ -123,15 +130,19 @@ def guarded_dispatch(call: Callable[[int], object], policy,
                 raise
             h.n_dispatch_retries += 1
             last = attempt >= policy.dispatch_retries
+            tids = list(trace_ids) + [""] * max(
+                0, len(tenants) - len(trace_ids))
             ev = HealthEvent(
                 chunk=chunk, iteration=iteration, kind="dispatch_error",
                 detail=f"{type(e).__name__}: {e}"[:200],
                 action="abort" if last else "retried",
                 tenant=tenants[0] if tenants else tenant, session=session,
-                backoff_s=0.0 if last else float(delay))
+                backoff_s=0.0 if last else float(delay),
+                trace_id=tids[0] if tenants else trace_id)
             h.record(ev)
-            for t in tenants[1:]:
-                h.record(dataclasses.replace(ev, tenant=t), emit=False)
+            for t, tid in zip(tenants[1:], tids[1:]):
+                h.record(dataclasses.replace(ev, tenant=t, trace_id=tid),
+                         emit=False)
             if last:
                 scope = ""
                 if tenants:

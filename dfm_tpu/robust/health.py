@@ -49,6 +49,7 @@ class HealthEvent:
     tenant: str = ""    # fit_jobs tenant id (multi-tenant attribution)
     session: str = ""   # NowcastSession id (serving attribution)
     backoff_s: float = 0.0  # sleep charged to this event before the retry
+    trace_id: str = ""  # request trace this pathology struck (obs.trace)
 
     def __str__(self) -> str:
         eng = f" {self.engine}" if self.engine else ""
@@ -112,6 +113,8 @@ class FitHealth:
                 extra["session"] = event.session
             if event.backoff_s:
                 extra["backoff_s"] = event.backoff_s
+            if event.trace_id:
+                extra["trace_id"] = event.trace_id
             if tr is not None:
                 tr.emit("health", t=event.t, event=event.kind,
                         chunk=event.chunk, iteration=event.iteration,

@@ -35,7 +35,7 @@ from ..estim.batched import (_smooth_impl, make_hetero, pad_panel_to_n,
                              stack_params, unstack_params)
 from ..estim.em import EMConfig
 from ..obs.cost import CostModel, em_iter_work, fit_cost_model
-from ..obs.trace import current_tracer, shape_key
+from ..obs.trace import current_request, current_tracer, shape_key
 from ..ops.precision import default_compute_dtype
 from ..utils.data import standardize, validate_panel
 from .buckets import BucketPlan, plan_buckets
@@ -155,6 +155,9 @@ def _requeue_quarantined(job: Job, tenant: str, bucket: int, reason: str,
                queue_wait_s=float(queue_wait), compute_s=float(wall),
                pad_waste_frac=0.0, n_iters=int(len(f.logliks)),
                converged=bool(f.converged), quarantined=True)
+    _req = current_request()
+    if _req is not None:     # fit_jobs inside a request_span: join spans
+        tev["trace_id"] = _req.get("id", "")
     if tr is not None:
         tr.emit("tenant", **tev)
     else:
@@ -358,6 +361,9 @@ def submit(jobs: Sequence[Job], *, backend: str = "tpu",
                            pad_waste_frac=float(waste),
                            n_iters=int(len(lls)),
                            converged=bool(conv[slot]))
+                _req = current_request()
+                if _req is not None:   # fit_jobs inside a request_span
+                    tev["trace_id"] = _req.get("id", "")
                 if tr is not None:
                     tr.emit("tenant", **tev)
                 else:

@@ -73,7 +73,8 @@ from ..estim.batched import pad_panel_to_t
 from ..estim.em import EMConfig, noise_floor_for
 from ..estim.fused import (FusedOptions, _CONVERGED, _DIVERGED,
                            _di_forecast_core_masked, _em_while_core)
-from ..obs.trace import current_tracer, shape_key
+from ..obs.trace import (current_request, current_tracer, finish_request,
+                         new_trace_id, request_clock, shape_key)
 from ..ops.precision import accum_dtype
 from ..robust.dispatch import guarded_dispatch
 from ..robust.health import FitHealth, HealthEvent
@@ -422,7 +423,7 @@ class NowcastSession:
             raise RuntimeError("session is closed")
 
     # -- the query path ------------------------------------------------
-    def update(self, new_rows=None, mask=None) -> SessionUpdate:
+    def update(self, new_rows=None, mask=None, trace=None) -> SessionUpdate:
         """Append ``new_rows`` ((n, N) or (N,), original units; NaN =
         missing, ``mask`` optional {0,1}) and re-estimate: m warm EM
         iterations + smooth + nowcast/forecast in ONE program dispatch.
@@ -432,10 +433,23 @@ class NowcastSession:
         resident panel), same executable — refresh the nowcast after a
         budget change or on a schedule without feeding data.
 
+        ``trace`` is an optional request span context (``obs.trace``):
+        an explicit dict (or one bound by an enclosing ``request_span``,
+        or — when a tracer is active — a fresh birth) is stamped at
+        every boundary and emitted as a ``request`` waterfall event.
+        Untraced calls with no context take zero clock reads and stay
+        byte-identical.
+
         All capacity/shape validation happens on host BEFORE any device
         work — an oversized update raises without touching the session.
         """
         self._check_open()
+        if trace is None:
+            trace = current_request()
+            if trace is None and current_tracer() is not None:
+                trace = {"id": new_trace_id(), "t_send": request_clock()}
+        if trace is not None:
+            trace.setdefault("t_admit", request_clock())
         if new_rows is None:
             if mask is not None:
                 raise ValueError(
@@ -516,6 +530,15 @@ class NowcastSession:
         donated = impl is _session_impl_donated
         pol = self._policy
         tr = current_tracer()
+
+        def _stamp(key):
+            # Span stamps land on EVERY attempt (last one wins): a retried
+            # dispatch's waterfall truthfully absorbs backoff into its
+            # dispatch stage.
+            if trace is not None:
+                trace[key] = request_clock()
+
+        _stamp("t_tick0")
         t0 = time.perf_counter()
 
         def _once(attempt):
@@ -528,7 +551,10 @@ class NowcastSession:
                     consts[1], consts[2], self._p, consts[3], consts[4])
             if tr is None:
                 out = impl(*args, **kw)
-                return out, self._read(out, donated and pol is not None)
+                _stamp("t_launch")
+                host = self._read(out, donated and pol is not None)
+                _stamp("t_read")
+                return out, host
             if attempt == 0:
                 tr.maybe_cost("serve_update", self._key, impl, *args, **kw)
             extra = {"attempt": attempt} if pol is not None else {}
@@ -536,7 +562,9 @@ class NowcastSession:
                              fused=True, n_iters=self._max_iters,
                              **extra) as rec:
                 out = impl(*args, **kw)
+                _stamp("t_launch")
                 host = self._read(out, donated and pol is not None)
+                _stamp("t_read")
                 if rec is not None:
                     rec["n_iters"] = host["n_iters"]
             return out, host
@@ -548,6 +576,8 @@ class NowcastSession:
                 out, host = guarded_dispatch(
                     _once, pol, self.health, label="session update",
                     session=self._sid, iteration=self._t,
+                    trace_id=(trace.get("id", "") if trace is not None
+                              else ""),
                     last_good=lambda: self._p_host)
         wall = time.perf_counter() - t0
         # Rebind device state from the program's outputs (the donated
@@ -620,7 +650,12 @@ class NowcastSession:
                    **({"ll_per_row": ll_per_row} if ll_per_row is not None
                       else {}),
                    **({"n_evicted": int(n_evict)} if n_evict else {}),
-                   **({"degraded": True} if degraded else {}))
+                   **({"degraded": True} if degraded else {}),
+                   **({"trace_id": trace.get("id", "")}
+                      if trace is not None else {}),
+                   **({"replay": True}
+                      if trace is not None and trace.get("replay")
+                      else {}))
         if tr is not None:
             tr.emit("query", **qev)
         else:
@@ -628,6 +663,16 @@ class NowcastSession:
             # the timestamps this method already took — same event dict,
             # zero extra dispatches/transfers/clock reads.
             live_observe({"t": t0 + wall, "kind": "query", **qev})
+        if trace is not None and trace.get("owner") != "daemon":
+            # Lone-session queries end their span here (daemon-owned
+            # spans finish at the daemon's ack instead).
+            trace["t_ack"] = request_clock()
+            rev = finish_request(trace, session=self._sid)
+            if tr is not None:
+                tr.emit("request", t=trace["t_ack"], **rev)
+            else:
+                live_observe({"t": trace["t_ack"], "kind": "request",
+                              **rev})
         inv = (self._std.inverse if self._std is not None
                else (lambda a: a))
         # Bands destandardize by the scale alone (the affine shift cancels

@@ -1,0 +1,391 @@
+"""Request-scoped tracing (obs/trace request spans — ISSUE 19).
+
+The operative contracts of the end-to-end latency waterfall:
+
+- TELESCOPING: ``finish_request`` names adjacent deltas of ONE monotonic
+  clock — the stages sum to the measured e2e at float fuzz, by
+  construction, for every subset of stamps (daemon path, lone-session
+  path, dedup short-circuit).
+- ZERO-OVERHEAD OFF: with no tracer and no explicit span, serving answers
+  are bit-identical to a traced twin — the span plumbing adds clock reads
+  only when someone is watching.
+- PROPAGATION: a trace born at submit reaches the request event with the
+  same trace_id at every seam — lone session, fleet bucket, daemon
+  handle(); the query event carries the same id so waterfall and device
+  telemetry join.
+- CROSS-PROCESS CONTINUITY: "trace" rides the daemon journal, so kill-9
+  replay and --takeover delta replay re-emit request events with the
+  ORIGINAL trace_ids stamped ``replay=true``; a duplicate request id is
+  answered with its own two-stage waterfall flagged ``dedup=true`` and
+  counted in ``status()["dedup_hits"]``.
+- TAIL EXEMPLARS: the e2e histogram keeps the worst exemplar-carrying
+  trace_id and ``render_prom`` attaches it to the 0.99 quantile in
+  OpenMetrics exemplar syntax — a p99 alert resolves to a request trace.
+"""
+
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from dfm_tpu import DynamicFactorModel, fit, open_fleet, open_session
+from dfm_tpu.api import TPUBackend
+from dfm_tpu.daemon import DaemonClient, DFMDaemon, make_listener
+from dfm_tpu.obs.metrics import Ledger, MetricsRegistry, record_event
+from dfm_tpu.obs.report import summarize, to_chrome
+from dfm_tpu.obs.trace import (Tracer, activate, current_request,
+                               finish_request, new_trace_id, request_clock,
+                               request_span, set_ambient)
+from dfm_tpu.utils import dgp
+
+BE = TPUBackend(filter="info")
+R = 2                                    # rows per query
+
+
+# ---------------------------------------------------------------------------
+# the waterfall itself (no jax)
+# ---------------------------------------------------------------------------
+
+def test_finish_request_full_waterfall_telescopes():
+    t0 = request_clock()
+    trace = {"id": "abc123", "t_send": t0, "t_admit": t0 + 0.001,
+             "t_batch": t0 + 0.003, "t_tick0": t0 + 0.004,
+             "t_launch": t0 + 0.010, "t_read": t0 + 0.050,
+             "t_ack": t0 + 0.051}
+    ev = finish_request(trace, tenant="t7", session="f1")
+    assert ev["trace_id"] == "abc123"
+    assert ev["tenant"] == "t7" and ev["session"] == "f1"
+    assert set(ev["stages"]) == {"client_send", "queue_wait", "batch_form",
+                                 "dispatch", "d2h", "ack"}
+    # Adjacent deltas of one clock telescope: residual is float fuzz,
+    # nowhere near the 1 ms acceptance budget.
+    residual = abs(sum(ev["stages"].values()) - ev["e2e"])
+    assert residual < 1e-9
+    assert ev["e2e"] == pytest.approx(0.051)
+    assert ev["stages"]["d2h"] == pytest.approx(0.040)
+    assert "replay" not in ev and "dedup" not in ev
+
+
+def test_finish_request_partial_stamps_and_flags():
+    # Lone-session path: no daemon, no batch former — queue_wait ends at
+    # t_tick0 and there is no batch_form stage.
+    t0 = 100.0
+    sess = {"id": "x", "t_send": t0, "t_admit": t0 + 1, "t_tick0": t0 + 2,
+            "t_launch": t0 + 3, "t_read": t0 + 4, "t_ack": t0 + 5}
+    ev = finish_request(sess)
+    assert set(ev["stages"]) == {"client_send", "queue_wait", "dispatch",
+                                 "d2h", "ack"}
+    assert sum(ev["stages"].values()) == pytest.approx(ev["e2e"])
+    # Dedup short-circuit: two stamps, one stage, flags carried.
+    dup = {"id": "y", "t_send": t0, "t_admit": t0 + 0.5,
+           "t_ack": t0 + 0.6, "replay": True}
+    ev2 = finish_request(dup, dedup=True)
+    assert ev2["dedup"] is True and ev2["replay"] is True
+    assert ev2["e2e"] == pytest.approx(0.6)
+    assert sum(ev2["stages"].values()) == pytest.approx(ev2["e2e"])
+
+
+def test_trace_ids_and_request_span_context():
+    ids = {new_trace_id() for _ in range(64)}
+    assert len(ids) == 64 and all(len(i) == 16 for i in ids)
+    assert current_request() is None
+    with request_span() as tr:
+        assert current_request() is tr
+        assert tr["id"] and "t_send" in tr
+        with request_span({"id": "outer9", "t_send": 1.0}) as tr2:
+            assert current_request() is tr2 and tr2["id"] == "outer9"
+        assert current_request() is tr
+    assert current_request() is None
+
+
+def test_request_metrics_counters_and_prom_exemplar():
+    reg, led = MetricsRegistry(), Ledger()
+    for i, (e2e, tid) in enumerate([(0.010, "fast01"), (0.500, "slow99")]):
+        record_event(reg, led, {
+            "kind": "request", "t": float(i), "trace_id": tid,
+            "tenant": "t0", "e2e": e2e,
+            "stages": {"queue_wait": e2e / 2, "dispatch": e2e / 2},
+            **({"replay": True} if i == 0 else {})})
+    record_event(reg, led, {"kind": "request", "t": 2.0, "trace_id": "d",
+                            "tenant": "t0", "e2e": 0.001,
+                            "stages": {"ack": 0.001}, "dedup": True})
+    assert reg.counter("requests_total", tenant="t0").value == 3
+    assert reg.counter("replayed_requests_total", tenant="t0").value == 1
+    assert reg.counter("dedup_hits_total", tenant="t0").value == 1
+    # The worst exemplar-carrying observation wins the exemplar slot and
+    # rides the 0.99 quantile line in OpenMetrics syntax.
+    h = reg.histogram("request_e2e_ms", tenant="t0")
+    assert h.exemplar is not None and h.exemplar[1] == "slow99"
+    prom = reg.render_prom()
+    line = [ln for ln in prom.splitlines()
+            if ln.startswith("dfm_request_e2e_ms{")
+            and 'quantile="0.99"' in ln]
+    assert len(line) == 1 and '# {trace_id="slow99"} 500' in line[0]
+    assert reg.histogram("request_stage_ms", stage="dispatch").count == 2
+
+
+# ---------------------------------------------------------------------------
+# report: the requests section + chrome flow events (no jax)
+# ---------------------------------------------------------------------------
+
+def _req_event(t, tid, tenant, stages, **extra):
+    return {"t": t, "kind": "request", "trace_id": tid, "tenant": tenant,
+            "stages": stages, "e2e": sum(stages.values()), **extra}
+
+
+def test_report_requests_section(tmp_path):
+    tr = str(tmp_path / "trace.jsonl")
+    evs = [
+        _req_event(1.0, "aa", "t0", {"queue_wait": 0.002, "dispatch": 0.08,
+                                     "d2h": 0.01, "ack": 0.001}),
+        _req_event(2.0, "bb", "t0", {"queue_wait": 0.5, "dispatch": 0.09,
+                                     "d2h": 0.01, "ack": 0.001}),
+        _req_event(3.0, "cc", "t1", {"queue_wait": 0.001, "dispatch": 0.07,
+                                     "d2h": 0.01, "ack": 0.001},
+                   replay=True),
+        _req_event(4.0, "dd", "t1", {"ack": 0.001}, dedup=True),
+    ]
+    with open(tr, "w") as f:
+        for e in evs:
+            f.write(json.dumps(e) + "\n")
+    rq = summarize(tr)["requests"]
+    assert rq["n_requests"] == 4
+    assert rq["replayed"] == 1 and rq["dedup"] == 1
+    assert rq["waterfall_residual_max_s"] < 1e-9
+    # Attribution: queue_wait dominates total stage time (the 0.5 s
+    # outlier), and the tail exemplar names that request.
+    shares = {s: d["share"] for s, d in rq["per_stage"].items()}
+    assert max(shares, key=shares.get) == "queue_wait"
+    assert abs(sum(shares.values()) - 1.0) < 1e-9
+    assert rq["tail_exemplars"][0]["trace_id"] == "bb"
+    assert set(rq["per_tenant"]) == {"t0", "t1"}
+    assert rq["per_tenant"]["t0"]["n"] == 2
+    # Empty traces keep the section with stable keys (dashboards).
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    rq0 = summarize(empty)["requests"]
+    assert rq0["n_requests"] == 0 and rq0["tail_exemplars"] == []
+
+
+def test_chrome_export_request_flows(tmp_path):
+    evs = [
+        {"t": 1.0, "kind": "query", "session": "s1", "tenant": "t0",
+         "wall": 0.05, "trace_id": "aa"},
+        _req_event(1.06, "aa", "t0", {"queue_wait": 0.01, "dispatch": 0.04,
+                                      "d2h": 0.005, "ack": 0.005}),
+    ]
+    trace = to_chrome(evs)
+    tevs = trace["traceEvents"]
+    flows = [e for e in tevs if e.get("ph") in ("s", "t", "f")]
+    # One flow per trace_id: start at the request span, a step at the
+    # query instant, a finish at the ack — all sharing one flow id.
+    assert {e["ph"] for e in flows} == {"s", "t", "f"}
+    assert len({e["id"] for e in flows}) == 1
+    spans = [e for e in tevs if e.get("ph") == "X"
+             and "request" in str(e.get("name", ""))]
+    names = {e["name"] for e in tevs if e.get("ph") == "X"}
+    assert any("aa" in str(e.get("name")) for e in spans)
+    assert {"queue_wait", "dispatch", "d2h", "ack"} <= names
+
+
+# ---------------------------------------------------------------------------
+# serving seams: session / fleet (tiny panels, fake mesh CPU)
+# ---------------------------------------------------------------------------
+
+def _tenant(N, T, k, seed, extra=40 * R):
+    rng = np.random.default_rng(seed)
+    p_true = dgp.dfm_params(N, k, rng)
+    Y, _ = dgp.simulate(p_true, T + extra, rng)
+    res = fit(DynamicFactorModel(n_factors=k), Y[:T], max_iters=6,
+              backend=BE, telemetry=False)
+    return res, Y[:T], Y[T:]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return _tenant(6, 26, 2, 411)
+
+
+def test_session_waterfall_and_untraced_bit_identity(tiny):
+    res, Y0, stream = tiny
+    # Traced twin: every update answers with a request event whose
+    # stages telescope to the measured e2e.
+    tr = Tracer()
+    with activate(tr):
+        s1 = open_session(res, Y0, max_update_rows=R, max_iters=3, tol=0.0,
+                          capacity=Y0.shape[0] + 6 * R)
+        u1 = [s1.update(stream[i * R:(i + 1) * R]) for i in range(3)]
+        s1.close()
+    reqs = [e for e in tr.events if e["kind"] == "request"]
+    quer = [e for e in tr.events if e["kind"] == "query"]
+    assert len(reqs) == 3 and len(quer) == 3
+    for rev, qev in zip(reqs, quer):
+        assert abs(sum(rev["stages"].values()) - rev["e2e"]) < 1e-3
+        assert rev["trace_id"] == qev["trace_id"] != ""
+        assert {"dispatch", "d2h", "ack"} <= set(rev["stages"])
+    # Untraced twin: no tracer, no explicit span -> zero request events
+    # and bit-identical answers (the off path takes no clock reads that
+    # could perturb anything numeric).
+    with activate(None):
+        s2 = open_session(res, Y0, max_update_rows=R, max_iters=3, tol=0.0,
+                          capacity=Y0.shape[0] + 6 * R)
+        u2 = [s2.update(stream[i * R:(i + 1) * R]) for i in range(3)]
+        s2.close()
+    for a, b in zip(u1, u2):
+        np.testing.assert_array_equal(a.nowcast, b.nowcast)
+        np.testing.assert_array_equal(a.forecasts["y"], b.forecasts["y"])
+
+
+def test_session_explicit_span_without_tracer(tiny):
+    # An explicit request_span makes an untraced session still finish the
+    # span (to the live plane), and the caller sees the stamps.
+    res, Y0, stream = tiny
+    with activate(None):
+        s = open_session(res, Y0, max_update_rows=R, max_iters=2, tol=0.0,
+                         capacity=Y0.shape[0] + 2 * R)
+        with request_span() as span:
+            s.update(stream[:R])
+        s.close()
+    assert "t_ack" in span and span["t_ack"] >= span["t_send"]
+
+
+def test_fleet_request_propagation_and_replay_flag(tiny):
+    res, Y0, stream = tiny
+    fl = open_fleet([res], [Y0], tenants=["t0"], max_update_rows=R,
+                    max_iters=3, tol=0.0,
+                    capacity=[Y0.shape[0] + 8 * R], backend=BE)
+    tr = Tracer()
+    with activate(tr):
+        # Explicit span (the daemon replay path): original id + replay
+        # flag must survive into the request event.
+        fl.submit("t0", stream[:R],
+                  trace={"id": "replayed01", "t_send": request_clock(),
+                         "replay": True})
+        fl.drain()
+        # Ambient-tracer birth: no explicit span, id minted at submit.
+        fl.submit("t0", stream[R:2 * R])
+        fl.drain()
+    fl.close()
+    reqs = [e for e in tr.events if e["kind"] == "request"]
+    assert len(reqs) == 2
+    assert reqs[0]["trace_id"] == "replayed01"
+    assert reqs[0].get("replay") is True
+    assert reqs[1]["trace_id"] and "replay" not in reqs[1]
+    for rev in reqs:
+        assert abs(sum(rev["stages"].values()) - rev["e2e"]) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# daemon: continuity across dedup, kill-9 replay, and takeover
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dwork(tmp_path_factory, tiny):
+    work = tmp_path_factory.mktemp("reqtrace")
+    res, Y0, _ = tiny
+    boot = open_fleet([res], [Y0], tenants=["t0"], max_update_rows=R,
+                      max_iters=3, tol=0.0,
+                      capacity=[Y0.shape[0] + 30 * R], backend=BE)
+    snap = str(work / "snap")
+    boot.snapshot_all(snap)
+    boot.close()
+    return work, snap
+
+
+def _submit(daemon, rows, rid, tid):
+    return daemon.handle({"op": "submit", "tenant": "t0",
+                          "rows": None if rows is None else rows.tolist(),
+                          "id": rid,
+                          "trace": {"id": tid, "t_send": request_clock()}})
+
+
+def test_daemon_dedup_waterfall_and_kill9_replay_continuity(dwork, tiny):
+    work, snap = dwork
+    _, _, stream = tiny
+    journal = str(work / "j1.jsonl")
+    tr = Tracer()
+    d1 = DFMDaemon.recover(snap, journal, backend=BE)
+    with activate(tr):
+        try:
+            sent = []
+            for q in range(2):
+                tid = new_trace_id()
+                r = _submit(d1, stream[q * R:(q + 1) * R], f"rq{q}", tid)
+                assert r.get("ok"), r
+                # The ack carries the span id end-to-end.
+                assert r["trace_id"] == tid
+                sent.append(tid)
+            # Duplicate id: answered from cache with a two-stage dedup
+            # waterfall under a FRESH span, counted in status().
+            dup_tid = new_trace_id()
+            dup = _submit(d1, stream[:R], "rq0", dup_tid)
+            assert dup.get("duplicate") is True
+            assert dup["trace_id"] == dup_tid
+            assert d1.status()["dedup_hits"] == 1
+        finally:
+            d1._journal.close()      # crash-sim: abandon, no fleet close
+    reqs = {e["trace_id"]: e for e in tr.events if e["kind"] == "request"}
+    assert set(reqs) == set(sent) | {dup_tid}
+    assert reqs[dup_tid].get("dedup") is True
+    assert not any(e.get("replay") for e in reqs.values())
+    for rev in reqs.values():
+        assert abs(sum(rev["stages"].values()) - rev["e2e"]) < 1e-3
+    # Kill-9 recovery: journal replay re-serves both submits under their
+    # ORIGINAL trace_ids, stamped replay=true — the waterfall stream is
+    # continuous across the process boundary.
+    tr2 = Tracer()
+    with activate(tr2):
+        d2 = DFMDaemon.recover(snap, journal, backend=BE)
+        d2.close()
+    replayed = [e for e in tr2.events if e["kind"] == "request"]
+    assert [e["trace_id"] for e in replayed] == sent
+    assert all(e.get("replay") is True for e in replayed)
+    st2 = summarize(list(tr2.events))
+    assert st2["requests"]["replayed"] == 2
+
+
+def test_takeover_trace_continuity(dwork, tiny):
+    work, snap = dwork
+    _, _, stream = tiny
+    journal = str(work / "j2.jsonl")
+    addr = str(work / "d.sock")
+    pred = DFMDaemon.recover(snap, journal, backend=BE)
+    listener = make_listener(addr)
+    th = threading.Thread(target=pred.serve_forever, args=(listener,),
+                          daemon=True)
+    th.start()
+    cli = DaemonClient(addr, timeout=120.0)
+    # Client-side birth: DaemonClient.submit mints the span; the id comes
+    # back on the ack after crossing the socket + queue + fleet tick.
+    r1 = cli.submit("t0", stream[:R], req_id="to-0", wait=True)
+    assert r1.get("ok") and len(r1.get("trace_id", "")) == 16
+    # Blue/green: the successor's journal delta replay re-emits the
+    # served request under its original trace_id, replay-stamped.
+    tr = Tracer()
+    prev = set_ambient(tr)       # takeover warms on this thread; the
+    try:                         # successor pump inherits the ambient
+        succ, lst2, _gap = DFMDaemon.takeover(addr, snap, journal,
+                                              backend=BE)
+        th.join(timeout=60)
+        th2 = threading.Thread(target=succ.serve_forever, args=(lst2,),
+                               daemon=True)
+        th2.start()
+        r2 = cli.submit("t0", stream[R:2 * R], req_id="to-1", wait=True)
+        assert r2.get("ok") and len(r2.get("trace_id", "")) == 16
+        cli.shutdown()
+        th2.join(timeout=60)
+        succ.close()
+        pred._journal.close()
+    finally:
+        set_ambient(prev)
+    reqs = [e for e in tr.events if e["kind"] == "request"]
+    tids = [e["trace_id"] for e in reqs]
+    assert r1["trace_id"] in tids       # replayed under the original id
+    assert r2["trace_id"] in tids       # served live by the successor
+    rep = next(e for e in reqs if e["trace_id"] == r1["trace_id"])
+    assert rep.get("replay") is True
+    live = next(e for e in reqs if e["trace_id"] == r2["trace_id"])
+    assert "replay" not in live
+    assert abs(sum(live["stages"].values()) - live["e2e"]) < 1e-3

@@ -1,0 +1,91 @@
+"""AST audit: every emitted event kind is registered in EVENT_KINDS.
+
+``summarize()``, ``LivePlane.record_event`` and ``to_chrome`` all route on
+the ``kind`` string of an event — a typo'd kind is an event NOTHING will
+ever aggregate, and it fails silently (the tracer happily records it, the
+report happily ignores it).  This test closes the schema: it walks every
+module under ``dfm_tpu/`` with ``ast`` and collects every event-kind
+literal from the two emission idioms in the codebase:
+
+  * ``tracer.emit("<kind>", ...)`` — first positional string constant of
+    any ``*.emit(...)`` call;
+  * ``live_observe({"t": ..., "kind": "<kind>", ...})`` — dict literals
+    with a constant ``"kind"`` key (the untraced live-plane mirror).  The
+    ``"t"`` key is required alongside: RunRecord dicts in ``obs/regress``
+    also carry a ``"kind"`` field (``"trace"``/``"profile"`` — run kinds,
+    not event kinds) but never a ``"t"`` timestamp.
+
+Both directions are asserted: no module emits a kind missing from
+``EVENT_KINDS`` (unroutable event), and no ``EVENT_KINDS`` entry is dead
+(registered but never emitted anywhere — a schema entry that rotted).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+import dfm_tpu
+from dfm_tpu.obs.trace import EVENT_KINDS
+
+PKG_ROOT = pathlib.Path(dfm_tpu.__file__).parent
+
+
+def _is_str_const(node) -> bool:
+    return isinstance(node, ast.Constant) and isinstance(node.value, str)
+
+
+def _emitted_kinds():
+    """(kind, location) pairs for every event-kind literal in the package."""
+    out = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        rel = str(path.relative_to(PKG_ROOT.parent))
+        tree = ast.parse(path.read_text(), filename=rel)
+        for node in ast.walk(tree):
+            # tracer.emit("<kind>", ...) — also catches self.emit / tr.emit.
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit"
+                    and node.args and _is_str_const(node.args[0])):
+                out.append((node.args[0].value, f"{rel}:{node.lineno}"))
+            # {"t": ..., "kind": "<kind>", ...} — live-plane event payloads.
+            # Requiring the "t" key alongside excludes RunRecord dicts
+            # (obs/regress uses "kind" for run kinds, never with "t").
+            elif isinstance(node, ast.Dict):
+                keys = [k.value for k in node.keys if _is_str_const(k)]
+                if "kind" not in keys or "t" not in keys:
+                    continue
+                for k, v in zip(node.keys, node.values):
+                    if _is_str_const(k) and k.value == "kind":
+                        if _is_str_const(v):
+                            out.append((v.value, f"{rel}:{node.lineno}"))
+    return out
+
+
+def test_every_emitted_kind_is_registered():
+    """No emission site uses a kind outside the closed EVENT_KINDS schema."""
+    unregistered = [(k, loc) for k, loc in _emitted_kinds()
+                    if k not in EVENT_KINDS]
+    assert not unregistered, (
+        "event kinds emitted but missing from obs.trace.EVENT_KINDS "
+        "(the report/live plane will silently drop them): "
+        f"{unregistered}")
+
+
+def test_no_dead_registry_entries():
+    """Every registered kind is emitted somewhere — no rotted entries."""
+    seen = {k for k, _ in _emitted_kinds()}
+    dead = EVENT_KINDS - seen
+    assert not dead, (
+        f"EVENT_KINDS entries never emitted anywhere in dfm_tpu/: {dead}")
+
+
+def test_registry_is_frozen_inventory():
+    """The schema itself — additions must be deliberate (update this test,
+    obs/metrics.record_event, and obs/report together)."""
+    assert EVENT_KINDS == frozenset({
+        "fit", "dispatch", "transfer", "chunk", "freeze", "health", "cost",
+        "span", "query", "tick", "tenant", "page", "daemon", "maintenance",
+        "compile_cache", "advice", "panel_reupload", "fused_fallback",
+        "request",
+    })

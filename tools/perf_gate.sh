@@ -251,5 +251,20 @@ for k in ("drift_detection_lag_updates", "drift_false_positive_rate",
 assert store._backfill_kind("BENCH_drift.json") == "bench_drift", \
     "perf_gate: store backfill no longer imports BENCH_drift.json"'
 
+# The request-tracing tax (bench.serve + bench.daemon /
+# tools/trace_smoke.sh) must stay registered: trace_overhead_pct is the
+# best-of-N traced-vs-untraced warm-wall delta in percent, gated
+# lower-is-better with its own 5-point noise floor (tiny smoke walls
+# jitter a few percent run-to-run; a real span-plumbing regression is
+# tens of points).
+python -c '
+from dfm_tpu.obs import store
+assert "trace_overhead_pct" in store._BENCH_NUMERIC_KEYS, \
+    "perf_gate: obs.store not recording trace_overhead_pct"
+assert store.lower_is_better("trace_overhead_pct"), \
+    "perf_gate: trace_overhead_pct lost its lower-is-better marker"
+assert store.noise_floor("trace_overhead_pct") >= 5.0, \
+    "perf_gate: trace_overhead_pct lost its percent noise floor"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"

@@ -29,24 +29,35 @@ else
     checks+=(tools/perf_gate.sh)
 fi
 
-pass=() fail=()
+pass=() fail=() names=() stats=() walls=()
+total=0
 for check in "${checks[@]}"; do
     name=$(basename "$check" .sh)
     log="$LOG_DIR/$name.log"
     printf '=== %-18s ' "$name"
     t0=$SECONDS
     if bash "$check" >"$log" 2>&1; then
-        printf 'PASS  (%3ds)\n' "$((SECONDS - t0))"
-        pass+=("$name")
+        dt=$((SECONDS - t0))
+        printf 'PASS  (%3ds)\n' "$dt"
+        pass+=("$name"); stats+=("PASS")
     else
-        printf 'FAIL  (%3ds)  log: %s\n' "$((SECONDS - t0))" "$log"
-        fail+=("$name")
+        dt=$((SECONDS - t0))
+        printf 'FAIL  (%3ds)  log: %s\n' "$dt" "$log"
+        fail+=("$name"); stats+=("FAIL")
     fi
+    names+=("$name"); walls+=("$dt"); total=$((total + dt))
 done
 
+# Per-smoke wall-clock recap: the slow checks are where smoke time goes,
+# and the table survives in scrollback after the inline lines are gone.
 echo
-echo "--- smoke summary: ${#pass[@]} passed, ${#fail[@]} failed ---"
+printf -- '--- smoke summary: %d passed, %d failed (total %ds) ---\n' \
+    "${#pass[@]}" "${#fail[@]}" "$total"
+for i in "${!names[@]}"; do
+    printf '  %-18s %s  %4ds\n' "${names[$i]}" "${stats[$i]}" "${walls[$i]}"
+done
 if [ "${#fail[@]}" -gt 0 ]; then
+    echo "failing: ${fail[*]}"
     for name in "${fail[@]}"; do
         echo "FAILED: $name  ($LOG_DIR/$name.log; last lines below)"
         tail -5 "$LOG_DIR/$name.log" | sed 's/^/    /'

@@ -1,0 +1,216 @@
+#!/usr/bin/env bash
+# One-command request-tracing smoke (ISSUE 19).  Leg 1 serves socket
+# queries through a traced daemon and asserts EVERY answered request has
+# a latency waterfall whose stages sum to the measured e2e within 1 ms,
+# then checks `obs.report` prints the per-stage p99 attribution table.
+# Leg 2 forces an SLO breach and follows the tail: the p99 line of
+# `render_prom()` must carry an OpenMetrics exemplar trace_id, the
+# breach must flight-dump, and the dump must resolve that trace_id back
+# to a full request event.  Leg 3 runs traced-vs-untraced twin sessions:
+# answers bit-identical, span-plumbing overhead (best-of-N warm walls)
+# under the gate.  The quick way to answer "can I follow one slow
+# request through the whole stack" without the real chip.
+#
+# Usage (from the repo root):
+#   tools/trace_smoke.sh [workdir]           # default: a fresh mktemp -d
+#
+# JAX_PLATFORMS defaults to cpu so this never burns real-device time.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK="${1:-$(mktemp -d /tmp/dfm_trace_smoke.XXXXXX)}"
+export DFM_SMOKE_WORK="$WORK"
+mkdir -p "$WORK"
+
+set +e
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" JAX_ENABLE_X64=1 \
+DFM_RUNS= DFM_FLIGHT_DIR="$WORK/flight" DFM_FLIGHT_MIN_INTERVAL_S=0 \
+python - <<'PY'
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+
+from dfm_tpu import DynamicFactorModel, fit, open_fleet, open_session
+from dfm_tpu.daemon import DaemonClient, DFMDaemon, make_listener
+from dfm_tpu.obs.live import plane, reset_plane, set_slo
+from dfm_tpu.obs.report import summarize
+from dfm_tpu.obs.slo import SLOConfig
+from dfm_tpu.obs.trace import Tracer, activate, set_ambient
+from dfm_tpu.utils import dgp
+
+WORK = os.environ["DFM_SMOKE_WORK"]
+SNAP = os.path.join(WORK, "snap")
+JOURNAL = os.path.join(WORK, "journal.jsonl")
+ADDR = os.path.join(WORK, "daemon.sock")
+TRACE = os.path.join(WORK, "trace.jsonl")
+R = 2                                    # rows per query
+
+# -- bootstrap: one tiny tenant, fitted + snapshotted -------------------
+rng = np.random.default_rng(190)
+p_true = dgp.dfm_params(8, 2, rng)
+Y, _ = dgp.simulate(p_true, 36 + 60 * R, rng)
+res = fit(DynamicFactorModel(n_factors=2), Y[:36], max_iters=6,
+          telemetry=False)
+Y0, stream = Y[:36], Y[36:]
+boot = open_fleet([res], [Y0], tenants=["t0"],
+                  capacity=[36 + 62 * R], max_update_rows=R,
+                  max_iters=4, tol=0.0)
+boot.snapshot_all(SNAP)
+boot.close()
+cursor = [0]
+
+
+def next_rows():
+    rows = stream[cursor[0]:cursor[0] + R]
+    cursor[0] += R
+    return rows
+
+# -- leg 1: traced daemon -> every answered request has a waterfall -----
+tracer = Tracer(TRACE)
+prev_amb = set_ambient(tracer)           # the daemon pump is another
+daemon = DFMDaemon.recover(SNAP, JOURNAL)  # thread: ambient, not a ctx
+listener = make_listener(ADDR)
+th = threading.Thread(target=daemon.serve_forever, args=(listener,),
+                      daemon=True)
+th.start()
+cli = DaemonClient(ADDR, timeout=300.0)
+acked = {}
+for q in range(6):
+    t0 = time.perf_counter()
+    resp = cli.submit("t0", next_rows(), req_id=f"l1-{q}", wait=True)
+    wall = time.perf_counter() - t0
+    assert resp.get("ok"), resp
+    tid = resp.get("trace_id", "")
+    assert tid, f"answered request q{q} carries no trace_id: {resp}"
+    acked[tid] = wall
+# One duplicate: answered from cache with its own (dedup) waterfall.
+dup = cli.submit("t0", stream[:R], req_id="l1-0", wait=True)
+assert dup.get("duplicate") is True and dup.get("trace_id"), dup
+acked[dup["trace_id"]] = None
+assert daemon.status()["dedup_hits"] == 1
+
+reqs = {e["trace_id"]: e for e in tracer.events
+        if e.get("kind") == "request"}
+missing = set(acked) - set(reqs)
+assert not missing, f"answered requests with no waterfall: {missing}"
+worst = 0.0
+for tid, wall in acked.items():
+    ev = reqs[tid]
+    resid = abs(sum(ev["stages"].values()) - ev["e2e"])
+    worst = max(worst, resid)
+    assert resid <= 1e-3, (tid, resid, ev)
+    if wall is not None:                 # span e2e inside the client wall
+        assert ev["e2e"] <= wall + 1e-3, (tid, ev["e2e"], wall)
+assert reqs[dup["trace_id"]].get("dedup") is True
+print(f"leg1: {len(acked)} answered requests, every waterfall sums to "
+      f"e2e (worst residual {1e3 * worst:.4f} ms, budget 1 ms)",
+      flush=True)
+
+cli.shutdown()
+th.join(timeout=60)
+daemon.close()
+set_ambient(prev_amb)
+tracer.close()
+
+rq = summarize(TRACE)["requests"]
+assert rq["n_requests"] == len(acked) and rq["dedup"] == 1, rq
+assert rq["waterfall_residual_max_s"] <= 1e-3, rq
+for st in ("queue_wait", "dispatch", "d2h", "ack"):
+    assert st in rq["per_stage"], (st, sorted(rq["per_stage"]))
+out = subprocess.run(
+    [sys.executable, "-m", "dfm_tpu.obs.report", TRACE],
+    capture_output=True, text=True, check=True).stdout
+assert "requests:" in out and "dispatch" in out and "share" in out, out
+attn = [ln for ln in out.splitlines() if "stage" in ln and "p99" in ln]
+assert attn, f"no per-stage p99 attribution table in report:\n{out}"
+print("leg1 PASS: obs.report prints the per-stage p99 attribution "
+      "table", flush=True)
+
+# -- leg 2: forced SLO breach -> exemplar + flight dump -> trace --------
+reset_plane()
+set_slo(SLOConfig(p99_ms=1e-6, min_events=3, window=3600.0))
+sess = open_session(res, Y0, max_update_rows=R, max_iters=3, tol=0.0,
+                    capacity=Y0.shape[0] + 10 * R)
+tr2 = Tracer()
+with activate(tr2):
+    for q in range(5):                   # every query violates the SLO
+        sess.update(next_rows())
+sess.close()
+set_slo(None)
+assert plane().flight_dumps >= 1, "SLO breach never flight-dumped"
+prom = plane().registry.render_prom()
+ex_lines = [ln for ln in prom.splitlines()
+            if "dfm_request_e2e_ms{" in ln and 'quantile="0.99"' in ln
+            and "trace_id=" in ln]
+assert ex_lines, f"no OpenMetrics exemplar on the e2e p99:\n{prom}"
+ex_tid = ex_lines[0].split('trace_id="')[1].split('"')[0]
+dumps = sorted(os.path.join(WORK, "flight", f)
+               for f in os.listdir(os.path.join(WORK, "flight")))
+hit = None
+for path in dumps:
+    with open(path) as f:
+        for line in f:
+            ev = json.loads(line)
+            if ev.get("kind") == "request" and ev.get("trace_id") == ex_tid:
+                hit = ev
+assert hit is not None, (f"exemplar {ex_tid} not resolvable in flight "
+                         f"dumps {dumps}")
+assert abs(sum(hit["stages"].values()) - hit["e2e"]) <= 1e-3
+burn = [e for e in tr2.events if e.get("kind") == "health"
+        and e.get("event") == "slo_burn" and e.get("action") == "fired"]
+assert burn and burn[0].get("trace_id"), burn
+print(f"leg2 PASS: breach -> prom exemplar {ex_tid} -> flight dump "
+      f"resolves to the full waterfall", flush=True)
+
+# -- leg 3: traced vs untraced twins: bit-identical + overhead gate -----
+N_WARM, N_MEAS = 2, 6
+lo = cursor[0]
+
+
+def run(traced):
+    walls, upds = [], []
+    ctx = activate(Tracer() if traced else None)
+    with ctx:
+        s = open_session(res, Y0, max_update_rows=R, max_iters=3, tol=0.0,
+                         capacity=Y0.shape[0] + (lo + (N_WARM + N_MEAS + 1)
+                                                 * R))
+        for i in range(N_WARM + N_MEAS):
+            rows = stream[lo + i * R:lo + (i + 1) * R]
+            t0 = time.perf_counter()
+            u = s.update(rows)
+            if i >= N_WARM:
+                walls.append(time.perf_counter() - t0)
+            upds.append(u)
+        s.close()
+    return walls, upds
+
+
+tw, tu = run(traced=True)
+uw, uu = run(traced=False)
+for a, b in zip(tu, uu):
+    assert np.array_equal(a.nowcast, b.nowcast)
+    assert np.array_equal(a.forecasts["y"], b.forecasts["y"])
+overhead = 100.0 * (min(tw) - min(uw)) / min(uw)
+gate = float(os.environ.get("DFM_SMOKE_TRACE_OVERHEAD_MAX", "30"))
+assert overhead <= gate, (f"tracing overhead {overhead:+.1f}% over the "
+                          f"{gate:.0f}% smoke gate")
+print(f"leg3 PASS: traced == untraced bit-exact; overhead "
+      f"{overhead:+.1f}% (best-of-{N_MEAS}, gate {gate:.0f}%)",
+      flush=True)
+print("TRACE SMOKE PASS", flush=True)
+PY
+rc=$?
+set -e
+if [ "$rc" -ne 0 ]; then
+    echo "--- trace smoke workdir kept: $WORK ---" >&2
+    exit "$rc"
+fi
+rm -rf "$WORK"
+exit $rc
