@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Differentiable tuning vs the G-fit grid sweep it replaces.
+
+Measures the gradient-descent Q/R search (``estim.tune``, method="grad":
+the whole search — inner fixed-iteration EM, in-graph held-out scoring,
+Adam over log hypers — as ONE jitted program with ONE blocking d2h)
+against the pre-tune baseline: a loop of G lone fused fits, one per grid
+point of the default (q_scale, r_scale) grid, each scored held-out at
+the same budget on the same backend.  Equal-quality: the grad search's
+final held-out MSE must match or beat the grid's best point (recorded as
+``tune_quality_vs_grid`` = grid best / grad best, >= 1 means the
+gradient search found an as-good-or-better point).
+
+Prints exactly ONE JSON line to stdout:
+
+    {"metric": "tune_speedup_vs_grid", "value": N, "unit": "x",
+     "tune_speedup_vs_grid": N, "tune_heldout_gain": N,
+     "tune_dispatches": N, ...}
+
+``tune_heldout_gain`` is the relative held-out one-step MSE improvement
+of the tuned point over the untuned (q=r=1) fit at the same EM budget —
+deterministic given the panel.  ``tune_dispatches`` is the search's
+blocking-d2h count (the dispatch-budget contract; 1 for the grad
+search vs 2G for the grid loop).
+
+Run on the real chip: ``python -m bench.tune``.  Smoke-size via
+DFM_BENCH_N/T/K, DFM_BENCH_TUNE_STEPS (Adam steps, default 12),
+DFM_BENCH_TUNE_EM_ITERS (inner EM budget, default 5),
+DFM_BENCH_TUNE_HOLDOUT (held-out rows, default 8), DFM_BENCH_REPS
+(best-of-N, default 3).  Diagnostics on stderr.
+"""
+
+import json
+import os
+
+from bench._common import log, record_run, timed
+
+
+def main():
+    N = int(os.environ.get("DFM_BENCH_N", 24))
+    T = int(os.environ.get("DFM_BENCH_T", 120))
+    k = int(os.environ.get("DFM_BENCH_K", 2))
+    steps = int(os.environ.get("DFM_BENCH_TUNE_STEPS", 12))
+    em_iters = int(os.environ.get("DFM_BENCH_TUNE_EM_ITERS", 5))
+    holdout = int(os.environ.get("DFM_BENCH_TUNE_HOLDOUT", 8))
+    reps = int(os.environ.get("DFM_BENCH_REPS", 3))
+
+    import numpy as np
+
+    import jax
+
+    from dfm_tpu.backends import cpu_ref
+    from dfm_tpu.estim.em import EMConfig
+    from dfm_tpu.estim.tune import DEFAULT_GRID, TuneOptions, tune_fit
+    from dfm_tpu.utils import dgp
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}); N={N} T={T} k={k}, "
+        f"{steps} grad steps x {em_iters} EM iters, holdout {holdout}, "
+        f"grid {len(DEFAULT_GRID)} points, best of {reps}")
+
+    rng = np.random.default_rng(77)
+    Y_raw, _ = dgp.simulate(dgp.dfm_params(N, k, rng), T, rng)
+    Y = (Y_raw - Y_raw.mean(0)) / Y_raw.std(0)
+    W = dgp.random_mask(T, N, rng, 0.1)      # masked panel: the tune
+    p0 = cpu_ref.pca_init(Y * W, k)          # objective's natural habitat
+    cfg = EMConfig(filter="info")
+
+    # --- grad leg: the whole search is ONE jitted program, ONE d2h ----
+    o_grad = TuneOptions(method="grad", steps=steps, em_iters=em_iters,
+                         holdout_rows=holdout)
+    rec = tune_fit(Y, W, p0, cfg, o_grad)
+    wall_grad = timed(lambda: tune_fit(Y, W, p0, cfg, o_grad), reps)
+    log(f"grad: q={rec['q_scale']:.3g} r={rec['r_scale']:.3g}, held-out "
+        f"{rec['heldout_before']:.4g} -> {rec['heldout_after']:.4g}, "
+        f"{rec['dispatches']} d2h, {1e3 * wall_grad:.1f} ms warm")
+
+    # --- grid leg: G lone fits, one per candidate point ---------------
+    # What the same search costs without tune: per point, a full lone
+    # ``fit()`` on the training window at the same EM budget (its own
+    # fused program + result d2h) scored held-out by the NumPy f64
+    # oracle — exactly the pre-tune recipe ``fleet/maintenance``'s
+    # quality gate uses.  Through the axon tunnel that is >= 2G blocking
+    # round-trips vs the grad search's one; the candidate hypers ride
+    # the backend's tuned-cfg seam so both legs fit the identical
+    # hyper-scaled EM.
+    from dfm_tpu import DynamicFactorModel, TPUBackend, fit
+    from dfm_tpu.estim.score import heldout_mse_np
+
+    model = DynamicFactorModel(n_factors=k, standardize=False)
+    Wtr = W.copy()
+    Wtr[T - holdout:] = 0.0
+    Ytr = np.where(Wtr > 0, Y, np.nan)   # holdout + mask -> missing
+    be = TPUBackend()
+
+    def grid_loop():
+        best = float("inf")
+        for g in DEFAULT_GRID:
+            be._tune_hypers = g
+            r1 = fit(model, Ytr, max_iters=em_iters, tol=0.0, init=p0,
+                     backend=be)
+            s = heldout_mse_np(Y, W, r1.params, holdout)
+            if np.isfinite(s):
+                best = min(best, s)
+        be._tune_hypers = None
+        return best
+
+    grid_best = grid_loop()
+    wall_grid = timed(grid_loop, reps)
+    log(f"grid: best held-out {grid_best:.4g} over {len(DEFAULT_GRID)} "
+        f"lone fits ({2 * len(DEFAULT_GRID)} d2h), "
+        f"{1e3 * wall_grid:.1f} ms warm")
+
+    speedup = wall_grid / wall_grad
+    before = rec["heldout_before"]
+    gain = ((before - rec["heldout_after"]) / before
+            if np.isfinite(before) and before > 0 else float("nan"))
+    quality = (grid_best / rec["heldout_after"]
+               if np.isfinite(grid_best) and rec["heldout_after"] > 0
+               else float("nan"))
+    log(f"speedup {speedup:.2f}x, held-out gain {100 * gain:.1f}%, "
+        f"quality vs grid {quality:.3f} (>=1 means grad as good or "
+        f"better)")
+
+    payload = {
+        "metric": "tune_speedup_vs_grid",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "value_definition": ("warm wall of the G-lone-fit grid sweep "
+                             "divided by the warm wall of the one-program "
+                             "gradient search at the same shape, budget "
+                             "and backend"),
+        "tune_speedup_vs_grid": round(speedup, 3),
+        "tune_heldout_gain": round(gain, 6),
+        "tune_dispatches": int(rec["dispatches"]),
+        "tune_quality_vs_grid": round(quality, 4),
+        "heldout_before": rec["heldout_before"],
+        "heldout_after": rec["heldout_after"],
+        "grid_best_heldout": grid_best,
+        "q_scale": rec["q_scale"],
+        "r_scale": rec["r_scale"],
+        "grad_steps": steps,
+        "grid_points": len(DEFAULT_GRID),
+        "em_iters": em_iters,
+        "holdout_rows": holdout,
+        "shape_N_T_k": [N, T, k],
+    }
+    from dfm_tpu.obs.store import new_run_id
+    payload["run_id"] = new_run_id()
+    print(json.dumps(payload))
+    record_run(payload, dev, "bench_tune")
+
+
+if __name__ == "__main__":
+    main()

@@ -107,6 +107,12 @@ class FitResult:
     #                                  # without the filter knob (CPU
     #                                  # oracle) — also stamped on the
     #                                  # fit trace event
+    tune: Optional[dict] = None        # fit(tune=...) only: the hyper
+    #                                  # search record {method, q_scale,
+    #                                  # r_scale, lam_ridge, heldout_
+    #                                  # before/after, trajectory | cv,
+    #                                  # dispatches, wall_s} — the chosen
+    #                                  # hypers were applied to THIS fit
 
     @property
     def loglik(self) -> float:
@@ -271,6 +277,12 @@ class TPUBackend(Backend):
         # Transient per-fit fused-program options (fit(fused=...) sets and
         # restores a FusedOptions); routes run_em through estim.fused.
         self._fused = None
+        # Transient per-fit tuned hypers (fit(tune=...) sets and restores a
+        # (q_scale, r_scale, lam_ridge) triple); _tuned_cfg folds them into
+        # EMConfig's static hyper fields at every program-build site, so
+        # the chunked/fused/sharded drivers all run the tuned M-step.
+        # None (the default) keeps every program byte-identical.
+        self._tune_hypers = None
         # PERSISTENT (not one-shot) device-panel cache for fused warm
         # refits: fit(warm_start=prev) with the same panel object re-enters
         # the fused program with ZERO h2d upload.  Keyed on the caller's
@@ -287,6 +299,16 @@ class TPUBackend(Backend):
         if self.device_init == "auto":
             return Y.size >= 4_000_000
         return bool(self.device_init)
+
+    def _tuned_cfg(self, cfg):
+        """Fold the transient fit(tune=...) hypers into the EMConfig every
+        driver builds its programs from.  No-op (the SAME cfg object) when
+        no tune is active — the untuned program stays byte-identical."""
+        if self._tune_hypers is None:
+            return cfg
+        q, r, lam = self._tune_hypers
+        return dataclasses.replace(cfg, q_scale=float(q), r_scale=float(r),
+                                   lam_ridge=float(lam))
 
     def default_init(self, Y, mask, model):
         if not self._use_device_init(Y):
@@ -404,10 +426,11 @@ class TPUBackend(Backend):
         pj = JaxParams.from_numpy(p0, dtype=dt)
         flt = self._filter_for(Y.shape[1], mask is not None)
         self._last_filter = flt
-        cfg = EMConfig(estimate_A=model.estimate_A,
-                       estimate_Q=model.estimate_Q,
-                       estimate_init=model.estimate_init,
-                       filter=flt, debug=self.debug, rank=self.rank)
+        cfg = self._tuned_cfg(
+            EMConfig(estimate_A=model.estimate_A,
+                     estimate_Q=model.estimate_Q,
+                     estimate_init=model.estimate_init,
+                     filter=flt, debug=self.debug, rank=self.rank))
         if flt == "ss":
             # tau from the measured covariance-recursion mixing time at the
             # init params (k x k on host, microseconds) — the same choice
@@ -510,10 +533,11 @@ class TPUBackend(Backend):
         pj = JaxParams.from_numpy(p0, dtype=dt)
         flt = self._filter_for(Y.shape[1], mask is not None)
         self._last_filter = flt
-        cfg = EMConfig(estimate_A=model.estimate_A,
-                       estimate_Q=model.estimate_Q,
-                       estimate_init=model.estimate_init,
-                       filter=flt, debug=False, rank=self.rank)
+        cfg = self._tuned_cfg(
+            EMConfig(estimate_A=model.estimate_A,
+                     estimate_Q=model.estimate_Q,
+                     estimate_init=model.estimate_init,
+                     filter=flt, debug=False, rank=self.rank))
         if flt == "ss":
             from .ssm.steady import auto_tau
             cfg = dataclasses.replace(cfg, tau=auto_tau(p0))
@@ -668,13 +692,15 @@ class TPUBackend(Backend):
                     checkpoint_fingerprint=gc[1], iter_offset=gc[2])
             monitor = ChunkMonitor(policy, controls)
             self._last_health = monitor.health
+        from .estim.em import cfg_hypers
         return run_em_chunked(
             scan_fn, pj, max_iters, tol,
             noise_floor_for(Yj.dtype, Yj.size, mult=cfg.noise_floor_mult),
             callback, self.fused_chunk,
             ss_tau=cfg.tau if cfg.filter == "ss" else None,
             monitor=monitor, progress=progress,
-            pipeline=getattr(self, "_pipeline", None))
+            pipeline=getattr(self, "_pipeline", None),
+            monotone=cfg_hypers(cfg) is None)
 
     def smooth(self, Y, mask, params):
         # fit() calls smooth right after run_em with the exact (Y, mask,
@@ -823,10 +849,11 @@ class ShardedBackend(TPUBackend):
         # single-device TPUBackend(debug=True).
         flt = self._filter_for(Y.shape[1], mask is not None)
         self._last_filter = flt
-        cfg = EMConfig(estimate_A=model.estimate_A,
-                       estimate_Q=model.estimate_Q,
-                       estimate_init=model.estimate_init, filter=flt,
-                       debug=self.debug)
+        cfg = self._tuned_cfg(
+            EMConfig(estimate_A=model.estimate_A,
+                     estimate_Q=model.estimate_Q,
+                     estimate_init=model.estimate_init, filter=flt,
+                     debug=self.debug))
         if flt == "ss":
             from .ssm.steady import auto_tau
             cfg = dataclasses.replace(cfg, tau=auto_tau(p0))
@@ -1057,6 +1084,7 @@ def fit(model,                     # DynamicFactorModel | family spec
         fused=False,
         warm_start=None,
         auto=False,
+        tune=None,
         keep_session=False):
     """Estimate a DFM: standardize -> PCA init -> EM -> smooth.
 
@@ -1168,6 +1196,24 @@ def fit(model,                     # DynamicFactorModel | family spec
         to the default knobs with a RuntimeWarning — ``auto`` never
         profiles inside ``fit`` and never tunes on pure priors.
         Mutually exclusive with explicit ``pipeline=``/``fused=``.
+    tune : hyperparameter search before the fit (``estim.tune``): ``True``
+        (defaults: in-graph gradient search), a ``TuneOptions``, or a
+        kwargs dict.  The search runs on the standardized panel —
+        ``method="grad"`` differentiates the held-out one-step MSE
+        through the filter and takes ~20 in-graph Adam steps over
+        (log Q-scale, log R-scale) in ONE jitted program (one blocking
+        device->host read); ``method="sweep"`` rides all grid candidates
+        as ONE fused batched-EM program plus one vmapped scoring program
+        (two reads); ``"both"`` composes them.  The winning
+        (q_scale, r_scale, lam_ridge) is applied to THIS fit through
+        ``EMConfig``'s hyper fields — every execution mode (chunked,
+        fused, pipelined, sharded) runs the tuned M-step — and the
+        search record lands as ``FitResult.tune``.  The best candidate
+        is never worse than untuned at the search's EM budget (theta=0 /
+        the (1,1,0) grid point is always evaluated).  Mutually exclusive
+        with ``auto=True`` (the advisor would re-plan a program the tune
+        already committed to); CPU oracle and family fits warn + ignore.
+        ``tune=None`` (default) is bit-identical to pre-tune ``fit()``.
     keep_session : open a streaming ``serve.NowcastSession`` on the fitted
         model (``FitResult.session``): params AND panel stay device-
         resident in a capacity-padded buffer, and every
@@ -1188,7 +1234,7 @@ def fit(model,                     # DynamicFactorModel | family spec
             res = _fit_impl(model, Y, mask, backend, max_iters, tol, init,
                             callback, checkpoint_path, checkpoint_every,
                             debug, robust, progress, pipeline, fused,
-                            warm_start, auto)
+                            warm_start, auto, tune)
             if keep_session and isinstance(res, FitResult):
                 # Session open uses the ORIGINAL-units panel from this
                 # scope (the session re-applies res.standardizer itself).
@@ -1399,7 +1445,7 @@ def _resolve_auto_plan(b, N, T, k, max_iters):
 def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
               checkpoint_path, checkpoint_every, debug, robust,
               progress=None, pipeline=None, fused=False, warm_start=None,
-              auto=False):
+              auto=False, tune=None):
     if warm_start is not None and not isinstance(model, DynamicFactorModel):
         raise TypeError(
             f"warm_start is only supported for DynamicFactorModel fits; "
@@ -1425,6 +1471,11 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
                 f"the {type(model).__name__} family has no auto-tunable "
                 "execution plans; ignoring auto=", RuntimeWarning,
                 stacklevel=3)
+        if tune:
+            import warnings
+            warnings.warn(
+                f"the {type(model).__name__} family has no hyper-tuning "
+                "seam; ignoring tune=", RuntimeWarning, stacklevel=3)
         return family
     max_iters = 50 if max_iters is None else max_iters
     tol = 1e-6 if tol is None else tol
@@ -1464,6 +1515,11 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             raise ValueError(
                 "auto=True picks the execution plan itself — drop the "
                 "explicit pipeline=/fused= knobs (or drop auto=)")
+        if tune:
+            raise ValueError(
+                "auto=True and tune=... are mutually exclusive: the "
+                "advisor re-plans the very program the tuned hypers "
+                "committed to (drop one of them)")
         auto_plan = _resolve_auto_plan(b, N, T, model.n_factors, max_iters)
         if auto_plan is not None:
             chunk = int(auto_plan.get("fused_chunk") or 0)
@@ -1553,6 +1609,34 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             ck = None
     if init is None:
         init = b.default_init(Yz, Wm, model)
+    # tune: hyper search BEFORE the fit (estim.tune), its winner applied
+    # transiently through the backend's _tune_hypers seam — every program
+    # the drivers build below folds the (q_scale, r_scale, lam_ridge)
+    # triple in via _tuned_cfg.  Same transient contract as debug/robust.
+    tune_rec = None
+    restore_tune = None
+    if tune is not None and tune is not False:
+        from .estim.tune import resolve_tune as _resolve_tune
+        from .estim.tune import tune_fit as _tune_fit
+        topts = _resolve_tune(tune)
+        if topts is not None and not hasattr(b, "_tune_hypers"):
+            import warnings
+            warnings.warn(
+                f"backend {b.name!r} has no tuned-hyper seam; ignoring "
+                "tune=", RuntimeWarning, stacklevel=3)
+        elif topts is not None:
+            from .estim.em import EMConfig as _EMConfig
+            bdt = getattr(b, "_dtype", None)
+            tune_rec = _tune_fit(
+                Yz, Wm, init,
+                _EMConfig(estimate_A=model.estimate_A,
+                          estimate_Q=model.estimate_Q,
+                          estimate_init=model.estimate_init,
+                          filter="info"),
+                topts, dtype=(bdt() if bdt is not None else None))
+            restore_tune = (b._tune_hypers,)
+            b._tune_hypers = (tune_rec["q_scale"], tune_rec["r_scale"],
+                              tune_rec["lam_ridge"])
     # debug only toggles THIS fit: user-supplied backend instances are
     # restored on exit (checkify mode is orders of magnitude slower — it
     # must not silently stick to the instance for later fits).
@@ -1709,6 +1793,8 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
             b.filter = restore_filter[0]
         if restore_gck is not None:
             b._guard_checkpoint = restore_gck[0]
+        if restore_tune is not None:
+            b._tune_hypers = restore_tune[0]
     nowcast = forecasts = None
     if fused_extra is not None:
         inv = std.inverse if std is not None else (lambda a: a)
@@ -1725,7 +1811,8 @@ def _fit_impl(model, Y, mask, backend, max_iters, tol, init, callback,
                      history=history, health=health,
                      fingerprint=fp_now, nowcast=nowcast,
                      forecasts=forecasts, advice=auto_plan,
-                     filter=getattr(b, "_last_filter", None))
+                     filter=getattr(b, "_last_filter", None),
+                     tune=tune_rec)
 
 
 def forecast(result, horizon: int):

@@ -275,6 +275,12 @@ class Hetero(NamedTuple):
     noise_floor: (B,) accum dtype; per-problem divergence floor, from the
                  problem's OWN n_obs = T_act * N_act.
     iter_cap:    (B,) int32; per-problem max EM iterations.
+    q_scale:     optional (B,) compute dtype; per-lane tuned EM hypers
+                 (``estim.tune``'s CV sweep lanes): Q <- q_scale * Q.
+    r_scale:     optional (B,); R <- max(r_scale * R, r_floor).
+    lam_ridge:   optional (B,); ridge on the loading normal equations.
+                 ``None`` (the default) keeps the historical program
+                 byte-identical — the hyper ops never trace.
     """
 
     t_mask: jnp.ndarray
@@ -284,14 +290,21 @@ class Hetero(NamedTuple):
     tol: jnp.ndarray
     noise_floor: jnp.ndarray
     iter_cap: jnp.ndarray
+    q_scale: Optional[jnp.ndarray] = None
+    r_scale: Optional[jnp.ndarray] = None
+    lam_ridge: Optional[jnp.ndarray] = None
 
 
 def make_hetero(t_act, n_act, T: int, N: int, *, dtype, tol, iter_cap,
-                noise_floor_mult: float = 100.0) -> Hetero:
+                noise_floor_mult: float = 100.0,
+                q_scale=None, r_scale=None, lam_ridge=None) -> Hetero:
     """Build a ``Hetero`` bundle for problems of true sizes (t_act, n_act)
     padded into a (T, N) bucket.  ``tol`` / ``iter_cap`` broadcast from
     scalars or per-problem sequences; per-problem noise floors come from
-    ``noise_floor_for(dtype, t*n)`` exactly as a lone fit would compute."""
+    ``noise_floor_for(dtype, t*n)`` exactly as a lone fit would compute.
+    ``q_scale``/``r_scale``/``lam_ridge`` (scalars or per-lane sequences)
+    attach tuned EM hypers per lane; ``None`` (the default) keeps the
+    historical programs byte-identical."""
     t_act = np.asarray(t_act, np.int64).reshape(-1)
     n_act = np.asarray(n_act, np.int64).reshape(-1)
     B = len(t_act)
@@ -307,6 +320,12 @@ def make_hetero(t_act, n_act, T: int, N: int, *, dtype, tol, iter_cap,
     caps = np.broadcast_to(np.asarray(iter_cap, np.int64), (B,))
     nf = np.array([noise_floor_for(dt, int(t * n), mult=noise_floor_mult)
                    for t, n in zip(t_act, n_act)])
+    def _lane(v):
+        if v is None:
+            return None
+        return jnp.asarray(np.broadcast_to(np.asarray(v, np.float64),
+                                           (B,)), dt)
+
     return Hetero(
         t_mask=jnp.asarray(np.arange(T)[None, :] < t_act[:, None], dt),
         n_mask=jnp.asarray(np.arange(N)[None, :] < n_act[:, None], dt),
@@ -314,7 +333,10 @@ def make_hetero(t_act, n_act, T: int, N: int, *, dtype, tol, iter_cap,
         t_act=jnp.asarray(t_act, dt),
         tol=jnp.asarray(tols, acc),
         noise_floor=jnp.asarray(nf, acc),
-        iter_cap=jnp.asarray(caps, jnp.int32))
+        iter_cap=jnp.asarray(caps, jnp.int32),
+        q_scale=_lane(q_scale),
+        r_scale=_lane(r_scale),
+        lam_ridge=_lane(lam_ridge))
 
 
 # ---------------------------------------------------------------------------
@@ -491,9 +513,26 @@ def batched_m_step(Y, x_sm, P_sm, P_lag, p: SSMParams, cfg: EMConfig, Ysq,
     S_cross = Pl_m[:, 1:].sum(1) + jnp.einsum("bti,btj->bij",
                                               x_m[:, 1:], x_m[:, :-1])
     S_yf = jnp.einsum("btn,btk->bnk", Y, x_m)       # (B, N, k)
-    Lam = _bsolve_rows(S_ff, S_yf)
-    R = jnp.maximum(
-        (Ysq - jnp.einsum("bnk,bnk->bn", Lam, S_yf)) / T_r, cfg.r_floor)
+    # Optional per-lane tuned hypers (estim.tune CV sweep lanes).  With a
+    # ridge the OLS shortcut (Ysq - Lam.S_yf)/T for R is biased, so the
+    # ridge branch computes the full residual quadratic — exactly as
+    # ``em.mstep_rows`` does.  None (the default) traces the historical
+    # program byte-identically.
+    ridge = None if hetero is None else hetero.lam_ridge
+    if ridge is not None:
+        k = S_ff.shape[-1]
+        eye_k = jnp.eye(k, dtype=S_ff.dtype)
+        Lam = _bsolve_rows(S_ff + ridge[:, None, None] * eye_k, S_yf)
+        quad = (Ysq - 2.0 * jnp.einsum("bnk,bnk->bn", Lam, S_yf)
+                + jnp.einsum("bnk,bkl,bnl->bn", Lam, S_ff, Lam))
+        R = jnp.maximum(quad / T_r, cfg.r_floor)
+    else:
+        Lam = _bsolve_rows(S_ff, S_yf)
+        R = jnp.maximum(
+            (Ysq - jnp.einsum("bnk,bnk->bn", Lam, S_yf)) / T_r,
+            cfg.r_floor)
+    if hetero is not None and hetero.r_scale is not None:
+        R = jnp.maximum(hetero.r_scale[:, None] * R, cfg.r_floor)
     if hetero is not None:
         nm = hetero.n_mask > 0
         Lam = jnp.where(nm[..., None], Lam, jnp.zeros((), Lam.dtype))
@@ -507,6 +546,8 @@ def batched_m_step(Y, x_sm, P_sm, P_lag, p: SSMParams, cfg: EMConfig, Ysq,
         Q = sym((S_cur - matmul_vpu(A, _bT(S_cross))
                  - matmul_vpu(S_cross, _bT(A))
                  + matmul_vpu(matmul_vpu(A, S_lag), _bT(A))) / T_q)
+    if hetero is not None and hetero.q_scale is not None:
+        Q = hetero.q_scale[:, None, None] * Q
     mu0, P0 = p.mu0, p.P0
     if cfg.estimate_init:
         mu0, P0 = x_sm[:, 0], sym(P_sm[:, 0])
@@ -765,7 +806,15 @@ def _em_chunk_core(Y, carry, tol, noise_floor, cfg: EMConfig, n_iters: int,
         rel = (ll - ll_prev) / jnp.maximum(jnp.abs(ll_prev), 1e-12)
         drop = ll_prev - ll
         conv_rel = (tol > 0) & (jnp.abs(rel) < tol)
-        diverged = drop > noise_floor
+        # Hyper-scaled lanes (estim.tune sweep) are generalized EM: their
+        # fixed point is not a loglik stationary point, so a drop is the
+        # plateau stop, not a divergence (host twin: em_progress's
+        # monotone=False rule).  Hetero's hyper fields are pytree
+        # structure, so hyper-free programs stay byte-identical.
+        monotone = hetero is None or (hetero.q_scale is None
+                                      and hetero.r_scale is None
+                                      and hetero.lam_ridge is None)
+        diverged = (drop > noise_floor) & monotone
         conv_plateau = (drop > 0) & (tol > 0)
         prog = jnp.where(conv_rel, CONVERGED,
                          jnp.where(diverged, DIVERGED,

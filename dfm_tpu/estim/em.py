@@ -71,6 +71,15 @@ class EMConfig:
                                       # noise floor (see noise_floor_for)
     rank: int = 0        # filter="lowrank" only: rank r (<= 0 -> auto,
                          # min(k, 8); see ssm.lowrank_filter.resolve_rank)
+    # -- tuned EM hyperparameters (estim.tune / fit(tune=...)) ----------
+    # Applied every M-step: Q <- q_scale * Q, R <- max(r_scale * R,
+    # r_floor), and lam_ridge adds a ridge to the loading normal
+    # equations (solve of S_ff + lam I).  At the defaults the guard in
+    # ``_m_step`` short-circuits so the compiled program is byte-
+    # identical to pre-tune builds (off-path bit-identity).
+    q_scale: float = 1.0
+    r_scale: float = 1.0
+    lam_ridge: float = 0.0
 
     def filter_fn(self):
         if self.filter == "lowrank":
@@ -151,7 +160,8 @@ def moment_sums(sm: SmootherResult):
     return S_ff, S_ff - last, S_ff - first, S_cross
 
 
-def mstep_rows(Y, mask, Ef, EffT, P_sm, S_ff, r_floor: float, Ysq=None):
+def mstep_rows(Y, mask, Ef, EffT, P_sm, S_ff, r_floor: float, Ysq=None,
+               lam_ridge=None):
     """Per-series M-step rows: new (Lam (n, k), R (n,)) for a series block.
 
     ``Y`` is (T, n) — the full panel or one device's shard.  Each series' row
@@ -162,15 +172,28 @@ def mstep_rows(Y, mask, Ef, EffT, P_sm, S_ff, r_floor: float, Ysq=None):
     ``Ysq``: optional precomputed per-series sum of squares (unmasked path).
     It is EM-iteration-invariant, so fused-scan drivers hoist the panel pass
     out of the iteration loop and thread it in.
+
+    ``lam_ridge`` (optional, scalar — static float or traced): ridge on the
+    loading normal equations, solving (S_ff + lam I) instead of S_ff.  The
+    unmasked R then uses the full quadratic (the ``Ysq - Lam.S_yf`` shortcut
+    is exact only at the OLS solution); ``None`` keeps the historical program
+    byte-identical.
     """
     T = Y.shape[0]
     dtype = Y.dtype
     if mask is None:
         S_yf = Y.T @ Ef                                       # (n, k)
-        Lam = solve_psd(S_ff, S_yf.T).T
         if Ysq is None:
             Ysq = jnp.einsum("ti,ti->i", Y, Y)
-        R = (Ysq - jnp.einsum("ik,ik->i", Lam, S_yf)) / T
+        if lam_ridge is None:
+            Lam = solve_psd(S_ff, S_yf.T).T
+            R = (Ysq - jnp.einsum("ik,ik->i", Lam, S_yf)) / T
+        else:
+            k = S_ff.shape[0]
+            Lam = solve_psd(S_ff + lam_ridge * jnp.eye(k, dtype=dtype),
+                            S_yf.T).T
+            R = (Ysq - 2.0 * jnp.einsum("ik,ik->i", Lam, S_yf)
+                 + jnp.einsum("ik,kl,il->i", Lam, S_ff, Lam)) / T
     else:
         k = S_ff.shape[0]
         W = mask.astype(dtype)
@@ -179,6 +202,8 @@ def mstep_rows(Y, mask, Ef, EffT, P_sm, S_ff, r_floor: float, Ysq=None):
         S_ff_i = jnp.einsum("ti,tkl->ikl", W, EffT)           # (n, k, k)
         never = (W.sum(0) == 0)[:, None, None]
         S_ff_i = jnp.where(never, jnp.eye(k, dtype=dtype)[None], S_ff_i)
+        if lam_ridge is not None:
+            S_ff_i = S_ff_i + lam_ridge * jnp.eye(k, dtype=dtype)[None]
         Lam = jax.vmap(solve_psd)(S_ff_i, S_yf_i)
         counts = jnp.maximum(W.sum(0), 1.0)
         resid_sq = jnp.einsum("ti,ti->i", W, (Yz - Ef @ Lam.T) ** 2)
@@ -241,26 +266,44 @@ def mstep_dynamics_tmasked(sm: SmootherResult, EffT, cross, p: SSMParams,
                                n_steps=n_steps)
 
 
+def cfg_hypers(cfg: EMConfig):
+    """Static (q_scale, r_scale, lam_ridge) from ``cfg``, or ``None`` at
+    the defaults — the ``None`` short-circuit is what keeps untuned
+    programs byte-identical to pre-tune builds."""
+    if cfg.q_scale != 1.0 or cfg.r_scale != 1.0 or cfg.lam_ridge != 0.0:
+        return (cfg.q_scale, cfg.r_scale, cfg.lam_ridge)
+    return None
+
+
 def _m_step(Y, mask, sm: SmootherResult, p: SSMParams, cfg: EMConfig,
-            Ysq=None, n_steps=None):
+            Ysq=None, n_steps=None, hypers=None):
+    """Closed-form M-step.  ``hypers`` (optional (q_scale, r_scale,
+    lam_ridge), traced or static) overrides the cfg's static hyper
+    fields — the seam ``estim.tune`` differentiates through; the tuned
+    ``fit()`` path reaches the same code through ``cfg_hypers``."""
+    hy = cfg_hypers(cfg) if hypers is None else hypers
+    ridge = None if hy is None else hy[2]
     if mask is None:
         if n_steps is not None:
             raise ValueError("n_steps (capacity-padded panels) requires a "
                              "mask: the pad tail must be zero-masked")
         S_ff, S_lag, S_cur, S_cross = moment_sums(sm)
         Lam, R = mstep_rows(Y, None, sm.x_sm, None, None, S_ff, cfg.r_floor,
-                            Ysq=Ysq)
+                            Ysq=Ysq, lam_ridge=ridge)
         A, Q, mu0, P0 = mstep_dynamics_sums(sm, S_lag, S_cur, S_cross, p, cfg)
     else:
         EffT, cross = moments(sm)
         S_ff = EffT.sum(0)
         Lam, R = mstep_rows(Y, mask, sm.x_sm, EffT, sm.P_sm, S_ff,
-                            cfg.r_floor)
+                            cfg.r_floor, lam_ridge=ridge)
         if n_steps is None:
             A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p, cfg)
         else:
             A, Q, mu0, P0 = mstep_dynamics_tmasked(sm, EffT, cross, p, cfg,
                                                    n_steps)
+    if hy is not None:
+        Q = hy[0] * Q
+        R = jnp.maximum(hy[1] * R, cfg.r_floor)
     return SSMParams(Lam, A, Q, R, mu0, P0)
 
 
@@ -321,7 +364,8 @@ def em_step(Y, p: SSMParams, mask=None, cfg: EMConfig = EMConfig()):
         return _em_step_impl(Y, mask, p, cfg, mask is not None)
 
 
-def em_progress(lls, tol: float, noise_floor: float = 0.0) -> str:
+def em_progress(lls, tol: float, noise_floor: float = 0.0,
+                monotone: bool = True) -> str:
     """Classify the last loglik step: 'continue' | 'converged' | 'diverged'.
 
     |relative change| < tol -> converged.  A DROP is impossible for exact
@@ -332,6 +376,14 @@ def em_progress(lls, tol: float, noise_floor: float = 0.0) -> str:
     tol <= 0 means "run the full budget" (benchmarks, fixed-iteration
     studies): noise-floor drops then do NOT stop the fit either — only a
     genuine divergence does.
+
+    monotone=False is the tuned-update rule (``estim.tune``): scaling
+    Q/R after the M-step makes the iteration a contraction toward a
+    fixed point that is NOT a likelihood stationary point, so the loglik
+    legitimately dips once the iterates cross their likelihood plateau.
+    A drop then classifies as 'converged' (stop at the plateau) instead
+    of 'diverged' — drivers pass ``monotone = (cfg_hypers(cfg) is
+    None)`` so exact-EM fits keep the sharp divergence alarm.
     """
     if len(lls) < 2:
         return "continue"
@@ -339,7 +391,7 @@ def em_progress(lls, tol: float, noise_floor: float = 0.0) -> str:
     if tol > 0 and abs(rel) < tol:
         return "converged"
     drop = lls[-2] - lls[-1]
-    if drop > noise_floor:
+    if drop > noise_floor and monotone:
         return "diverged"
     if drop > 0 and tol > 0:
         return "converged"      # noise-floor drop at a plateau
@@ -370,7 +422,7 @@ def noise_floor_for(dtype, n_obs: float = 1.0, mult: float = 100.0) -> float:
 
 
 def run_em_loop(step, max_iters: int, tol: float, callback=None,
-                noise_floor: float = 0.0):
+                noise_floor: float = 0.0, monotone: bool = True):
     """Shared EM convergence loop (used by single-device AND sharded drivers).
 
     ``step(it) -> (loglik, params_for_callback)`` advances one iteration;
@@ -390,7 +442,7 @@ def run_em_loop(step, max_iters: int, tol: float, callback=None,
         lls.append(ll)
         if callback is not None:
             callback(it, ll, cb_params)
-        progress = em_progress(lls, tol, noise_floor)
+        progress = em_progress(lls, tol, noise_floor, monotone=monotone)
         if progress != "continue":
             state = progress
             break
@@ -399,7 +451,8 @@ def run_em_loop(step, max_iters: int, tol: float, callback=None,
 
 def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                    noise_floor: float, callback=None, fused_chunk: int = 8,
-                   ss_tau=None, monitor=None, progress=None, pipeline=None):
+                   ss_tau=None, monitor=None, progress=None, pipeline=None,
+                   monotone: bool = True):
     """Shared fused-chunk EM driver (single-device, sharded, and MF fits).
 
     ``scan_fn(p, n) -> (p_new, logliks (n,), ss_deltas (n,) | None)`` runs n
@@ -445,7 +498,7 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         return guarded_run_em_chunked(
             scan_fn, p0, max_iters, tol, noise_floor, callback=callback,
             fused_chunk=fused_chunk, ss_tau=ss_tau, monitor=monitor,
-            progress=progress, pipeline=pipeline)
+            progress=progress, pipeline=pipeline, monotone=monotone)
     import time
     import numpy as np
     fused_chunk = max(1, int(fused_chunk))   # 0/negative would never advance
@@ -454,7 +507,7 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
         return _run_em_chunked_pipelined(
             scan_fn, p0, max_iters, tol, noise_floor, callback=callback,
             fused_chunk=fused_chunk, ss_tau=ss_tau, progress=progress,
-            pipe=pipe)
+            pipe=pipe, monotone=monotone)
     pass_piter = getattr(callback, "wants_params_iter", False)
     tr = current_tracer()
     prog = getattr(scan_fn, "trace_name", "em_chunk")
@@ -512,7 +565,7 @@ def run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                              params_iter=entry_it)
                 else:
                     callback(it + j, float(ll), p_entry)
-            state = em_progress(lls, tol, noise_floor)
+            state = em_progress(lls, tol, noise_floor, monotone=monotone)
             if state != "continue":
                 converged = state == "converged"
                 # Same update counts the run_em_loop drivers return:
@@ -609,7 +662,8 @@ class _ChunkCall:
 def _run_em_chunked_pipelined(scan_fn, p0, max_iters: int, tol: float,
                               noise_floor: float, callback=None,
                               fused_chunk: int = 8, ss_tau=None,
-                              progress=None, pipe=None):
+                              progress=None, pipe=None,
+                              monotone: bool = True):
     """Latency-hiding twin of the serial ``run_em_chunked`` loop.
 
     Issues up to ``pipe.depth`` chunks back-to-back, each chained from
@@ -703,7 +757,8 @@ def _run_em_chunked_pipelined(scan_fn, p0, max_iters: int, tol: float,
                                  params_iter=entry_it)
                     else:
                         callback(f_it + j, float(ll), p_entry)
-                state = em_progress(lls, tol, noise_floor)
+                state = em_progress(lls, tol, noise_floor,
+                                    monotone=monotone)
                 if state != "continue":
                     converged = state == "converged"
                     target = (len(lls) if converged
@@ -797,7 +852,8 @@ def em_fit(Y, p0: SSMParams, mask=None, cfg: EMConfig = EMConfig(),
     lls, converged, state = run_em_loop(
         step, max_iters, tol, callback,
         noise_floor=noise_floor_for(Y.dtype, Y.size,
-                                    mult=cfg.noise_floor_mult))
+                                    mult=cfg.noise_floor_mult),
+        monotone=cfg_hypers(cfg) is None)
     if cfg.filter == "ss":
         warn_ss_delta(max_delta, cfg.tau)
     p_iters = len(lls)

@@ -85,16 +85,11 @@ def oos_evaluate(model: DynamicFactorModel, Y: np.ndarray,
     else:
         raise ValueError(f"unknown engine {engine!r} (loop|batched)")
 
-    errors = np.zeros((len(origins), N))
-    naive = np.zeros((len(origins), N))
-    meanb = np.zeros((len(origins), N))
-    for w, t0 in enumerate(origins):
-        lo = max(0, t0 - min_train) if window == "rolling" else 0
-        Ytr = Y[lo:t0]
-        truth = Y[t0 + horizon - 1]
-        errors[w] = truth - y_hats[w]
-        naive[w] = truth - Ytr[-1]
-        meanb[w] = truth - Ytr.mean(0)
+    # Shared windowing (estim.score): the same error/benchmark definition
+    # the tune objective and the maintenance quality gate build on.
+    from .score import forecast_origin_errors
+    errors, naive, meanb = forecast_origin_errors(
+        Y, origins, y_hats, min_train, window, horizon)
     rmse = np.sqrt((errors ** 2).mean(0))
     return OOSResult(origins=np.asarray(origins), errors=errors, rmse=rmse,
                      rmse_naive=np.sqrt((naive ** 2).mean(0)),

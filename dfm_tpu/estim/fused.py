@@ -207,7 +207,15 @@ def _em_while_core(Y, m, p0, tol, noise_floor, cfg, max_iters, chunk, opts,
         rel = (lls_c - prev) / jnp.maximum(jnp.abs(prev), 1e-12)
         drop = prev - lls_c
         small = (tol > 0) & (jnp.abs(rel) < tol)
-        diver = ~small & (drop > floor)
+        # Tuned fits (cfg_hypers active — estim.tune) stop at the
+        # likelihood plateau instead of alarming on it: the hyper-scaled
+        # update's fixed point is not a loglik stationary point, so a
+        # drop is the expected terminal behavior, not a divergence
+        # (host twin: em_progress(monotone=False)).  cfg is static, so
+        # the untuned predicate is byte-identical to pre-tune programs.
+        from .em import cfg_hypers
+        monotone = cfg_hypers(cfg) is None
+        diver = ~small & (drop > floor) & monotone
         plateau = ~small & ~diver & (drop > 0) & (tol > 0)
         conv = has_prev & active & (small | plateau)
         # Non-finite logliks count as divergence: NaN comparisons are all

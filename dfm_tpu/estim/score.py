@@ -1,0 +1,105 @@
+"""Shared held-out one-step prediction scoring (arXiv 1910.08615).
+
+One definition of "model quality" rides every seam that needs it:
+
+- ``fleet/maintenance.heldout_score`` (the drift-refit quality gate),
+- ``estim.tune``'s cross-validated / differentiable objective, and
+- ``estim.evaluate.oos_evaluate``'s forecast-error windowing
+
+all call into this module, so a change to the objective changes every
+consumer at once instead of drifting three private copies apart.
+
+The core (:func:`one_step_sse`) is array-module generic: pass ``xp=numpy``
+for the f64 oracle paths (jax-free — maintenance can score without
+touching the device) or ``xp=jax.numpy`` to compute the SAME reduction
+in-graph, where it is reverse-mode differentiable (the seam
+``estim.tune`` drives gradients through).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["one_step_sse", "heldout_mse_np", "heldout_mse_graph",
+           "forecast_origin_errors", "clamp_holdout"]
+
+
+def clamp_holdout(holdout_rows: int, T: int) -> int:
+    """Trailing-window length actually scored: at least 1 row, never the
+    whole panel (one-step predictions need at least one training row)."""
+    return max(1, min(int(holdout_rows), T - 1))
+
+
+def one_step_sse(Y, W, x_pred, Lam, holdout_rows: int, xp=np):
+    """Sum of squared one-step prediction errors over the observed entries
+    of the trailing ``holdout_rows`` rows, plus the observed count.
+
+    ``x_pred`` (T, k) are the filter's one-step state predictions —
+    ``x_pred[t]`` uses data strictly before ``t``, so scoring rows the
+    filter also saw is legitimate pseudo-out-of-sample scoring.  ``W``
+    may be ``None`` (observedness falls back to ``isfinite(Y)``).
+
+    Returns ``(sse, n_obs)`` in ``xp``'s array type; callers divide
+    (hosts guard n == 0 with NaN, graphs with ``maximum(n, 1)``).
+    """
+    T = Y.shape[0]
+    h = clamp_holdout(holdout_rows, T)
+    lo = T - h
+    pred = x_pred[lo:] @ Lam.T
+    obs = (W[lo:] > 0) if W is not None else xp.isfinite(Y[lo:])
+    err = xp.where(obs, xp.nan_to_num(Y[lo:]) - pred, 0.0)
+    return (err * err).sum(), obs.sum()
+
+
+def heldout_mse_np(Y_std: np.ndarray, W: Optional[np.ndarray], params,
+                   holdout_rows: int) -> float:
+    """Held-out one-step MSE via the NumPy f64 oracle filter (standardized
+    units; lower is better; NaN when the window holds no observed entry).
+
+    This is the maintenance quality gate's scorer — the historical
+    ``fleet.maintenance.heldout_score`` body, now shared.
+    """
+    from ..backends import cpu_ref
+    Y = np.asarray(Y_std, np.float64)
+    kf = cpu_ref.kalman_filter(Y, params, mask=W)
+    sse, n = one_step_sse(Y, None if W is None else np.asarray(W, np.float64),
+                          kf.x_pred, np.asarray(params.Lam, np.float64),
+                          holdout_rows, xp=np)
+    n = float(n)
+    if n == 0:
+        return float("nan")
+    return float(sse / n)
+
+
+def heldout_mse_graph(Y, W, x_pred, Lam, holdout_rows: int):
+    """In-graph held-out one-step MSE (same reduction as the oracle, in
+    the caller's compute dtype): differentiable, vmappable, zero-guarded
+    with ``maximum(n, 1)`` instead of host NaN logic."""
+    import jax.numpy as jnp
+    sse, n = one_step_sse(Y, W, x_pred, Lam, holdout_rows, xp=jnp)
+    return sse / jnp.maximum(n.astype(sse.dtype), 1.0)
+
+
+def forecast_origin_errors(Y: np.ndarray, origins, y_hats, min_train: int,
+                           window: str, horizon: int):
+    """Per-window forecast errors vs truth plus the naive benchmarks —
+    the ``oos_evaluate`` windowing loop, shared.
+
+    Returns ``(errors, naive, meanb)``, each (W, N): model error, last-
+    value-benchmark error and train-mean-benchmark error at each origin.
+    """
+    Y = np.asarray(Y, np.float64)
+    N = Y.shape[1]
+    errors = np.zeros((len(origins), N))
+    naive = np.zeros((len(origins), N))
+    meanb = np.zeros((len(origins), N))
+    for w, t0 in enumerate(origins):
+        lo = max(0, t0 - min_train) if window == "rolling" else 0
+        Ytr = Y[lo:t0]
+        truth = Y[t0 + horizon - 1]
+        errors[w] = truth - y_hats[w]
+        naive[w] = truth - Ytr[-1]
+        meanb[w] = truth - Ytr.mean(0)
+    return errors, naive, meanb

@@ -69,6 +69,15 @@ class MaintenancePolicy:
     max_iters: int = 50        # background refit EM budget
     tol: float = 1e-6          # background refit stop tolerance
     max_buckets: int = 3       # sched.submit bucketing cap
+    retune: bool = False       # also re-tune Q/R hypers per tenant
+    #                          # (estim.tune gradient search on the
+    #                          # tenant's window); the tuned candidate
+    #                          # competes with the plain refit on the
+    #                          # same held-out gate and lands through the
+    #                          # SAME params-only swap seam — zero
+    #                          # recompiles, trail action "retune"
+    retune_steps: int = 8      # tune search budget (Adam steps)
+    retune_em_iters: int = 5   # tune search inner EM budget
 
 
 @dataclasses.dataclass
@@ -84,8 +93,13 @@ class MaintenanceRecord:
     score_before: float        # held-out one-step MSE (standardized)
     score_after: float
     quality_delta: float       # score_before - score_after (> 0 == better)
-    action: str                # "swap" or "skip"
+    action: str                # "swap", "retune" (tuned candidate won;
+    #                          # policy.retune only) or "skip"
     swap_t: Optional[float]    # perf_counter at swap (None when skipped)
+    tune: Optional[dict] = None  # policy.retune only: the tune record
+    #                          # (q_scale/r_scale/lam_ridge + held-out
+    #                          # curve) — recorded even when the plain
+    #                          # refit wins
 
 
 def heldout_score(Y_std: np.ndarray, W: Optional[np.ndarray], params,
@@ -97,21 +111,12 @@ def heldout_score(Y_std: np.ndarray, W: Optional[np.ndarray], params,
     the observed entries of the trailing ``holdout_rows`` rows — the
     "fitting a Kalman smoother to data" quality objective.  Lower is
     better; NaN when the window holds no observed entries.
+
+    The actual reduction lives in ``estim.score`` — ONE definition shared
+    with ``estim.tune``'s in-graph objective and ``oos_evaluate``.
     """
-    from ..backends import cpu_ref
-    Y = np.asarray(Y_std, np.float64)
-    T = Y.shape[0]
-    h = max(1, min(int(holdout_rows), T - 1))
-    kf = cpu_ref.kalman_filter(Y, params, mask=W)
-    pred = kf.x_pred @ np.asarray(params.Lam, np.float64).T
-    lo = T - h
-    obs = (np.asarray(W, np.float64)[lo:] > 0 if W is not None
-           else np.isfinite(Y[lo:]))
-    err = np.where(obs, np.nan_to_num(Y[lo:]) - pred[lo:], 0.0)
-    n = float(obs.sum())
-    if n == 0:
-        return float("nan")
-    return float((err * err).sum() / n)
+    from ..estim.score import heldout_mse_np
+    return heldout_mse_np(Y_std, W, params, holdout_rows)
 
 
 def _emit(ev: dict) -> None:
@@ -202,26 +207,62 @@ def run_maintenance(fleet, tenants: Optional[Sequence[str]] = None, *,
                "n_iters": int(res.fit.n_iters),
                "converged": bool(res.fit.converged),
                "engine": engine, "advice": advice})
+        # Optional hyper re-tune (policy.retune): a small gradient search
+        # (estim.tune) warm-started from the refit params.  Its best fit
+        # competes with the plain refit on the SAME masked held-out gate;
+        # the winner lands through the SAME params-only swap seam (zero
+        # recompiles) and the trail records the chosen hypers either way.
+        tune_rec = None
+        p_swap = p_new
+        action = "swap"
+        if policy.retune:
+            from ..estim.em import EMConfig
+            from ..estim.tune import TuneOptions, tune_fit
+            model = slot.model
+            tune_rec = tune_fit(
+                Yz, W, p_new,
+                EMConfig(estimate_A=model.estimate_A,
+                         estimate_Q=model.estimate_Q,
+                         estimate_init=model.estimate_init, filter="info"),
+                TuneOptions(method="grad", steps=policy.retune_steps,
+                            em_iters=policy.retune_em_iters,
+                            holdout_rows=policy.holdout_rows),
+                return_params=True)
+            p_tuned = tune_rec.pop("best_params", None)
+            if p_tuned is not None:
+                after_tuned = heldout_score(Yz, W, p_tuned,
+                                            policy.holdout_rows)
+                if np.isfinite(after_tuned) and (
+                        not np.isfinite(after) or after_tuned < after):
+                    p_swap = p_tuned
+                    after = after_tuned
+                    delta = (before - after if np.isfinite(before)
+                             else float("nan"))
+                    action = "retune"
         do_swap = bool(np.isfinite(delta) and delta >= policy.min_gain)
         swap_t = None
         if do_swap:
-            fleet.swap_params(name, p_new)
+            fleet.swap_params(name, p_swap)
             pl.reset_drift(name)
             swap_t = time.perf_counter()
+        hyp = ({} if tune_rec is None else
+               {"q_scale": round(float(tune_rec["q_scale"]), 6),
+                "r_scale": round(float(tune_rec["r_scale"]), 6),
+                "lam_ridge": round(float(tune_rec["lam_ridge"]), 6)})
         _emit({"t": swap_t if swap_t is not None else time.perf_counter(),
                "kind": "maintenance", "session": fleet.fleet_id,
-               "tenant": name, "action": "swap" if do_swap else "skip",
+               "tenant": name, "action": action if do_swap else "skip",
                "quality_delta": (round(delta, 9) if np.isfinite(delta)
                                  else None),
                "score_before": (round(before, 9) if np.isfinite(before)
                                 else None),
                "score_after": (round(after, 9) if np.isfinite(after)
                                else None),
-               "engine": engine, "advice": advice})
+               "engine": engine, "advice": advice, **hyp})
         records.append(MaintenanceRecord(
             tenant=name, trigger=trigger, advice=advice, engine=engine,
             refit_s=float(res.compute_s), refit_iters=int(res.fit.n_iters),
             score_before=float(before), score_after=float(after),
-            quality_delta=float(delta), action="swap" if do_swap
-            else "skip", swap_t=swap_t))
+            quality_delta=float(delta), action=action if do_swap
+            else "skip", swap_t=swap_t, tune=tune_rec))
     return records

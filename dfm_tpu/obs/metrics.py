@@ -556,7 +556,9 @@ def record_event(registry: MetricsRegistry, ledger: Optional[Ledger],
             cs = _num(ev.get("refit_s"))
             if cs is not None:
                 registry.histogram("refit_ms", tenant=ten).observe(cs * 1e3)
-        elif action == "swap":
+        elif action in ("swap", "retune"):
+            # "retune" = the hyper-tuned candidate won the held-out gate
+            # (MaintenancePolicy(retune=True)) — still a params swap.
             registry.counter("swaps_total", tenant=ten).inc()
             qd = _num(ev.get("quality_delta"))
             if qd is not None:
@@ -564,6 +566,25 @@ def record_event(registry: MetricsRegistry, ledger: Optional[Ledger],
                                tenant=ten).set(qd)
         elif action == "skip":
             registry.counter("maintenance_skips_total", tenant=ten).inc()
+    elif kind == "tune":
+        # Differentiable hyper-tuning (estim/tune.py): one event per
+        # tune_fit call with the search method, chosen scales and the
+        # held-out improvement.  Replayable from traces like the
+        # maintenance trail — live plane and summarize() agree.
+        method = str(ev.get("method", "?"))
+        registry.counter("tunes_total", method=method).inc()
+        wall = _num(ev.get("wall"))
+        if wall is not None:
+            registry.histogram("tune_wall_ms", method=method).observe(
+                wall * 1e3)
+        hb = _num(ev.get("heldout_before"))
+        ha = _num(ev.get("heldout_after"))
+        if hb is not None and ha is not None and hb > 0:
+            registry.gauge("tune_heldout_gain", method=method).set(
+                (hb - ha) / hb)
+        nd = _num(ev.get("dispatches"))
+        if nd is not None:
+            registry.gauge("tune_dispatches", method=method).set(nd)
     elif kind == "daemon":
         # The serving daemon's front door (dfm_tpu/daemon/): admission,
         # durability and handoff events share one kind with an

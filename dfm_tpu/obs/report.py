@@ -162,6 +162,9 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
     tenants: List[dict] = []
     advice_last = None
     n_advice = 0
+    # differentiable hyper-tuning (estim/tune.py)
+    tune_last = None
+    n_tunes = 0
     # health / robustness
     n_health = 0
     health_kinds = set()
@@ -279,6 +282,9 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
         elif kind == "advice":
             advice_last = e
             n_advice += 1
+        elif kind == "tune":
+            tune_last = e
+            n_tunes += 1
         elif kind == "freeze":
             freezes.append({k: v for k, v in e.items() if k != "kind"})
         elif kind == "cost":
@@ -403,8 +409,10 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
                 mt["refits"] += 1
                 if isinstance(e.get("refit_s"), (int, float)):
                     mt["refit_s"] += float(e["refit_s"])
-            elif act in ("swap", "skip"):
-                mt["swaps" if act == "swap" else "skips"] += 1
+            elif act in ("swap", "retune", "skip"):
+                # "retune" is a swap whose winning candidate came from the
+                # hyper search (MaintenancePolicy(retune=True)).
+                mt["skips" if act == "skip" else "swaps"] += 1
                 mt["action"] = act
                 if isinstance(e.get("quality_delta"), (int, float)):
                     mt["quality_delta"] = float(e["quality_delta"])
@@ -549,6 +557,14 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
                          if k not in ("kind", "t")}
         if n_advice > 1:
             out["advice"]["n_events"] = n_advice
+    # Differentiable hyper-tuning (estim/tune.py): the last tune event
+    # wins (one per tune_fit call); ``dispatches`` is the budget metric
+    # obs.regress gates as ``tune_dispatches``.
+    if tune_last is not None:
+        out["tune"] = {k: v for k, v in tune_last.items()
+                       if k not in ("kind", "t")}
+        if n_tunes > 1:
+            out["tune"]["n_events"] = n_tunes
     # Total wall + per-phase breakdown: dispatch (device walls measured
     # behind a barrier or async enqueue), transfer (h2d/d2h walls), host
     # (everything else — python driver, numpy, event emission).
@@ -687,7 +703,8 @@ def summarize(events_or_path: Union[str, List[str], Iterable[dict]]) -> dict:
         "drift_clears": n_drift_cleared,
         "triggers": mt_counts.get("trigger", 0),
         "refits": mt_counts.get("refit", 0),
-        "swaps": mt_counts.get("swap", 0),
+        "swaps": mt_counts.get("swap", 0) + mt_counts.get("retune", 0),
+        "retunes": mt_counts.get("retune", 0),
         "skips": mt_counts.get("skip", 0),
         "per_tenant": mt_tenant,
     }
@@ -1063,8 +1080,9 @@ def _print_text(s: dict) -> None:
                             f"{'' if pt['refits'] == 1 else 's'} "
                             f"({_fmt_s(pt['refit_s'])})")
             if pt.get("action"):
-                act = ("SWAPPED" if pt["action"] == "swap"
-                       else "skipped (no gain)")
+                act = {"swap": "SWAPPED",
+                       "retune": "RETUNED (tuned hypers won)"}.get(
+                    pt["action"], "skipped (no gain)")
                 if isinstance(pt.get("quality_delta"), (int, float)):
                     act += f", quality delta {pt['quality_delta']:+.3g}"
                 bits.append(act)
@@ -1092,6 +1110,21 @@ def _print_text(s: dict) -> None:
             line += f", realized {_fmt_s(float(real))}"
         if isinstance(a.get("rel_err"), (int, float)):
             line += f", prediction error {100 * float(a['rel_err']):.0f}%"
+        print(line)
+    tu = s.get("tune")
+    if tu:
+        line = (f"tune: {tu.get('method', '?')} search, "
+                f"q_scale={tu.get('q_scale', 1.0):.3g} "
+                f"r_scale={tu.get('r_scale', 1.0):.3g}")
+        if tu.get("lam_ridge"):
+            line += f" lam_ridge={tu['lam_ridge']:.3g}"
+        hb, ha = tu.get("heldout_before"), tu.get("heldout_after")
+        if isinstance(hb, (int, float)) and isinstance(ha, (int, float)):
+            line += f", held-out MSE {hb:.4g} -> {ha:.4g}"
+        if tu.get("dispatches") is not None:
+            line += f", {tu['dispatches']} dispatches"
+        if isinstance(tu.get("wall"), (int, float)):
+            line += f" in {_fmt_s(float(tu['wall']))}"
         print(line)
 
 

@@ -49,7 +49,7 @@ _LOWER_BETTER_MARKERS = ("ms_per", "_ms", "secs", "wall", "time_s",
                          "slo_burn_rate", "flight_dumps", "noise_ratio",
                          "evictions_per", "shed_rate", "dropped_queries",
                          "detection_lag", "false_positive", "p99_ratio",
-                         "trace_overhead")
+                         "trace_overhead", "tune_dispatches")
 
 
 def lower_is_better(metric: str) -> bool:
@@ -373,6 +373,15 @@ _BENCH_NUMERIC_KEYS = (
     # plumbing's serving-path tax (lower-is-better; "trace_overhead"
     # marker + 5-point floor above).
     "trace_overhead_pct",
+    # Differentiable tuning (bench.tune): gradient Q/R search as ONE
+    # fused program vs the G-lone-fit grid loop (higher-is-better wall
+    # ratio), the held-out one-step MSE improvement of the tuned fit
+    # (higher; deterministic given the panel), and the search's blocking
+    # d2h count — the dispatch-budget contract itself (lower-is-better
+    # marker above; floor 0 by omission — a single extra blocking
+    # transfer through the ~60-100 ms tunnel is exactly the regression
+    # the gate exists to catch).
+    "tune_speedup_vs_grid", "tune_heldout_gain", "tune_dispatches",
 )
 
 
@@ -440,7 +449,8 @@ def _backfill_kind(src: str) -> str:
     family = {"stream": "bench_stream", "longt": "bench_longt",
               "kscale": "bench_kscale", "serve": "bench_serve",
               "mixed": "bench_mixed", "fleet": "bench_fleet",
-              "daemon": "bench_daemon", "drift": "bench_drift"}
+              "daemon": "bench_daemon", "drift": "bench_drift",
+              "tune": "bench_tune"}
     return family.get(stem, "bench")
 
 

@@ -74,7 +74,7 @@ EVENT_KINDS = frozenset({
     "fit", "dispatch", "transfer", "chunk", "freeze", "health", "cost",
     "span", "query", "tick", "tenant", "page", "daemon", "maintenance",
     "compile_cache", "advice", "panel_reupload", "fused_fallback",
-    "request",
+    "request", "tune",
 })
 
 

@@ -37,8 +37,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 import dataclasses
 
 from ..obs.trace import current_tracer, shape_key
-from ..estim.em import (EMConfig, moments, moment_sums, mstep_rows,
-                        mstep_dynamics, mstep_dynamics_sums, run_em_loop)
+from ..estim.em import (EMConfig, cfg_hypers, moments, moment_sums,
+                        mstep_rows, mstep_dynamics, mstep_dynamics_sums,
+                        run_em_loop)
 from ..ssm.info_filter import (ObsStats, obs_stats, info_scan, quad_expanded,
                                quad_local, u_from_stats, loglik_from_terms)
 from ..ssm.kalman import rts_smoother
@@ -119,18 +120,25 @@ def _shard_em_step(Y_s, mask_s, p_s: SSMParams, cfg: EMConfig, gate_s=None,
                    Ysq_s=None, sumsq_s=None):
     kf, sm, delta = _shard_filter_smoother(Y_s, mask_s, p_s, cfg, gate_s,
                                            sumsq_s=sumsq_s)
+    # Tuned hypers (fit(tune=...)): the ridge/scales are replicated
+    # statics, so the shard-local rows need no extra collective.
+    hy = cfg_hypers(cfg)
+    ridge = None if hy is None else hy[2]
     if mask_s is None:
         S_ff, S_lag, S_cur, S_cross = moment_sums(sm)
         Lam_s, R_s = mstep_rows(Y_s, None, sm.x_sm, None, None, S_ff,
-                                cfg.r_floor, Ysq=Ysq_s)
+                                cfg.r_floor, Ysq=Ysq_s, lam_ridge=ridge)
         A, Q, mu0, P0 = mstep_dynamics_sums(sm, S_lag, S_cur, S_cross,
                                             p_s, cfg)
     else:
         EffT, cross = moments(sm)
         S_ff = EffT.sum(0)
         Lam_s, R_s = mstep_rows(Y_s, mask_s, sm.x_sm, EffT, sm.P_sm, S_ff,
-                                cfg.r_floor)
+                                cfg.r_floor, lam_ridge=ridge)
         A, Q, mu0, P0 = mstep_dynamics(sm, EffT, cross, p_s, cfg)
+    if hy is not None:
+        Q = hy[0] * Q
+        R_s = jnp.maximum(hy[1] * R_s, cfg.r_floor)
     if gate_s is not None and mask_s is None:
         # Keep the pads at their neutral (Lam=0, R=1): the unmasked M-step
         # would otherwise drive a pad's R to r_floor (its residual is 0),
@@ -639,11 +647,12 @@ def _sharded_em_fit_body(Y, p0, mask, mesh, cfg, max_iters, tol, dtype,
                      if callback is not None else None)
         return ll, cb_params
 
-    from ..estim.em import noise_floor_for, warn_ss_delta
+    from ..estim.em import cfg_hypers, noise_floor_for, warn_ss_delta
     lls, converged, em_state = run_em_loop(
         step, max_iters, tol, callback,
         noise_floor=noise_floor_for(drv.Y.dtype, drv.Y.size,
-                                    mult=drv.cfg.noise_floor_mult))
+                                    mult=drv.cfg.noise_floor_mult),
+        monotone=cfg_hypers(drv.cfg) is None)
     if drv.cfg.filter == "ss":
         warn_ss_delta(max_delta, drv.cfg.tau)
     drv.p_iters = len(lls)

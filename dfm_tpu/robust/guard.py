@@ -223,7 +223,7 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                            noise_floor: float, callback=None,
                            fused_chunk: int = 8, ss_tau=None,
                            monitor: ChunkMonitor = None, progress=None,
-                           pipeline=None):
+                           pipeline=None, monotone: bool = True):
     """Monitored twin of ``estim.em.run_em_chunked`` (same return tuple,
     same optional 4-element scan_fn metrics contract and per-chunk
     ``progress`` hook).
@@ -456,9 +456,10 @@ def guarded_run_em_chunked(scan_fn, p0, max_iters: int, tol: float,
                              params_iter=entry_it)
                 else:
                     callback(it + j, float(ll), p_entry)
-            if len(lls) >= 2 and lls[-2] - lls[-1] > noise_floor:
+            if (monotone and len(lls) >= 2
+                    and lls[-2] - lls[-1] > noise_floor):
                 health.monotonicity_violations += 1
-            state = em_progress(lls, tol, noise_floor)
+            state = em_progress(lls, tol, noise_floor, monotone=monotone)
             if state == "diverged" and policy.recover_divergence:
                 ev = health.record(HealthEvent(
                     chunk=chunk_idx, iteration=it + j, kind="divergence",

@@ -240,7 +240,8 @@ def test_summarize_maintenance_section_always_present_empty_shape():
                     "first_call": True}])
     assert s["maintenance"] == {"drift_fires": 0, "drift_clears": 0,
                                 "triggers": 0, "refits": 0, "swaps": 0,
-                                "skips": 0, "per_tenant": {}}
+                                "retunes": 0, "skips": 0,
+                                "per_tenant": {}}
     assert json.loads(json.dumps(s)) == s
 
 

@@ -105,8 +105,9 @@ def test_audit_scope_saw_the_timing_modules():
                 "dfm_tpu/obs/advise.py", "dfm_tpu/obs/metrics.py",
                 "dfm_tpu/obs/slo.py", "dfm_tpu/obs/live.py",
                 "dfm_tpu/estim/em.py", "dfm_tpu/estim/fused.py",
-                "dfm_tpu/robust/guard.py",
-                "bench.py", "bench/all.py", "bench/batched.py"}
+                "dfm_tpu/estim/tune.py", "dfm_tpu/robust/guard.py",
+                "bench.py", "bench/all.py", "bench/batched.py",
+                "bench/tune.py"}
     assert expected <= rels, sorted(expected - rels)
 
 

@@ -87,5 +87,5 @@ def test_registry_is_frozen_inventory():
         "fit", "dispatch", "transfer", "chunk", "freeze", "health", "cost",
         "span", "query", "tick", "tenant", "page", "daemon", "maintenance",
         "compile_cache", "advice", "panel_reupload", "fused_fallback",
-        "request",
+        "request", "tune",
     })

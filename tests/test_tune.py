@@ -1,0 +1,359 @@
+"""Differentiable hyper-tuning (estim/tune.py + fit(tune=...)).
+
+Pins the PR-20 contracts:
+
+- The in-graph held-out objective equals the NumPy f64 oracle twin, and
+  its ``jax.grad`` matches central finite differences of the ORACLE to
+  <= 1e-5 relative (x64, masked and unmasked) — the gradient really
+  flows through filter -> smoother -> M-step chain -> eval filter.
+- The gradient search and the CV sweep never return a point worse than
+  untuned at the same EM budget (best-tracking includes theta = 0), and
+  on a masked panel the tuned fit strictly improves held-out one-step
+  MSE over the untuned EM fit.
+- ``fit(tune=...)``: record on ``FitResult.tune``, tuned hypers really
+  reach the fit's M-step, hypers are transient (the backend serves
+  untuned fits bit-identically afterwards), ``tune=None`` is
+  bit-identical to pre-tune ``fit()``, ``auto=True`` conflicts, the CPU
+  backend warns + skips, fused/telemetry/robust compose, and the whole
+  search stays on its dispatch budget (proven from the trace).
+- Tuned (generalized) EM is non-monotone in the loglik by design: the
+  convergence seams classify a beyond-floor terminal drop as plateau
+  convergence (``monotone=False``) instead of divergence.
+- ``MaintenancePolicy(retune=True)``: the tuned candidate rides the
+  held-out gate and lands through the params-only swap seam with
+  ``action="retune"`` + the chosen hypers in the decision trail.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dfm_tpu import DynamicFactorModel, fit, open_fleet
+from dfm_tpu.api import CPUBackend, TPUBackend
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.estim.em import EMConfig, cfg_hypers, em_progress
+from dfm_tpu.estim.score import heldout_mse_np
+from dfm_tpu.estim.tune import (DEFAULT_GRID, TuneOptions, _heldout_loss,
+                                heldout_loss_np, resolve_tune, tune_fit)
+from dfm_tpu.fleet import MaintenancePolicy, run_maintenance
+from dfm_tpu.ssm.params import SSMParams as JaxParams
+from dfm_tpu.utils import dgp
+
+N, T, K = 10, 48, 2
+
+
+def _panel(seed=5, frac_missing=0.0):
+    rng = np.random.default_rng(seed)
+    Y_raw, _ = dgp.simulate(dgp.dfm_params(N, K, rng), T, rng)
+    Y = (Y_raw - Y_raw.mean(0)) / Y_raw.std(0)
+    W = (dgp.random_mask(T, N, rng, frac_missing) if frac_missing
+         else np.ones((T, N)))
+    p0 = cpu_ref.pca_init(Y * W if frac_missing else Y, K)
+    return Y, W, p0
+
+
+# ------------------------------------------------ gradient parity -----
+
+@pytest.mark.parametrize("frac", [0.0, 0.25], ids=["unmasked", "masked"])
+def test_grad_matches_central_fd_of_oracle(frac):
+    """jax.grad of the in-graph loss == central FD of the NumPy oracle
+    (<= 1e-5 rel, x64) at a non-trivial theta, with the ridge active."""
+    h, iters, lam = 6, 3, 0.05
+    Y, W, p0 = _panel(11, frac)
+    Wfull = np.asarray(W, np.float64)
+    Wtr = Wfull.copy()
+    Wtr[T - h:] = 0.0
+    Yz = np.where(Wfull > 0, Y, 0.0)
+    cfg = EMConfig(filter="info")
+    p0g = JaxParams(*(jnp.asarray(x, jnp.float64) for x in
+                      (p0.Lam, p0.A, p0.Q, p0.R, p0.mu0, p0.P0)))
+    theta = np.array([0.3, -0.2])
+
+    def graph_loss(th):
+        loss, _ = _heldout_loss(
+            jnp.asarray(th, jnp.float64), jnp.asarray(Yz, jnp.float64),
+            jnp.asarray(Wtr, jnp.float64), jnp.asarray(Wfull, jnp.float64),
+            p0g, cfg, iters, h, jnp.asarray(lam, jnp.float64))
+        return loss
+
+    with jax.default_matmul_precision("highest"):
+        # Objective parity first: graph == oracle at the same theta.
+        f_graph = float(graph_loss(jnp.asarray(theta)))
+        f_np = heldout_loss_np(theta, Yz, Wtr, Wfull, p0, iters, h,
+                               lam_ridge=lam)
+        assert abs(f_graph - f_np) / abs(f_np) < 1e-8, (f_graph, f_np)
+        g_ad = np.asarray(jax.grad(graph_loss)(jnp.asarray(theta)),
+                          np.float64)
+
+    eps = 1e-6
+    g_fd = np.empty(2)
+    for i in range(2):
+        tp, tm = theta.copy(), theta.copy()
+        tp[i] += eps
+        tm[i] -= eps
+        g_fd[i] = (heldout_loss_np(tp, Yz, Wtr, Wfull, p0, iters, h,
+                                   lam_ridge=lam)
+                   - heldout_loss_np(tm, Yz, Wtr, Wfull, p0, iters, h,
+                                     lam_ridge=lam)) / (2 * eps)
+    rel = np.abs(g_ad - g_fd) / np.maximum(np.abs(g_fd), 1e-12)
+    assert rel.max() < 1e-5, (g_ad, g_fd, rel)
+
+
+# ------------------------------------------------ search quality ------
+
+def test_grad_search_never_worse_and_strictly_improves_masked():
+    """Best-tracking includes theta = 0, so tuned <= untuned always; on
+    this masked panel the search strictly improves the held-out MSE."""
+    Y, W, p0 = _panel(21, 0.2)
+    rec = tune_fit(Y, W, p0, EMConfig(filter="info"),
+                   TuneOptions(method="grad", steps=8, em_iters=4),
+                   dtype=jnp.float64)
+    assert rec["dispatches"] == 1
+    assert rec["heldout_after"] <= rec["heldout_before"] + 1e-12
+    assert rec["heldout_after"] < rec["heldout_before"], rec
+    assert len(rec["trajectory"]["loss"]) == 8
+    # theta = 0 is the first evaluation: the recorded "before" IS it.
+    assert rec["heldout_before"] == rec["trajectory"]["loss"][0]
+
+
+def test_sweep_scores_every_lane_and_picks_argmin():
+    Y, W, p0 = _panel(22, 0.1)
+    rec = tune_fit(Y, W, p0, EMConfig(filter="info"),
+                   TuneOptions(method="sweep", em_iters=4),
+                   dtype=jnp.float64)
+    assert rec["dispatches"] == 2
+    assert len(rec["cv"]) == len(DEFAULT_GRID)
+    scores = [c["heldout"] for c in rec["cv"]]
+    best = rec["cv"][int(np.nanargmin(scores))]
+    assert rec["heldout_after"] == best["heldout"]
+    assert (rec["q_scale"], rec["r_scale"]) == (best["q_scale"],
+                                                best["r_scale"])
+    # The (1,1,0) lane is the untuned yardstick: sweep can only improve.
+    assert rec["heldout_after"] <= rec["heldout_before"] + 1e-12
+
+
+def test_sweep_single_untuned_point_is_identity():
+    Y, _, p0 = _panel(23)
+    rec = tune_fit(Y, None, p0, EMConfig(filter="info"),
+                   TuneOptions(method="sweep", grid=((1.0, 1.0, 0.0),),
+                               em_iters=3), dtype=jnp.float64)
+    assert rec["q_scale"] == 1.0 and rec["r_scale"] == 1.0
+    assert rec["heldout_after"] == rec["heldout_before"]
+
+
+def test_oracle_scoring_agrees_with_sweep_lane():
+    """A sweep lane's in-graph held-out score == oracle rescoring of the
+    lane's returned params (same estim.score definition end to end)."""
+    Y, W, p0 = _panel(24, 0.1)
+    rec = tune_fit(Y, W, p0, EMConfig(filter="info"),
+                   TuneOptions(method="sweep", grid=((2.0, 0.5, 0.0),),
+                               em_iters=3),
+                   dtype=jnp.float64, return_params=True)
+    s_np = heldout_mse_np(np.where(W > 0, Y, np.nan), W,
+                          rec["best_params"], rec["holdout_rows"])
+    assert rec["heldout_after"] == pytest.approx(s_np, rel=1e-9)
+
+
+# ------------------------------------------------ fit() wiring --------
+
+def test_fit_tune_record_transient_hypers_and_off_path_identity():
+    Y, _, _ = _panel(31)
+    b = TPUBackend(dtype=jnp.float64)
+    model = DynamicFactorModel(n_factors=K)
+    base = fit(model, Y, max_iters=6, tol=0.0, backend=b)
+    # A single forced non-default grid point: the winning hypers are
+    # (2.0, 0.5) by construction, so the tuned fit's M-step provably ran
+    # with them (params MUST differ from the untuned twin).
+    tuned = fit(model, Y, max_iters=6, tol=0.0, backend=b,
+                tune=TuneOptions(method="sweep", grid=((2.0, 0.5, 0.0),),
+                                 em_iters=3))
+    assert tuned.tune is not None and tuned.tune["method"] == "sweep"
+    assert tuned.tune["q_scale"] == 2.0
+    assert tuned.tune["dispatches"] == 2
+    assert not np.allclose(np.asarray(tuned.params.Q),
+                           np.asarray(base.params.Q))
+    # Hypers are transient: the SAME backend serves untuned fits
+    # bit-identically after the tuned one (seam restored on exit).
+    assert b._tune_hypers is None
+    again = fit(model, Y, max_iters=6, tol=0.0, backend=b)
+    assert np.array_equal(np.asarray(base.logliks),
+                          np.asarray(again.logliks))
+    assert np.array_equal(np.asarray(base.params.Lam),
+                          np.asarray(again.params.Lam))
+    # tune=None is the same code path as omitting it entirely.
+    none_fit = fit(model, Y, max_iters=6, tol=0.0, backend=b, tune=None)
+    assert np.array_equal(np.asarray(base.logliks),
+                          np.asarray(none_fit.logliks))
+    assert none_fit.tune is None
+
+
+def test_fit_tuned_beats_untuned_heldout_on_masked_panel():
+    """The acceptance contract: at the same EM budget on a masked panel,
+    the tuned fit's held-out one-step MSE strictly beats the untuned
+    fit's (both scored by the f64 oracle on the standardized panel)."""
+    Y, W, _ = _panel(32, 0.2)
+    Ym = np.where(W > 0, Y, np.nan)
+    model = DynamicFactorModel(n_factors=K, standardize=False)
+    b = TPUBackend(dtype=jnp.float64)
+    h = 8
+    base = fit(model, Ym, max_iters=5, tol=0.0, backend=b)
+    tuned = fit(model, Ym, max_iters=5, tol=0.0, backend=b,
+                tune=TuneOptions(method="both", steps=8, em_iters=5,
+                                 holdout_rows=h))
+    s_base = heldout_mse_np(Ym, W, base.params, h)
+    s_tuned = heldout_mse_np(Ym, W, tuned.params, h)
+    assert s_tuned < s_base, (s_tuned, s_base, tuned.tune)
+
+
+def test_fit_auto_conflicts_and_cpu_backend_warns():
+    Y, _, _ = _panel(33)
+    model = DynamicFactorModel(n_factors=K)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        fit(model, Y, auto=True, tune=TuneOptions())
+    with pytest.warns(RuntimeWarning, match="no tuned-hyper seam"):
+        res = fit(model, Y, max_iters=4, tol=0.0, backend=CPUBackend(),
+                  tune=TuneOptions(method="grad", steps=3))
+    assert res.tune is None
+
+
+def test_fit_tune_composes_with_fused_and_telemetry(tmp_path):
+    """Tuned fused fit keeps the one-program contract (nowcast present,
+    no fallback event) and the trace proves the budget: ONE tune event,
+    ONE barrier'd tune_grad dispatch for the whole search."""
+    Y, _, _ = _panel(34)
+    trace = tmp_path / "t.jsonl"
+    res = fit(DynamicFactorModel(n_factors=K), Y, max_iters=9, tol=0.0,
+              fused=True, backend=TPUBackend(dtype=jnp.float64),
+              tune=TuneOptions(method="grad", steps=4, em_iters=3),
+              telemetry=str(trace))
+    assert res.tune is not None and res.nowcast is not None
+    evs = [json.loads(l) for l in trace.read_text().splitlines()]
+    tunes = [e for e in evs if e["kind"] == "tune"]
+    assert len(tunes) == 1 and tunes[0]["dispatches"] == 1
+    assert not any(e["kind"] == "fused_fallback" for e in evs)
+    grad_disp = [e for e in evs if e["kind"] == "dispatch"
+                 and e.get("program") == "tune_grad"]
+    assert len(grad_disp) == 1 and grad_disp[0]["barrier"]
+
+
+def test_fit_tune_composes_with_robust_guard():
+    """The guard must not count generalized EM's plateau dip as a
+    divergence (monotone=False reaches the guarded driver too)."""
+    from dfm_tpu.robust import RobustPolicy
+    Y, _, _ = _panel(35)
+    res = fit(DynamicFactorModel(n_factors=K), Y, max_iters=8, tol=0.0,
+              backend=TPUBackend(dtype=jnp.float64),
+              tune=TuneOptions(method="grad", steps=3, em_iters=3),
+              robust=RobustPolicy())
+    assert res.tune is not None
+    if res.health is not None:
+        assert not [e for e in res.health.events
+                    if e.kind == "divergence"], res.health.events
+
+
+def test_resolve_and_options_validation():
+    assert resolve_tune(None) is None and resolve_tune(False) is None
+    assert resolve_tune(True) == TuneOptions()
+    o = resolve_tune({"method": "sweep", "em_iters": 7})
+    assert o.method == "sweep" and o.em_iters == 7
+    with pytest.raises(ValueError):
+        TuneOptions(method="bayes")
+    with pytest.raises(TypeError):
+        resolve_tune(42)
+
+
+# ------------------------------------------------ monotone seam -------
+
+def test_em_progress_tuned_rule_classifies_drop_as_plateau():
+    lls = [-100.0, -90.0, -90.5]          # beyond-floor terminal drop
+    assert em_progress(lls, 1e-6, 0.1, monotone=True) == "diverged"
+    assert em_progress(lls, 1e-6, 0.1, monotone=False) == "converged"
+    # Rising histories are unaffected by the flag.
+    assert em_progress([-100.0, -90.0], 1e-6, 0.1,
+                       monotone=False) == "continue"
+    assert cfg_hypers(EMConfig()) is None
+    assert cfg_hypers(EMConfig(q_scale=2.0)) == (2.0, 1.0, 0.0)
+
+
+# ------------------------------------------------ maintenance retune --
+
+def _small_fleet():
+    rng = np.random.default_rng(77)
+    Y_all, _ = dgp.simulate(dgp.dfm_params(8, 2, rng), 48, rng)
+    Y0, stream = Y_all[:40], Y_all[40:]
+    res = fit(DynamicFactorModel(n_factors=2), Y0, max_iters=3, tol=0.0,
+              fused=True)
+    fl = open_fleet([res], [Y0], tenants=["t0"], capacity=48,
+                    max_update_rows=2, max_iters=2, tol=0.0)
+    fl.submit("t0", stream[:2])
+    fl.drain()
+    return fl
+
+
+def test_maintenance_retune_records_tune_trail():
+    fl = _small_fleet()
+    recs = run_maintenance(fl, ["t0"], policy=MaintenancePolicy(
+        min_gain=float("-inf"), max_iters=8, retune=True,
+        retune_steps=4, retune_em_iters=3))
+    r = recs[0]
+    assert r.action in ("swap", "retune") and r.swap_t is not None
+    assert r.tune is not None and "best_params" not in r.tune
+    for key in ("q_scale", "r_scale", "heldout_before", "heldout_after"):
+        assert key in r.tune
+    fl.close()
+
+
+def test_maintenance_retune_swaps_winning_tuned_candidate(monkeypatch):
+    """When the tuned candidate wins the held-out gate, the fleet serves
+    exactly those params (params-only through swap_params) and the trail
+    says action="retune" with the chosen hypers."""
+    fl = _small_fleet()
+    _, slot = fl._slot_of["t0"]
+    Y_host = np.asarray(slot.Y_orig, np.float64)
+    W = np.asarray(slot.W_orig, np.float64)
+    Yz = slot.std.transform(Y_host) if slot.std is not None else Y_host
+    # A tuned candidate distinguishable from the refit: a lone fit on
+    # the current window.  Stands in for the tune search, and the gate's
+    # scorer is biased to prefer it BY IDENTITY, so the decision seam
+    # (gate -> retune swap -> trail) runs deterministically.
+    strong = fit(DynamicFactorModel(n_factors=2, standardize=False), Yz,
+                 max_iters=20, tol=0.0).params
+    import dfm_tpu.estim.tune as tune_mod
+    import dfm_tpu.fleet.maintenance as maint_mod
+
+    def fake_tune(Y, mask, p0, cfg, opts=None, dtype=None,
+                  return_params=False):
+        return {"method": "grad", "q_scale": 1.3, "r_scale": 0.8,
+                "lam_ridge": 0.0, "heldout_before": 1.0,
+                "heldout_after": 0.5, "dispatches": 1,
+                "best_params": strong}
+
+    real_score = maint_mod.heldout_score
+
+    def biased_score(Yz_, W_, params, h):
+        return 0.0 if params is strong else real_score(Yz_, W_, params, h)
+
+    monkeypatch.setattr(tune_mod, "tune_fit", fake_tune)
+    monkeypatch.setattr(maint_mod, "heldout_score", biased_score)
+    recs = run_maintenance(fl, ["t0"], policy=MaintenancePolicy(
+        min_gain=float("-inf"), max_iters=1, retune=True))
+    r = recs[0]
+    assert r.action == "retune" and r.swap_t is not None
+    assert r.tune["q_scale"] == 1.3 and "best_params" not in r.tune
+    assert r.score_after == 0.0
+    p_now = fl._slot_params_np(*fl._slot_of["t0"])
+    assert np.allclose(np.asarray(p_now.Lam), np.asarray(strong.Lam),
+                       rtol=1e-6, atol=1e-8)
+    fl.close()
+
+
+def test_maintenance_retune_off_is_unchanged():
+    fl = _small_fleet()
+    recs = run_maintenance(fl, ["t0"], policy=MaintenancePolicy(
+        min_gain=float("-inf"), max_iters=8))
+    assert recs[0].tune is None and recs[0].action == "swap"
+    fl.close()

@@ -266,5 +266,25 @@ assert store.lower_is_better("trace_overhead_pct"), \
 assert store.noise_floor("trace_overhead_pct") >= 5.0, \
     "perf_gate: trace_overhead_pct lost its percent noise floor"'
 
+# The differentiable-tuning metrics (bench.tune / tools/tune_smoke.sh)
+# must stay registered: the grad-search-vs-grid-sweep wall ratio and the
+# held-out MSE gain gate higher-is-better; tune_dispatches is the
+# dispatch-budget contract itself — lower-is-better with floor 0 (one
+# extra blocking d2h through the tunnel IS the regression).
+python -c '
+from dfm_tpu.obs import store
+need = ("tune_speedup_vs_grid", "tune_heldout_gain", "tune_dispatches")
+missing = [k for k in need if k not in store._BENCH_NUMERIC_KEYS]
+assert not missing, f"perf_gate: obs.store not recording {missing}"
+for k in ("tune_speedup_vs_grid", "tune_heldout_gain"):
+    assert not store.lower_is_better(k), \
+        f"perf_gate: {k} must gate higher-is-better"
+assert store.lower_is_better("tune_dispatches"), \
+    "perf_gate: tune_dispatches lost its lower-is-better marker"
+assert store.noise_floor("tune_dispatches") == 0, \
+    "perf_gate: tune_dispatches must gate exactly (dispatch budget)"
+assert store._backfill_kind("BENCH_tune.json") == "bench_tune", \
+    "perf_gate: store backfill no longer imports BENCH_tune.json"'
+
 echo "--- perf gate (run $RUN_ID vs ${*:-history}) ---" >&2
 python -m dfm_tpu.obs.regress "$RUN_ID" --runs "$RUNS" "$@"

@@ -1,0 +1,101 @@
+#!/usr/bin/env bash
+# One-command differentiable-tuning check: the CV sweep leg (G hyper
+# points as ONE fused batched program + one scoring program, 2 blocking
+# d2h), the gradient-search leg (the whole search — inner EM, in-graph
+# held-out loss, Adam over log hypers — as ONE jitted program, 1 d2h,
+# dispatch budget asserted from the trace via obs.report --json), and a
+# smoke-size bench.tune run (grad search must beat the G-lone-fit grid
+# sweep >= 3x with <= 2 dispatches).  The quick answer to "does gradient
+# tuning still replace the grid, on budget, and does the trace prove it".
+#
+# Usage (from the repo root):
+#   tools/tune_smoke.sh
+#
+# JAX_PLATFORMS defaults to cpu (the axon CPU fallback); shapes are
+# smoke-size via the DFM_BENCH_* knobs below.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "--- CV sweep leg: G lanes, ONE fused program, 2 d2h ---" >&2
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" python - <<'PY'
+import numpy as np
+
+from dfm_tpu.backends import cpu_ref
+from dfm_tpu.estim.em import EMConfig
+from dfm_tpu.estim.tune import DEFAULT_GRID, TuneOptions, tune_fit
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(7)
+Y_raw, _ = dgp.simulate(dgp.dfm_params(12, 2, rng), 72, rng)
+Y = (Y_raw - Y_raw.mean(0)) / Y_raw.std(0)
+W = dgp.random_mask(72, 12, rng, 0.1)
+p0 = cpu_ref.pca_init(Y * W, 2)
+rec = tune_fit(Y, W, p0, EMConfig(filter="info"),
+               TuneOptions(method="sweep", em_iters=4))
+assert rec["dispatches"] == 2, \
+    f"tune smoke FAILED: sweep used {rec['dispatches']} d2h (budget 2)"
+assert len(rec["cv"]) == len(DEFAULT_GRID), rec["cv"]
+assert rec["heldout_after"] <= rec["heldout_before"] + 1e-12, \
+    f"tune smoke FAILED: sweep made held-out worse ({rec})"
+print(f"sweep OK: {len(rec['cv'])} lanes in 2 d2h, best "
+      f"q={rec['q_scale']:.3g} r={rec['r_scale']:.3g}, held-out "
+      f"{rec['heldout_before']:.4g} -> {rec['heldout_after']:.4g}")
+PY
+
+echo "--- grad leg: fit(tune=) end-to-end, budget from the trace ---" >&2
+JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" python - <<'PY'
+import json
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+from dfm_tpu import DynamicFactorModel, fit
+from dfm_tpu.estim.tune import TuneOptions
+from dfm_tpu.utils import dgp
+
+rng = np.random.default_rng(8)
+Y, _ = dgp.simulate(dgp.dfm_params(12, 2, rng), 72, rng)
+trace = tempfile.mktemp(suffix=".jsonl")
+res = fit(DynamicFactorModel(n_factors=2), Y, max_iters=6, tol=0.0,
+          tune=TuneOptions(method="grad", steps=5, em_iters=4),
+          telemetry=trace)
+assert res.tune is not None and res.tune["method"] == "grad"
+assert res.tune["heldout_after"] <= res.tune["heldout_before"] + 1e-12, \
+    f"tune smoke FAILED: grad search made held-out worse ({res.tune})"
+# The dispatch budget, proven from the trace the fit wrote:
+out = subprocess.run(
+    [sys.executable, "-m", "dfm_tpu.obs.report", trace, "--json"],
+    capture_output=True, text=True, check=True).stdout
+s = json.loads(out)
+tu = s["tune"]
+assert tu["dispatches"] <= 2, \
+    f"tune smoke FAILED: search cost {tu['dispatches']} blocking d2h"
+assert tu["q_scale"] == res.tune["q_scale"], (tu, res.tune)
+print(f"grad OK: q={tu['q_scale']:.3g} r={tu['r_scale']:.3g} in "
+      f"{tu['dispatches']} d2h (budget 2), held-out "
+      f"{tu['heldout_before']:.4g} -> {tu['heldout_after']:.4g}")
+PY
+
+echo "--- bench.tune smoke (grad vs G-lone-fit grid) ---" >&2
+OUT=$(JAX_PLATFORMS="${JAX_PLATFORMS-cpu}" \
+      DFM_BENCH_N="${DFM_BENCH_N:-12}" \
+      DFM_BENCH_T="${DFM_BENCH_T:-60}" \
+      DFM_BENCH_TUNE_STEPS="${DFM_BENCH_TUNE_STEPS:-5}" \
+      DFM_BENCH_TUNE_EM_ITERS="${DFM_BENCH_TUNE_EM_ITERS:-3}" \
+      DFM_BENCH_REPS="${DFM_BENCH_REPS:-3}" \
+      DFM_RUNS= python -m bench.tune)
+echo "$OUT"
+printf '%s' "$OUT" | python -c '
+import json, sys
+d = json.loads(sys.stdin.readline())
+spd = d["tune_speedup_vs_grid"]
+nd = d["tune_dispatches"]
+assert spd >= 3.0, (
+    f"tune smoke FAILED: grad search only {spd}x the grid sweep")
+assert nd <= 2, (
+    f"tune smoke FAILED: tune_dispatches {nd} over the 2-d2h budget")
+print(f"bench smoke OK: {spd}x vs grid, {nd} blocking d2h")'
+
+echo "tune smoke OK"
